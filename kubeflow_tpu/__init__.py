@@ -1,0 +1,29 @@
+"""kubeflow-tpu: a TPU-native ML platform.
+
+A ground-up rebuild of the capabilities of the Kubeflow monorepo (reference:
+PatrickXYS/kubeflow, v1.0 era) designed for TPUs:
+
+- ``kubeflow_tpu.parallel`` — device meshes, sharding rules, collectives, and
+  the multi-process bootstrap contract (the reference's TF_CONFIG / gRPC
+  parameter-server world, rebuilt on ``jax.sharding`` + ICI/DCN collectives).
+- ``kubeflow_tpu.models`` — flagship workloads (ResNet-50 benchmark parity
+  with ``tf-controller-examples/tf-cnn``, a Transformer LM with long-context
+  ring attention).
+- ``kubeflow_tpu.ops`` — Pallas TPU kernels with portable fallbacks.
+- ``kubeflow_tpu.train`` — train-step factories, synthetic data, metrics,
+  orbax checkpoint/auto-resume.
+- ``kubeflow_tpu.api`` — the platform's CRD-style typed objects (TpuJob,
+  Notebook, Profile, Tensorboard, PodDefault).
+- ``kubeflow_tpu.controllers`` — reconcilers for those objects (the
+  reference's Go controller tier, rebuilt around a reconcile toolkit and a
+  native C++ gang/topology scheduler).
+- ``kubeflow_tpu.serving`` / ``kubeflow_tpu.tuning`` / ``kubeflow_tpu.webapps``
+  / ``kubeflow_tpu.deploy`` — serving path, HP studies, web backends, and the
+  kfctl-style deploy tool.
+
+Nothing here imports jax at package-import time beyond what submodules need;
+importing ``kubeflow_tpu`` itself is cheap so control-plane processes (which
+never touch a TPU) don't pay accelerator-runtime startup costs.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,118 @@
+"""Multi-process bootstrap: the TpuJob env contract.
+
+The reference's tf-operator injected a ``TF_CONFIG`` JSON blob that each pod
+parsed into parameter-server CLI flags
+(`tf-controller-examples/tf-cnn/launcher.py:68-88`). The TPU-native
+equivalent is a flat env contract that the TpuJob operator injects into every
+pod of a gang and that maps 1:1 onto ``jax.distributed.initialize``:
+
+    TPUJOB_COORDINATOR    host:port of process 0 (the JAX coordinator)
+    TPUJOB_NUM_PROCESSES  total processes in the gang
+    TPUJOB_PROCESS_ID     this process's rank
+    TPUJOB_NUM_SLICES     number of TPU slices (multi-slice over DCN); def 1
+    TPUJOB_SLICE_ID       which slice this process belongs to; default 0
+
+Within a slice collectives ride ICI; across slices XLA routes the outer mesh
+axes over DCN (`jax.sharding` handles both through the same Mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Mapping
+
+log = logging.getLogger(__name__)
+
+ENV_COORDINATOR = "TPUJOB_COORDINATOR"
+ENV_NUM_PROCESSES = "TPUJOB_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
+ENV_NUM_SLICES = "TPUJOB_NUM_SLICES"
+ENV_SLICE_ID = "TPUJOB_SLICE_ID"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessEnv:
+    """Parsed gang membership for one process."""
+
+    coordinator: str | None = None
+    num_processes: int = 1
+    process_id: int = 0
+    num_slices: int = 1
+    slice_id: int = 0
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "ProcessEnv":
+        env = os.environ if env is None else env
+        pe = cls(
+            coordinator=env.get(ENV_COORDINATOR),
+            num_processes=int(env.get(ENV_NUM_PROCESSES, "1")),
+            process_id=int(env.get(ENV_PROCESS_ID, "0")),
+            num_slices=int(env.get(ENV_NUM_SLICES, "1")),
+            slice_id=int(env.get(ENV_SLICE_ID, "0")),
+        )
+        pe.validate()
+        return pe
+
+    def validate(self) -> None:
+        if self.num_processes < 1:
+            raise ValueError(f"num_processes must be >= 1, got {self.num_processes}")
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"process_id {self.process_id} out of range [0, {self.num_processes})"
+            )
+        if self.num_processes > 1 and not self.coordinator:
+            raise ValueError(
+                f"{ENV_COORDINATOR} is required when {ENV_NUM_PROCESSES} > 1"
+            )
+        if self.num_slices < 1 or not 0 <= self.slice_id < self.num_slices:
+            raise ValueError(
+                f"slice_id {self.slice_id} out of range [0, {self.num_slices})"
+            )
+        if self.num_processes % self.num_slices:
+            raise ValueError(
+                f"num_processes {self.num_processes} not divisible by "
+                f"num_slices {self.num_slices}"
+            )
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    def to_env(self) -> dict[str, str]:
+        """The operator-side inverse of from_env: env to inject into a pod."""
+        out = {
+            ENV_NUM_PROCESSES: str(self.num_processes),
+            ENV_PROCESS_ID: str(self.process_id),
+            ENV_NUM_SLICES: str(self.num_slices),
+            ENV_SLICE_ID: str(self.slice_id),
+        }
+        if self.coordinator:
+            out[ENV_COORDINATOR] = self.coordinator
+        return out
+
+
+def initialize_from_env(env: Mapping[str, str] | None = None) -> ProcessEnv:
+    """Initialize `jax.distributed` from the TpuJob env contract.
+
+    Single-process gangs (the default, and every test) skip initialization
+    entirely, so this is safe to call unconditionally at trainer startup —
+    the same way the reference's launcher ran identically with and without
+    TF_CONFIG present.
+    """
+    pe = ProcessEnv.from_env(env)
+    if pe.num_processes > 1:
+        import jax
+
+        log.info(
+            "jax.distributed.initialize coordinator=%s rank=%d/%d slice=%d/%d",
+            pe.coordinator, pe.process_id, pe.num_processes, pe.slice_id,
+            pe.num_slices,
+        )
+        jax.distributed.initialize(
+            coordinator_address=pe.coordinator,
+            num_processes=pe.num_processes,
+            process_id=pe.process_id,
+        )
+    return pe

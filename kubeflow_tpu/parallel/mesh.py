@@ -1,0 +1,118 @@
+"""Device-mesh construction for TPU slices.
+
+The reference scaled training only by adding replica processes (PS/worker
+TFJobs, `tf-controller-examples/tf-cnn/launcher.py:68-88`; Horovod rings,
+`components/openmpi-controller/controller/controller.py`). Here every
+parallelism strategy — including the ones the reference lacked entirely
+(tensor, pipeline, sequence/context, expert; SURVEY.md §2.2) — is an axis of
+one `jax.sharding.Mesh`:
+
+    pp    pipeline-parallel stages (slowest-varying; stage boundaries cross
+          the fewest ICI links and tolerate DCN in multi-slice layouts)
+    dp    pure data parallel (gradient psum only)
+    fsdp  data parallel with fully-sharded parameters (ZeRO-3 style:
+          all-gather params, reduce-scatter grads)
+    sp    sequence/context parallel (ring attention shifts ride this axis)
+    ep    expert parallel (MoE all-to-all rides this axis)
+    tp    tensor parallel (fastest-varying so its all-reduces ride
+          nearest-neighbor ICI links)
+
+Axis order is part of the performance contract: `mesh_utils.create_device_mesh`
+maps the last mesh axis onto physically adjacent chips, so the axis with the
+chattiest collectives (tp) must come last and the one that can tolerate DCN
+(pp, then dp) first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Mesh axis names, slowest-varying (outermost, DCN-tolerant) first.
+AXES: tuple[str, ...] = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+# Axes over which a *global data batch* is split. `sp` and `ep` shard
+# activations (tokens within an example / experts), `tp` shards features,
+# `pp` shards layers — none of those divide the batch.
+BATCH_AXES: tuple[str, ...] = ("dp", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named parallelism layout.
+
+    Each field is the size of one mesh axis. At most one axis may be -1,
+    meaning "fill with all remaining devices" — the usual idiom is
+    ``MeshSpec(fsdp=-1)`` for pure FSDP or ``MeshSpec(dp=-1)`` for pure DP.
+    """
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    def sizes(self) -> tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Resolve a single -1 axis against the device count and validate."""
+        sizes = list(self.sizes())
+        if any(s < 1 and s != -1 for s in sizes):
+            raise ValueError(f"mesh axis sizes must be >= 1 (or -1 to infer): {self}")
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {self}")
+        if wild:
+            fixed = math.prod(s for s in sizes if s != -1)
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes of {self}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        if math.prod(sizes) != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXES, sizes))} needs {math.prod(sizes)} devices, "
+                f"have {n_devices}"
+            )
+        return MeshSpec(**dict(zip(AXES, sizes)))
+
+    @property
+    def data_parallelism(self) -> int:
+        return self.dp * self.fsdp
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a `jax.sharding.Mesh` for `spec` over `devices`.
+
+    Uses `mesh_utils.create_device_mesh` so the logical axes are laid out
+    along the physical ICI topology (it understands TPU 2D/3D torus wraps);
+    falls back to a plain reshape for CPU/virtual device sets where there is
+    no topology to exploit.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = (spec or MeshSpec(dp=-1)).resolve(len(devices))
+    shape = spec.sizes()
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, NotImplementedError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def local_mesh_spec(n_devices: int | None = None, tp: int = 1, sp: int = 1) -> MeshSpec:
+    """Convenience: FSDP over everything not claimed by tp/sp."""
+    n = n_devices if n_devices is not None else jax.device_count()
+    if n % (tp * sp):
+        raise ValueError(f"{n} devices not divisible by tp={tp} * sp={sp}")
+    return MeshSpec(fsdp=n // (tp * sp), sp=sp, tp=tp)

@@ -1,0 +1,119 @@
+"""Logical-axis sharding rules.
+
+Models annotate parameters/activations with *logical* axis names ("embed",
+"mlp", "heads", ...); a rules table maps logical names to mesh axes from
+`kubeflow_tpu.parallel.mesh.AXES`. Changing the parallelism layout is a
+rules-table change, never a model change — this is the scaling-book recipe
+(pick a mesh, annotate shardings, let XLA insert the collectives), and it is
+what makes TP/SP/EP "a config, not a fork" (SURVEY.md §5, long-context row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import BATCH_AXES
+
+# logical name -> mesh axis (or tuple of mesh axes, or None for replicated)
+LogicalRules = Mapping[str, Any]
+
+
+def default_rules(*, fsdp_params: bool = True) -> dict[str, Any]:
+    """Rules for the standard DP/FSDP × TP × SP transformer layout.
+
+    With ``fsdp_params=True`` the embed dimension of every weight is sharded
+    over the fsdp axis (ZeRO-3: XLA all-gathers weights forward, reduce-
+    scatters gradients backward). Attention heads and MLP hidden ride tp;
+    activation sequence rides sp (ring attention), batch rides dp×fsdp.
+    """
+    return {
+        # activations
+        "batch": BATCH_AXES,
+        "seq": "sp",
+        "act_embed": None,          # activation features replicated across tp
+        "act_heads": "tp",
+        # parameters
+        "embed": "fsdp" if fsdp_params else None,
+        "mlp": "tp",
+        "heads": "tp",
+        "kv": None,
+        "qkv_embed": "fsdp" if fsdp_params else None,
+        "vocab": "tp",
+        "expert": "ep",
+        # conv / vision parameters: shard the output-channel dim over fsdp
+        "conv_out": "fsdp" if fsdp_params else None,
+        "conv_in": None,
+        "spatial": None,
+        # scalars / norms
+        "norm": None,
+        "stage": "pp",
+    }
+
+
+def spec_for(names: Sequence[str | None], rules: LogicalRules) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    parts = []
+    for name in names:
+        if name is None:
+            parts.append(None)
+        else:
+            if name not in rules:
+                raise KeyError(f"no sharding rule for logical axis {name!r}")
+            parts.append(rules[name])
+    # Trim trailing Nones so specs print compactly and match ranks loosely.
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def named_sharding(mesh: Mesh, *parts: Any) -> NamedSharding:
+    return NamedSharding(mesh, P(*parts))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard dim 0 over the batch axes, replicate the rest."""
+    axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
+
+
+def logical_sharding(
+    mesh: Mesh, names: Sequence[str | None], rules: LogicalRules
+) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(names, rules))
+
+
+def shard_pytree(tree: Any, mesh: Mesh, sharding_tree: Any | None = None) -> Any:
+    """`jax.device_put` a pytree onto `mesh`, replicating by default.
+
+    `sharding_tree` may be a pytree-prefix of NamedShardings (as accepted by
+    device_put); None replicates everything — the right default for small
+    states and for tests.
+    """
+    if sharding_tree is None:
+        sharding_tree = replicated(mesh)
+    return jax.device_put(tree, sharding_tree)
+
+
+def apply_logical_annotations(tree: Any, mesh: Mesh, rules: LogicalRules) -> Any:
+    """Turn a pytree of flax logically-annotated params into NamedShardings.
+
+    Works with `flax.linen.with_partitioning` metadata: leaves that are
+    `nn.Partitioned` (or anything exposing `.names`) get their logical names
+    mapped through `rules`; plain arrays are replicated.
+    """
+    def one(leaf: Any) -> NamedSharding:
+        names = getattr(leaf, "names", None)
+        if names is None:
+            return replicated(mesh)
+        return logical_sharding(mesh, names, rules)
+
+    return jax.tree_util.tree_map(
+        one, tree, is_leaf=lambda x: hasattr(x, "names")
+    )

@@ -1,0 +1,48 @@
+import pytest
+
+from kubeflow_tpu.parallel.distributed import (
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ProcessEnv,
+    initialize_from_env,
+)
+
+
+def test_default_single_process():
+    pe = ProcessEnv.from_env({})
+    assert pe.num_processes == 1 and pe.is_coordinator
+
+
+def test_parse_gang():
+    pe = ProcessEnv.from_env({
+        ENV_COORDINATOR: "job-0:8476",
+        ENV_NUM_PROCESSES: "4",
+        ENV_PROCESS_ID: "2",
+    })
+    assert pe.num_processes == 4 and pe.process_id == 2
+    assert not pe.is_coordinator
+    round_trip = ProcessEnv.from_env(pe.to_env())
+    assert round_trip == pe
+
+
+def test_missing_coordinator_rejected():
+    with pytest.raises(ValueError):
+        ProcessEnv.from_env({ENV_NUM_PROCESSES: "2", ENV_PROCESS_ID: "0"})
+
+
+def test_bad_rank_rejected():
+    with pytest.raises(ValueError):
+        ProcessEnv.from_env({
+            ENV_COORDINATOR: "a:1", ENV_NUM_PROCESSES: "2", ENV_PROCESS_ID: "5",
+        })
+
+
+def test_slices_must_divide():
+    with pytest.raises(ValueError):
+        ProcessEnv(coordinator="a:1", num_processes=4, num_slices=3).validate()
+
+
+def test_initialize_noop_single_process():
+    pe = initialize_from_env({})
+    assert pe.num_processes == 1

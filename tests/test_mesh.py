@@ -1,0 +1,53 @@
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_tpu.parallel.mesh import AXES, local_mesh_spec
+
+
+def test_resolve_wildcard():
+    spec = MeshSpec(dp=-1, tp=2).resolve(8)
+    assert spec.dp == 4 and spec.tp == 2
+    assert spec.data_parallelism == 4
+
+
+def test_resolve_exact():
+    spec = MeshSpec(dp=2, fsdp=2, tp=2).resolve(8)
+    assert spec.sizes() == (1, 2, 2, 1, 1, 2)
+
+
+def test_resolve_rejects_bad_product():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, fsdp=-1).resolve(8)
+
+
+def test_build_mesh_axes(devices):
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2), devices)
+    assert mesh.axis_names == AXES
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_build_mesh_default_is_all_dp(devices):
+    mesh = build_mesh(devices=devices)
+    assert mesh.shape["dp"] == 8
+
+
+def test_local_mesh_spec():
+    assert local_mesh_spec(8, tp=2).fsdp == 4
+    with pytest.raises(ValueError):
+        local_mesh_spec(8, tp=3)
+
+
+def test_mesh_runs_sharded_compute(mesh8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh8, P(("dp", "fsdp"), None)))
+    y = jax.jit(lambda a: (a * 2).sum())(xs)
+    assert float(y) == float(x.sum() * 2)
