@@ -1,0 +1,99 @@
+"""Platform benchmark: ResNet-50 training throughput on TPU.
+
+Parity target: the reference's benchmark workload is `tf_cnn_benchmarks`
+ResNet-50 launched by a TFJob (`tf-controller-examples/tf-cnn`), default
+synthetic data (`README.md:19`). The reference published no numbers
+(BASELINE.md); the driver-set north star is >=90% of the MLPerf reference
+images/sec/chip. We use 2000 images/sec/chip as that per-chip proxy on
+v5e — `vs_baseline` is measured/2000, so 0.9 is the north-star line.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 2000.0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--warmup-steps", type=int, default=5)
+    parser.add_argument("--steps", type=int, default=30)
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.resnet import resnet50
+    from kubeflow_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_tpu.train import SyntheticImages, TrainConfig, Trainer
+
+    n_chips = jax.device_count()
+    mesh = build_mesh(MeshSpec(dp=-1))
+    config = TrainConfig(
+        batch_size=args.batch_size * n_chips,
+        learning_rate=0.4,
+        total_steps=10_000,
+        # Single-host bench: pure DP; params replicated (ResNet-50 is 25M
+        # params — FSDP buys nothing below pod scale).
+        fsdp_params=False,
+    )
+    trainer = Trainer(
+        resnet50(),
+        config,
+        mesh,
+        example_input_shape=(2, args.image_size, args.image_size, 3),
+    )
+    data = SyntheticImages(
+        mesh,
+        batch_size=config.batch_size,
+        image_size=args.image_size,
+        dtype=jnp.bfloat16,
+    )
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    step = trainer.make_train_step()
+    it = iter(data)
+
+    for _ in range(args.warmup_steps):
+        state, metrics = step(state, next(it))
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, next(it))
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec = config.batch_size * args.steps / elapsed
+    per_chip = images_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4
+                ),
+            }
+        )
+    )
+    print(
+        f"# devices={n_chips} global_batch={config.batch_size} "
+        f"steps={args.steps} elapsed={elapsed:.2f}s "
+        f"total={images_per_sec:.1f} img/s loss={float(metrics['loss']):.3f}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Flagship workloads.
+
+- ``resnet`` — ResNet-50 v1.5, the platform benchmark. Functional parity
+  target for the reference's `tf-controller-examples/tf-cnn` TFJob workload
+  (which wrapped upstream `tf_cnn_benchmarks`; `launcher.py:68-88`).
+- ``transformer`` — decoder-only LM with TP/SP logical sharding and ring
+  attention, the long-context/multi-axis showcase the reference never had
+  (SURVEY.md §2.2: TP/PP/SP/EP all absent upstream).
+- ``mnist`` — the small CNN used by the serving golden-prediction tests
+  (parity with `testing/test_tf_serving.py`'s mnist model).
+"""
+
+from kubeflow_tpu.models.resnet import ResNet, resnet18, resnet50

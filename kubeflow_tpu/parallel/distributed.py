@@ -100,8 +100,20 @@ def initialize_from_env(env: Mapping[str, str] | None = None) -> ProcessEnv:
     entirely, so this is safe to call unconditionally at trainer startup —
     the same way the reference's launcher ran identically with and without
     TF_CONFIG present.
+
+    Multi-slice gangs additionally export the MEGASCALE_* variables that
+    libtpu's DCN transport reads, so cross-slice collectives are configured
+    before the backend initializes. (jax.distributed itself only sees the
+    flat process gang; slice structure is a runtime concern.)
     """
     pe = ProcessEnv.from_env(env)
+    if pe.num_slices > 1:
+        os.environ.setdefault("MEGASCALE_NUM_SLICES", str(pe.num_slices))
+        os.environ.setdefault("MEGASCALE_SLICE_ID", str(pe.slice_id))
+        if pe.coordinator:
+            os.environ.setdefault(
+                "MEGASCALE_COORDINATOR_ADDRESS", pe.coordinator
+            )
     if pe.num_processes > 1:
         import jax
 

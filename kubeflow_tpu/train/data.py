@@ -1,0 +1,84 @@
+"""Input pipelines.
+
+`SyntheticImages` mirrors the reference benchmark's default data mode:
+`tf_cnn_benchmarks` runs on synthetic data unless told otherwise
+(`tf-controller-examples/tf-cnn/README.md:19`), which isolates accelerator
+throughput from input IO. Batches are created *already sharded* (jit with
+out_shardings) so no single device ever holds the global batch, and
+iteration costs nothing on the host — measured steps/sec is pure device
+time.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.sharding import batch_axes, batch_sharding
+
+
+class SyntheticImages:
+    """An infinite stream of one device-resident image batch."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        batch_size: int,
+        image_size: int = 224,
+        num_classes: int = 1000,
+        channels: int = 3,
+        seed: int = 0,
+        dtype=jnp.float32,
+    ):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        k_img, k_lbl = jax.random.split(jax.random.PRNGKey(seed))
+        sharding = batch_sharding(mesh, ndim=1)
+
+        def make():
+            img = jax.random.normal(
+                k_img, (batch_size, image_size, image_size, channels), dtype
+            )
+            lbl = jax.random.randint(k_lbl, (batch_size,), 0, num_classes)
+            return {"image": img, "label": lbl}
+
+        self.batch = jax.jit(make, out_shardings=sharding)()
+        self.batch_size = batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.batch
+
+
+class SyntheticTokens:
+    """Synthetic LM batches: random token ids, next-token labels."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        batch_size: int,
+        seq_len: int,
+        vocab_size: int,
+        seed: int = 0,
+    ):
+        key = jax.random.PRNGKey(seed)
+        # Sequence dim rides sp when present so ring attention gets
+        # pre-sharded inputs.
+        seq_axis = "sp" if "sp" in mesh.axis_names else None
+        sharding = NamedSharding(mesh, P(batch_axes(mesh), seq_axis))
+
+        def make():
+            tokens = jax.random.randint(
+                key, (batch_size, seq_len + 1), 0, vocab_size
+            )
+            return {"tokens": tokens[:, :-1], "labels": tokens[:, 1:]}
+
+        self.batch = jax.jit(make, out_shardings=sharding)()
+        self.batch_size = batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.batch

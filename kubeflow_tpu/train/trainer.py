@@ -1,0 +1,218 @@
+"""Sharded train-step factory.
+
+The scaling recipe end-to-end: the model carries logical axis names, the
+mesh carries physical axes, `flax.linen.logical_to_mesh_sharding` joins them
+through the rules table, and one `jax.jit` with explicit in/out shardings
+compiles the whole step — XLA inserts every collective (gradient psum over
+dp, all-gather/reduce-scatter for fsdp, tp all-reduces) that the reference
+obtained from parameter servers and Horovod rings (SURVEY.md §2.2).
+
+No pmap, no per-device Python: a single traced program over the global mesh,
+which is what lets the same trainer run 1 chip or a multi-slice pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Mapping
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel import sharding as shlib
+
+
+class TrainState(struct.PyTreeNode):
+    """Step counter + params + optimizer + BN state, one donate-able pytree."""
+
+    step: jax.Array
+    params: core.FrozenDict | dict
+    opt_state: optax.OptState
+    batch_stats: core.FrozenDict | dict = struct.field(default_factory=dict)
+    apply_fn: Callable = struct.field(pytree_node=False, default=None)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False, default=None)
+
+    def apply_gradients(self, *, grads, **updates) -> "TrainState":
+        upd, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, upd),
+            opt_state=new_opt,
+            **updates,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    batch_size: int = 256
+    learning_rate: float = 0.4
+    warmup_steps: int = 200
+    total_steps: int = 10_000
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    label_smoothing: float = 0.1
+    # "sgd" (benchmark parity with tf_cnn_benchmarks' default) or "adamw"
+    optimizer: str = "sgd"
+    fsdp_params: bool = True
+
+
+def decay_mask(params) -> Any:
+    """Weight decay applies to matrices/filters only — never to the 1-D
+    params (BN/LN scales and biases)."""
+    return jax.tree_util.tree_map(lambda p: p.ndim > 1, params)
+
+
+def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=config.learning_rate,
+        warmup_steps=config.warmup_steps,
+        decay_steps=max(config.total_steps, config.warmup_steps + 1),
+    )
+    if config.optimizer == "sgd":
+        return optax.chain(
+            optax.add_decayed_weights(config.weight_decay, mask=decay_mask),
+            optax.sgd(schedule, momentum=config.momentum, nesterov=True),
+        )
+    if config.optimizer == "adamw":
+        return optax.adamw(schedule, weight_decay=config.weight_decay)
+    raise ValueError(f"unknown optimizer {config.optimizer!r}")
+
+
+def softmax_cross_entropy(logits, labels, label_smoothing: float = 0.0):
+    num_classes = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, num_classes)
+    if label_smoothing:
+        onehot = (
+            onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+        )
+    return optax.softmax_cross_entropy(logits, onehot).mean()
+
+
+class Trainer:
+    """Binds (model, config, mesh) into sharded init/train-step callables."""
+
+    def __init__(
+        self,
+        model: nn.Module,
+        config: TrainConfig,
+        mesh: Mesh,
+        rules: Mapping[str, Any] | None = None,
+        example_input_shape: tuple = (2, 224, 224, 3),
+        input_key: str = "image",
+    ):
+        self.model = model
+        self.config = config
+        self.mesh = mesh
+        self.rules = dict(
+            rules
+            if rules is not None
+            else shlib.default_rules(fsdp_params=config.fsdp_params)
+        )
+        self.tx = make_optimizer(config)
+        self.example_input_shape = example_input_shape
+        self.input_key = input_key
+        self._shardings = None
+
+    # -- state construction ------------------------------------------------
+
+    def _init_boxed(self, rng) -> TrainState:
+        """Init keeping flax Partitioned boxes so logical names survive
+        through eval_shape into the optimizer state (optax tree_maps rebuild
+        the boxes, which is how momentum inherits the param shardings)."""
+        dummy = jnp.zeros(self.example_input_shape, jnp.float32)
+        variables = self.model.init(rng, dummy, train=False)
+        params = variables["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.tx.init(params),
+            batch_stats=variables.get("batch_stats", {}),
+            apply_fn=self.model.apply,
+            tx=self.tx,
+        )
+
+    def state_shardings(self) -> TrainState:
+        """NamedSharding tree for TrainState, from logical annotations."""
+        if self._shardings is None:
+            abstract = jax.eval_shape(self._init_boxed, jax.random.PRNGKey(0))
+            logical = nn.get_partition_spec(abstract)
+            self._shardings = nn.logical_to_mesh_sharding(
+                logical, self.mesh, list(self.rules.items())
+            )
+        return self._shardings
+
+    def init_state(self, rng) -> TrainState:
+        shardings = self.state_shardings()
+        init = jax.jit(
+            lambda r: nn.meta.unbox(self._init_boxed(r)),
+            out_shardings=shardings,
+        )
+        return init(rng)
+
+    def batch_sharding(self, ndim: int = 1) -> NamedSharding:
+        return shlib.batch_sharding(self.mesh, ndim)
+
+    # -- the step ----------------------------------------------------------
+
+    def make_train_step(self):
+        cfg = self.config
+        input_key = self.input_key
+
+        def train_step(state: TrainState, batch):
+            def loss_fn(params):
+                variables = {"params": params}
+                mutable = []
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                    mutable = ["batch_stats"]
+                out = state.apply_fn(
+                    variables, batch[input_key], train=True, mutable=mutable
+                )
+                logits, new_vars = out if mutable else (out, {})
+                loss = softmax_cross_entropy(
+                    logits, batch["label"], cfg.label_smoothing
+                )
+                return loss, (new_vars, logits)
+
+            (loss, (new_vars, logits)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            state = state.apply_gradients(
+                grads=grads,
+                batch_stats=new_vars.get("batch_stats", state.batch_stats),
+            )
+            accuracy = jnp.mean(
+                (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+            )
+            return state, {"loss": loss, "accuracy": accuracy}
+
+        return jax.jit(
+            train_step,
+            donate_argnums=0,
+            out_shardings=(self.state_shardings(), None),
+        )
+
+    def make_eval_step(self):
+        input_key = self.input_key
+
+        def eval_step(state: TrainState, batch):
+            variables = {"params": state.params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            logits = state.apply_fn(variables, batch[input_key], train=False)
+            return {
+                "loss": softmax_cross_entropy(logits, batch["label"]),
+                "accuracy": jnp.mean(
+                    (jnp.argmax(logits, -1) == batch["label"]).astype(
+                        jnp.float32
+                    )
+                ),
+            }
+
+        return jax.jit(eval_step)

@@ -1,0 +1,76 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.resnet import resnet50, tiny_resnet
+from kubeflow_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_tpu.train import SyntheticImages, TrainConfig, Trainer
+
+
+def _trainer(mesh, **cfg):
+    config = TrainConfig(
+        batch_size=16,
+        learning_rate=0.1,
+        warmup_steps=2,
+        total_steps=20,
+        **cfg,
+    )
+    model = tiny_resnet()
+    return Trainer(
+        model, config, mesh, example_input_shape=(2, 32, 32, 3)
+    )
+
+
+def test_resnet50_param_count():
+    # The canonical ResNet-50 has 25.56M params; catches block-wiring bugs.
+    model = resnet50()
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)))
+    )
+    import flax
+
+    n = sum(
+        np.prod(x.shape)
+        for x in jax.tree_util.tree_leaves(flax.linen.meta.unbox(variables["params"]))
+    )
+    assert 25_500_000 < n < 25_620_000, f"param count {n}"
+
+
+def test_train_step_decreases_loss(mesh8):
+    trainer = _trainer(mesh8)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    data = SyntheticImages(
+        mesh8, batch_size=16, image_size=32, num_classes=10, dtype=jnp.float32
+    )
+    step = trainer.make_train_step()
+    it = iter(data)
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, next(it))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 10
+
+
+def test_state_is_sharded_fsdp(mesh8):
+    trainer = _trainer(mesh8)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    # The stem conv kernel (3,3,3,8): conv_out=8 sharded over fsdp=2.
+    stem = state.params["conv_stem"]["kernel"]
+    spec = stem.sharding.spec
+    assert "fsdp" in str(spec), spec
+    # Momentum inherits the same sharding (boxes survive optax.init).
+    mu = jax.tree_util.tree_leaves(
+        state.opt_state, is_leaf=lambda x: hasattr(x, "sharding")
+    )
+    assert any("fsdp" in str(m.sharding.spec) for m in mu if m.ndim > 1)
+
+
+def test_eval_step(mesh8):
+    trainer = _trainer(mesh8)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    data = SyntheticImages(
+        mesh8, batch_size=16, image_size=32, num_classes=10, dtype=jnp.float32
+    )
+    metrics = trainer.make_eval_step()(state, next(iter(data)))
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
