@@ -11,3 +11,4 @@
 """
 
 from kubeflow_tpu.models.resnet import ResNet, resnet18, resnet50
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM

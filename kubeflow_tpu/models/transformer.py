@@ -1,0 +1,265 @@
+"""Decoder-only Transformer LM, TPU-first.
+
+The multi-axis showcase: every parallelism strategy the reference lacked
+(SURVEY.md §2.2 — TP, SP, EP all "Absent") is expressed here through logical
+axis names and resolved by the rules table:
+
+- attention heads and MLP hidden shard over ``tp`` (XLA inserts the two
+  all-reduces per block);
+- the sequence axis shards over ``sp`` and attention runs on the ring
+  (`kubeflow_tpu.ops.ring_attention`);
+- optional mixture-of-experts MLP shards experts over ``ep``;
+- embed-dim weight shards over ``fsdp`` (ZeRO-3).
+
+Blocks are rematerialized (`nn.remat`) — recompute beats HBM traffic on
+TPU for long sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from kubeflow_tpu.ops.attention import dense_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    head_dim: int = 64
+    d_ff: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # MoE: 0 experts = dense MLP. Top-1 (switch) routing with capacity.
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+
+
+def _dense(features, names, name=None, dtype=jnp.bfloat16):
+    return nn.DenseGeneral(
+        features,
+        axis=-1,
+        use_bias=False,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal"), names
+        ),
+        name=name,
+    )
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(self.dtype)
+
+
+def rope(x, positions, theta: float):
+    """Rotary embeddings. x: [B, S, H, D], positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h, d = cfg.n_heads, cfg.head_dim
+        q = _dense((h, d), ("embed", "heads", "kv"), "wq", cfg.dtype)(x)
+        k = _dense((h, d), ("embed", "heads", "kv"), "wk", cfg.dtype)(x)
+        v = _dense((h, d), ("embed", "heads", "kv"), "wv", cfg.dtype)(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if self.mesh is not None:
+            out = ring_attention(q, k, v, self.mesh, causal=True)
+        else:
+            out = dense_attention(q, k, v, causal=True)
+        out = nn.DenseGeneral(
+            cfg.d_model,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+                ("heads", "kv", "embed"),
+            ),
+            name="wo",
+        )(out)
+        return out
+
+
+class SwiGLU(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = _dense(cfg.d_ff, ("embed", "mlp"), "wi_gate", cfg.dtype)(x)
+        up = _dense(cfg.d_ff, ("embed", "mlp"), "wi_up", cfg.dtype)(x)
+        return _dense(cfg.d_model, ("mlp", "embed"), "wo", cfg.dtype)(
+            nn.silu(gate) * up
+        )
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 (switch) MoE with capacity, einsum-dispatched for the MXU.
+
+    Experts are a leading weight dimension with logical name "expert"
+    (→ ``ep`` mesh axis); dispatch/combine are einsums so XLA chooses the
+    all-to-all pattern. Load-balancing aux loss is sown under
+    ``intermediates/aux_loss`` and picked up by the trainer.
+    """
+
+    config: TransformerConfig
+
+    @staticmethod
+    def _group_size(n_tok: int, target: int = 4096) -> int:
+        """Largest divisor of n_tok <= target. Grouping keeps the one-hot
+        dispatch tensors O(n_tok * group) instead of O(n_tok^2)."""
+        for g in range(min(target, n_tok), 0, -1):
+            if n_tok % g == 0:
+                return g
+        return n_tok
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, dm = x.shape
+        n_tok = b * s
+        e = cfg.num_experts
+        g = self._group_size(n_tok)
+        n_groups = n_tok // g
+        cap = max(1, int(cfg.capacity_factor * g / e))
+        xg = x.reshape(n_groups, g, dm)
+
+        router = _dense(e, ("embed", "expert"), "router", jnp.float32)
+        probs = jax.nn.softmax(router(xg.astype(jnp.float32)), axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [G, g]
+        expert_gate = jnp.max(probs, axis=-1)
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [G, g, E]
+        # Slot within the chosen expert, per group; -1 for unchosen experts
+        # and overflow tokens — one_hot maps -1 to all-zeros (token dropped).
+        pos = (jnp.cumsum(onehot, axis=1) * onehot - 1.0).astype(jnp.int32)
+        pos = jnp.where(pos < cap, pos, -1)
+        dispatch = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [G, g, E, cap]
+
+        # Load-balancing aux loss (Switch Transformer eq. 4), mean over
+        # groups; sown to the dedicated "losses" collection.
+        frac_tokens = onehot.mean(axis=1)  # [G, E]
+        frac_probs = probs.mean(axis=1)
+        aux = e * jnp.mean(jnp.sum(frac_tokens * frac_probs, -1)) * cfg.aux_loss_coef
+        self.sow("losses", "moe_aux_loss", aux)
+
+        w_in = self.param(
+            "w_in",
+            nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+                ("expert", "embed", "mlp"),
+            ),
+            (e, dm, cfg.d_ff),
+            jnp.float32,
+        ).astype(cfg.dtype)
+        w_out = self.param(
+            "w_out",
+            nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+                ("expert", "mlp", "embed"),
+            ),
+            (e, cfg.d_ff, dm),
+            jnp.float32,
+        ).astype(cfg.dtype)
+
+        xin = jnp.einsum("gnec,gnd->gecd", dispatch.astype(cfg.dtype), xg)
+        hidden = nn.silu(jnp.einsum("gecd,edf->gecf", xin, w_in))
+        xout = jnp.einsum("gecf,efd->gecd", hidden, w_out)
+        combine = dispatch * expert_gate[..., None, None]
+        out = jnp.einsum("gnec,gecd->gnd", combine.astype(cfg.dtype), xout)
+        return out.reshape(b, s, dm)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, self.mesh, name="attn")(
+            RMSNorm(cfg.dtype, name="ln_attn")(x), positions
+        )
+        mlp: nn.Module
+        if cfg.num_experts > 0:
+            mlp = SwitchMoE(cfg, name="moe")
+        else:
+            mlp = SwiGLU(cfg, name="mlp")
+        x = x + mlp(RMSNorm(cfg.dtype, name="ln_mlp")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Embed → N blocks → norm → logits. apply(tokens[, train]) → [B,S,V]."""
+
+    config: TransformerConfig
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        cfg = self.config
+        embed = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        x = embed.astype(cfg.dtype)[tokens]
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+        )
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, self.mesh, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.dtype, name="ln_final")(x)
+        # Tied output head: logits against the embedding matrix, f32.
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x.astype(jnp.float32), embed
+        )
+        return logits

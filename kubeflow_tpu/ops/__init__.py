@@ -1,0 +1,9 @@
+"""Compute ops: attention implementations and (Pallas) kernels.
+
+Every op here has a portable jnp reference implementation (used on CPU test
+meshes and as the correctness oracle) and, where it pays, a TPU-optimized
+path — shard_map collectives for cross-chip ops, Pallas kernels for on-chip
+hot loops.
+"""
+
+from kubeflow_tpu.ops.attention import dense_attention, ring_attention

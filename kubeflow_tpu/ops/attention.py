@@ -1,0 +1,124 @@
+"""Attention: dense reference and ring (sequence-parallel) implementation.
+
+Long context is first-class here where the reference had nothing (SURVEY.md
+§5 "Long-context / sequence parallelism: Absent"). The design is blockwise
+ring attention: the sequence axis is sharded over the mesh's `sp` axis; K/V
+chunks rotate around the sp ring via `ppermute` (nearest-neighbor ICI hops)
+while each device's Q stays put, and softmax is accumulated online
+(flash-attention style running max/sum) so no device ever materializes the
+full [S, S] score matrix or the full K/V.
+
+Memory per device: O(S/n · S/n) scores, O(S/n) K/V — sequence length scales
+linearly with the sp ring size.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.parallel.sharding import batch_axes
+
+
+def dense_attention(q, k, v, *, causal: bool = True):
+    """Reference attention. q,k,v: [B, S, H, D] (or [B,S,G,H,D] grouped)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(q.dtype), v)
+
+
+def _ring_body(q, k, v, *, axis: str, causal: bool):
+    """Per-shard ring attention. q,k,v: local [B, C, H, D] chunks.
+
+    The ring has a static size, so the loop is unrolled at trace time:
+    the step index is static (letting the causal mask specialize per hop)
+    and the final hop skips its rotation — n-1 ppermutes, not n.
+    """
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, c, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my * c + lax.broadcasted_iota(jnp.int32, (c, c), 0)
+
+    o = jnp.zeros((b, c, h, d), jnp.float32)
+    m = jnp.full((b, h, c), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, c), jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(n):
+        src = (my - i) % n  # ring position this K/V chunk originated from
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32)
+        ) * scale
+        if causal:
+            k_pos = src * c + lax.broadcasted_iota(jnp.int32, (c, c), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # Rows with no unmasked key yet keep m=-inf; exp(-inf - -inf) is
+        # nan, so guard the correction factor.
+        corr = jnp.where(m == -jnp.inf, 0.0, jnp.exp(m - m_new))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        o = o * corr.transpose(0, 2, 1)[..., None] + pv
+        m = m_new
+        if i + 1 < n:
+            k_cur = _rotate(k_cur, axis, n)
+            v_cur = _rotate(v_cur, axis, n)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _rotate(x, axis: str, n: int):
+    return lax.ppermute(x, axis, perm=[(i, (i + 1) % n) for i in range(n)])
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    sp_axis: str = "sp",
+    heads_axis: str | None = "tp",
+):
+    """Sequence-parallel attention over `mesh`'s sp ring.
+
+    q,k,v: global [B, S, H, D]; S must divide by the sp ring size, H by the
+    tp size. Falls back to dense attention when the ring is trivial.
+    """
+    if mesh.shape.get(sp_axis, 1) == 1:
+        return dense_attention(q, k, v, causal=causal)
+
+    ring = mesh.shape[sp_axis]
+    if q.shape[1] % ring:
+        raise ValueError(
+            f"ring attention requires the sequence length ({q.shape[1]}) to "
+            f"be divisible by the {sp_axis!r} ring size ({ring})"
+        )
+    bspec = batch_axes(mesh)
+    spec = P(bspec, sp_axis, heads_axis, None)
+    body = functools.partial(_ring_body, axis=sp_axis, causal=causal)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )(q, k, v)

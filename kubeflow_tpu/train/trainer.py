@@ -105,6 +105,8 @@ class Trainer:
         rules: Mapping[str, Any] | None = None,
         example_input_shape: tuple = (2, 224, 224, 3),
         input_key: str = "image",
+        label_key: str = "label",
+        example_input_dtype: Any = jnp.float32,
     ):
         self.model = model
         self.config = config
@@ -115,8 +117,18 @@ class Trainer:
             else shlib.default_rules(fsdp_params=config.fsdp_params)
         )
         self.tx = make_optimizer(config)
-        self.example_input_shape = example_input_shape
+        # The init dummy batch must divide evenly over the mesh batch axes
+        # (model code may shard_map over them, e.g. ring attention).
+        dp_total = 1
+        for a in shlib.batch_axes(mesh):
+            dp_total *= mesh.shape[a]
+        lead = example_input_shape[0]
+        if lead % dp_total:
+            lead = dp_total * max(1, -(-lead // dp_total))
+        self.example_input_shape = (lead, *example_input_shape[1:])
+        self.example_input_dtype = example_input_dtype
         self.input_key = input_key
+        self.label_key = label_key
         self._shardings = None
 
     # -- state construction ------------------------------------------------
@@ -125,7 +137,7 @@ class Trainer:
         """Init keeping flax Partitioned boxes so logical names survive
         through eval_shape into the optimizer state (optax tree_maps rebuild
         the boxes, which is how momentum inherits the param shardings)."""
-        dummy = jnp.zeros(self.example_input_shape, jnp.float32)
+        dummy = jnp.zeros(self.example_input_shape, self.example_input_dtype)
         variables = self.model.init(rng, dummy, train=False)
         params = variables["params"]
         return TrainState(
@@ -164,20 +176,29 @@ class Trainer:
         cfg = self.config
         input_key = self.input_key
 
+        label_key = self.label_key
+
         def train_step(state: TrainState, batch):
             def loss_fn(params):
                 variables = {"params": params}
-                mutable = []
+                # "losses" is the dedicated channel for scalar auxiliary
+                # losses (MoE load balancing etc.) — kept separate from
+                # flax's general-purpose "intermediates" so diagnostics
+                # never leak into the objective.
+                mutable = ["losses"]
                 if state.batch_stats:
                     variables["batch_stats"] = state.batch_stats
-                    mutable = ["batch_stats"]
-                out = state.apply_fn(
+                    mutable.append("batch_stats")
+                logits, new_vars = state.apply_fn(
                     variables, batch[input_key], train=True, mutable=mutable
                 )
-                logits, new_vars = out if mutable else (out, {})
                 loss = softmax_cross_entropy(
-                    logits, batch["label"], cfg.label_smoothing
+                    logits, batch[label_key], cfg.label_smoothing
                 )
+                for aux in jax.tree_util.tree_leaves(
+                    new_vars.get("losses", {})
+                ):
+                    loss = loss + aux
                 return loss, (new_vars, logits)
 
             (loss, (new_vars, logits)), grads = jax.value_and_grad(
@@ -188,7 +209,7 @@ class Trainer:
                 batch_stats=new_vars.get("batch_stats", state.batch_stats),
             )
             accuracy = jnp.mean(
-                (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+                (jnp.argmax(logits, -1) == batch[label_key]).astype(jnp.float32)
             )
             return state, {"loss": loss, "accuracy": accuracy}
 
@@ -199,7 +220,7 @@ class Trainer:
         )
 
     def make_eval_step(self):
-        input_key = self.input_key
+        input_key, label_key = self.input_key, self.label_key
 
         def eval_step(state: TrainState, batch):
             variables = {"params": state.params}
@@ -207,9 +228,9 @@ class Trainer:
                 variables["batch_stats"] = state.batch_stats
             logits = state.apply_fn(variables, batch[input_key], train=False)
             return {
-                "loss": softmax_cross_entropy(logits, batch["label"]),
+                "loss": softmax_cross_entropy(logits, batch[label_key]),
                 "accuracy": jnp.mean(
-                    (jnp.argmax(logits, -1) == batch["label"]).astype(
+                    (jnp.argmax(logits, -1) == batch[label_key]).astype(
                         jnp.float32
                     )
                 ),

@@ -1,0 +1,122 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from kubeflow_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_tpu.train import SyntheticTokens, TrainConfig, Trainer
+
+TINY = TransformerConfig(
+    vocab_size=128,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    head_dim=8,
+    d_ff=64,
+    dtype=jnp.float32,
+    remat=False,
+)
+
+
+def _lm_trainer(mesh, cfg=TINY, batch=8):
+    config = TrainConfig(
+        batch_size=batch,
+        learning_rate=1e-2,
+        warmup_steps=2,
+        total_steps=50,
+        optimizer="adamw",
+        weight_decay=0.0,
+        label_smoothing=0.0,
+    )
+    model = TransformerLM(cfg, mesh=mesh)
+    return Trainer(
+        model,
+        config,
+        mesh,
+        example_input_shape=(2, 16),
+        example_input_dtype=jnp.int32,
+        input_key="tokens",
+        label_key="labels",
+    )
+
+
+def test_forward_shapes():
+    model = TransformerLM(TINY)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(variables, tokens)
+    assert logits.shape == (2, 16, TINY.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality():
+    model = TransformerLM(TINY)
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (1, 16), 0, TINY.vocab_size)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    base = model.apply(variables, tokens)
+    # Changing the last token must not change any earlier logits.
+    mutated = tokens.at[0, -1].set((tokens[0, -1] + 1) % TINY.vocab_size)
+    out = model.apply(variables, mutated)
+    np.testing.assert_allclose(
+        np.asarray(base[0, :-1]), np.asarray(out[0, :-1]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_lm_train_step_tp_sp(devices):
+    # dp=2, sp=2, tp=2: batch, ring attention, and tensor parallel together.
+    mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2), devices)
+    trainer = _lm_trainer(mesh)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    data = SyntheticTokens(mesh, batch_size=8, seq_len=16, vocab_size=TINY.vocab_size)
+    step = trainer.make_train_step()
+    it = iter(data)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, next(it))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_lm_tp_matches_single_device(devices):
+    # The same init must produce the same loss on a tp=2 mesh and a
+    # trivial mesh — partitioning must not change semantics.
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (4, 16), 0, 128)
+
+    def loss_on(mesh_spec, devs):
+        mesh = build_mesh(mesh_spec, devs)
+        trainer = _lm_trainer(mesh, batch=4)
+        state = trainer.init_state(jax.random.PRNGKey(0))
+        model = trainer.model
+        logits = model.apply(
+            {"params": state.params}, jax.device_put(tokens)
+        )
+        return np.asarray(logits)
+
+    dense = loss_on(MeshSpec(), devices[:1])
+    parallel = loss_on(MeshSpec(dp=2, fsdp=1, sp=2, tp=2), devices)
+    np.testing.assert_allclose(dense, parallel, rtol=5e-4, atol=5e-4)
+
+
+def test_moe_train_step(mesh8):
+    cfg = TransformerConfig(
+        vocab_size=64,
+        d_model=32,
+        n_layers=2,
+        n_heads=2,
+        head_dim=8,
+        d_ff=32,
+        dtype=jnp.float32,
+        remat=False,
+        num_experts=4,
+    )
+    trainer = _lm_trainer(mesh8, cfg=cfg)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    data = SyntheticTokens(mesh8, batch_size=8, seq_len=16, vocab_size=64)
+    step = trainer.make_train_step()
+    state, metrics = step(state, next(iter(data)))
+    assert np.isfinite(float(metrics["loss"]))
+    # Expert weights exist with the expert dimension leading.
+    moe_w = state.params["layer_0"]["moe"]["w_in"]
+    assert moe_w.shape[0] == 4
