@@ -8,3 +8,5 @@ studies, and benchmarks all drive one code path.
 
 from kubeflow_tpu.train.trainer import Trainer, TrainConfig, TrainState
 from kubeflow_tpu.train.data import SyntheticImages, SyntheticTokens
+from kubeflow_tpu.train.checkpoint import Checkpointer
+from kubeflow_tpu.train.loop import FitResult, TrainingDiverged, fit

@@ -130,6 +130,7 @@ class Trainer:
         self.input_key = input_key
         self.label_key = label_key
         self._shardings = None
+        self._abstract = None
 
     # -- state construction ------------------------------------------------
 
@@ -149,15 +150,31 @@ class Trainer:
             tx=self.tx,
         )
 
+    def _abstract_boxed(self) -> TrainState:
+        if self._abstract is None:
+            self._abstract = jax.eval_shape(
+                self._init_boxed, jax.random.PRNGKey(0)
+            )
+        return self._abstract
+
     def state_shardings(self) -> TrainState:
         """NamedSharding tree for TrainState, from logical annotations."""
         if self._shardings is None:
-            abstract = jax.eval_shape(self._init_boxed, jax.random.PRNGKey(0))
-            logical = nn.get_partition_spec(abstract)
+            logical = nn.get_partition_spec(self._abstract_boxed())
             self._shardings = nn.logical_to_mesh_sharding(
                 logical, self.mesh, list(self.rules.items())
             )
         return self._shardings
+
+    def abstract_state(self) -> TrainState:
+        """ShapeDtypeStruct pytree with shardings attached — the template
+        for sharded checkpoint restore (each device reads its own shards)."""
+        abstract = nn.meta.unbox(self._abstract_boxed())
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract,
+            self.state_shardings(),
+        )
 
     def init_state(self, rng) -> TrainState:
         shardings = self.state_shardings()
