@@ -1,0 +1,25 @@
+"""The platform's API object model.
+
+K8s-style resources: every object is (apiVersion, kind, metadata, spec,
+status). The CRDs mirror the reference's platform surface (SURVEY.md §2):
+
+- ``TpuJob``     — gang-scheduled TPU training job (replaces TFJob,
+                   `tf-cnn/create_job_specs.py:24-27`, and the
+                   openmpi-controller's MPI sequencing)
+- ``Notebook``   — `notebook-controller/api/v1beta1/notebook_types.go:30-85`
+- ``Profile``    — `profile-controller/api/v1/profile_types.go:36-44`
+- ``Tensorboard``— `tensorboard-controller` v1alpha1 types
+- ``PodDefault`` — `admission-webhook/pkg/apis/settings/v1alpha1`
+
+plus the core kinds controllers reconcile into (Pod, Service, StatefulSet,
+Deployment, Namespace, Event, ...).
+"""
+
+from kubeflow_tpu.api.objects import (
+    GROUP,
+    ObjectMeta,
+    Resource,
+    new_resource,
+    owner_ref,
+)
+from kubeflow_tpu.api.tpujob import TpuJobSpec, make_tpujob

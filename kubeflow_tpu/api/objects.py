@@ -1,0 +1,146 @@
+"""Generic resource model: metadata + free-form spec/status dicts.
+
+Typed helpers (TpuJobSpec etc.) parse/emit the spec dicts; the storage and
+controller layers treat resources uniformly — the same split the reference
+gets from Go structs + unstructured clients.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+import uuid
+from typing import Any
+
+GROUP = "kubeflow-tpu.org"
+VERSION = "v1"
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+    uid: str | None = None
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float | None = None
+    deletion_timestamp: float | None = None
+    finalizers: list[str] = dataclasses.field(default_factory=list)
+    owner_references: list[dict] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "namespace": self.namespace,
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+            "uid": self.uid,
+            "resourceVersion": self.resource_version,
+            "generation": self.generation,
+            "creationTimestamp": self.creation_timestamp,
+            "deletionTimestamp": self.deletion_timestamp,
+            "finalizers": list(self.finalizers),
+            "ownerReferences": copy.deepcopy(self.owner_references),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ObjectMeta":
+        return cls(
+            name=d["name"],
+            namespace=d.get("namespace", "default"),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            uid=d.get("uid"),
+            resource_version=d.get("resourceVersion", 0),
+            generation=d.get("generation", 0),
+            creation_timestamp=d.get("creationTimestamp"),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            finalizers=list(d.get("finalizers") or []),
+            owner_references=copy.deepcopy(d.get("ownerReferences") or []),
+        )
+
+
+@dataclasses.dataclass
+class Resource:
+    kind: str
+    metadata: ObjectMeta
+    spec: dict[str, Any] = dataclasses.field(default_factory=dict)
+    status: dict[str, Any] = dataclasses.field(default_factory=dict)
+    api_version: str = f"{GROUP}/{VERSION}"
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.kind, self.metadata.namespace, self.metadata.name)
+
+    def deepcopy(self) -> "Resource":
+        return Resource(
+            kind=self.kind,
+            metadata=ObjectMeta.from_dict(self.metadata.to_dict()),
+            spec=copy.deepcopy(self.spec),
+            status=copy.deepcopy(self.status),
+            api_version=self.api_version,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": copy.deepcopy(self.spec),
+            "status": copy.deepcopy(self.status),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Resource":
+        return cls(
+            kind=d["kind"],
+            metadata=ObjectMeta.from_dict(d["metadata"]),
+            spec=copy.deepcopy(d.get("spec") or {}),
+            status=copy.deepcopy(d.get("status") or {}),
+            api_version=d.get("apiVersion", f"{GROUP}/{VERSION}"),
+        )
+
+
+def new_resource(
+    kind: str,
+    name: str,
+    namespace: str = "default",
+    *,
+    spec: dict | None = None,
+    labels: dict | None = None,
+    annotations: dict | None = None,
+    api_version: str = f"{GROUP}/{VERSION}",
+) -> Resource:
+    return Resource(
+        kind=kind,
+        metadata=ObjectMeta(
+            name=name,
+            namespace=namespace,
+            labels=dict(labels or {}),
+            annotations=dict(annotations or {}),
+        ),
+        spec=dict(spec or {}),
+        api_version=api_version,
+    )
+
+
+def owner_ref(owner: Resource, *, controller: bool = True) -> dict:
+    """An ownerReference to `owner` — the GC/cascade edge."""
+    return {
+        "apiVersion": owner.api_version,
+        "kind": owner.kind,
+        "name": owner.metadata.name,
+        "uid": owner.metadata.uid,
+        "controller": controller,
+    }
+
+
+def fresh_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def now() -> float:
+    return time.time()

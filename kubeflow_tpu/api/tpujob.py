@@ -1,0 +1,98 @@
+"""TpuJob: the platform's training-job CRD.
+
+The TPU-native successor to TFJob (reference:
+`tf-controller-examples/tf-cnn/create_job_specs.py:24-27` builds TFJob CRs
+with PS/worker replica specs and `nvidia.com/gpu` limits). Differences are
+deliberate (SURVEY.md §2.2 mapping):
+
+- one homogeneous worker gang, not PS/worker roles — SPMD over a mesh needs
+  no parameter servers;
+- TPU resources (`google.com/tpu`) plus a slice *topology* string; gangs are
+  all-or-nothing because a slice is (§7.3);
+- the operator injects the TPUJOB_* env contract (not TF_CONFIG), which
+  `kubeflow_tpu.parallel.distributed.initialize_from_env` consumes;
+- whole-gang restart on any worker failure, bounded by `max_restarts`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from kubeflow_tpu.api.objects import Resource, new_resource
+
+KIND = "TpuJob"
+COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuJobSpec:
+    """Typed view over a TpuJob's spec dict."""
+
+    replicas: int = 1
+    image: str = "kubeflow-tpu/worker:latest"
+    command: tuple[str, ...] = ()
+    args: tuple[str, ...] = ()
+    env: tuple[tuple[str, str], ...] = ()
+    tpu_chips_per_worker: int = 4
+    topology: str = ""  # e.g. "4x4" (v5e-16); empty = single host
+    num_slices: int = 1
+    max_restarts: int = 3
+    checkpoint_dir: str = ""
+
+    def validate(self) -> None:
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.tpu_chips_per_worker < 0:
+            raise ValueError("tpu_chips_per_worker must be >= 0")
+        if self.num_slices < 1 or self.replicas % self.num_slices:
+            raise ValueError(
+                f"num_slices ({self.num_slices}) must divide replicas "
+                f"({self.replicas}) evenly"
+            )
+        if self.max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "replicas": self.replicas,
+            "image": self.image,
+            "command": list(self.command),
+            "args": list(self.args),
+            "env": [{"name": k, "value": v} for k, v in self.env],
+            "tpu": {
+                "chipsPerWorker": self.tpu_chips_per_worker,
+                "topology": self.topology,
+                "numSlices": self.num_slices,
+            },
+            "maxRestarts": self.max_restarts,
+            "checkpointDir": self.checkpoint_dir,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TpuJobSpec":
+        tpu = d.get("tpu") or {}
+        spec = cls(
+            replicas=d.get("replicas", 1),
+            image=d.get("image", "kubeflow-tpu/worker:latest"),
+            command=tuple(d.get("command") or ()),
+            args=tuple(d.get("args") or ()),
+            env=tuple(
+                (e["name"], e["value"]) for e in (d.get("env") or [])
+            ),
+            tpu_chips_per_worker=tpu.get("chipsPerWorker", 4),
+            topology=tpu.get("topology", ""),
+            num_slices=tpu.get("numSlices", 1),
+            max_restarts=d.get("maxRestarts", 3),
+            checkpoint_dir=d.get("checkpointDir", ""),
+        )
+        spec.validate()
+        return spec
+
+
+def make_tpujob(
+    name: str, namespace: str = "default", **spec_kwargs
+) -> Resource:
+    spec = TpuJobSpec(**spec_kwargs)
+    spec.validate()
+    return new_resource(KIND, name, namespace, spec=spec.to_dict())

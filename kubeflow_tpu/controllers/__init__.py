@@ -1,0 +1,15 @@
+"""Controllers: reconcilers for the platform CRDs.
+
+The Python mirror of the reference's Go controller tier (SURVEY.md §2
+items 1-11), built on a shared reconcile runtime (`runtime.py`, the
+`common/reconcilehelper` equivalent). The performance-critical scheduling
+core (gang/topology placement) lives in the native C++ tier under
+``native/`` and is consumed through ctypes.
+"""
+
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    ControllerManager,
+    Result,
+)
+from kubeflow_tpu.controllers.tpujob import TpuJobController

@@ -1,0 +1,1 @@
+from kubeflow_tpu.launcher.launcher import main, run_and_stream

@@ -1,0 +1,7 @@
+import logging
+import sys
+
+from kubeflow_tpu.launcher.launcher import main
+
+logging.basicConfig(level=logging.INFO)
+sys.exit(main())

@@ -1,0 +1,81 @@
+"""Worker-pod launcher.
+
+Functional parity with the reference's tf-cnn launcher
+(`tf-controller-examples/tf-cnn/launcher.py`): that script parsed the
+operator-injected TF_CONFIG into parameter-server CLI flags (:68-88) and
+streamed the wrapped process's output (:31). Here the operator injects
+TPUJOB_* (already the exact shape `jax.distributed.initialize` wants), so
+the launcher's job is: validate the gang env, export it, and exec/stream
+the user command — or, with ``--module``, initialize JAX distributed
+in-process and call a python entrypoint directly.
+
+Usage (the TpuJob operator sets this as the container command):
+
+    python -m kubeflow_tpu.launcher -- python train.py --flags...
+    python -m kubeflow_tpu.launcher --module mypkg.train:main
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import logging
+import subprocess
+import sys
+
+from kubeflow_tpu.parallel import distributed as dist
+
+log = logging.getLogger(__name__)
+
+
+def run_and_stream(cmd: list[str]) -> int:
+    """Run `cmd`, streaming combined output line-by-line to our stdout
+    (reference `launcher.py:31` run_and_stream)."""
+    log.info("launching: %s", " ".join(cmd))
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
+    )
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        sys.stdout.write(line)
+        sys.stdout.flush()
+    return proc.wait()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu-launcher")
+    parser.add_argument(
+        "--module",
+        help="python entrypoint 'pkg.mod:fn' to call in-process after "
+        "jax.distributed init (instead of exec-ing a command)",
+    )
+    parser.add_argument(
+        "cmd", nargs="*", help="command to run (after --)"
+    )
+    args = parser.parse_args(argv)
+
+    pe = dist.ProcessEnv.from_env()
+    log.info(
+        "gang member %d/%d (slice %d/%d) coordinator=%s",
+        pe.process_id, pe.num_processes, pe.slice_id, pe.num_slices,
+        pe.coordinator,
+    )
+
+    if args.module:
+        dist.initialize_from_env()
+        mod_name, _, fn_name = args.module.partition(":")
+        mod = importlib.import_module(mod_name)
+        fn = getattr(mod, fn_name or "main")
+        result = fn()
+        return int(result or 0)
+
+    if not args.cmd:
+        parser.error("either --module or a command is required")
+    # The child inherits the TPUJOB_* env as-is; it calls
+    # initialize_from_env itself (same contract as TF_CONFIG pass-through).
+    return run_and_stream(args.cmd)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from kubeflow_tpu.runtime.local import LocalPodRunner

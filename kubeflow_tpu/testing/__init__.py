@@ -1,0 +1,15 @@
+"""Test infrastructure: the in-process fake API server and fixtures.
+
+The reference had no in-process cluster simulacrum beyond envtest
+(SURVEY.md §4.3) and tested everything against real GKE. This package is
+the fixture it lacked: controllers and web backends run against
+`FakeApiServer` with real optimistic-concurrency, finalizer, and
+owner-reference semantics — deterministic, no cluster.
+"""
+
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    Conflict,
+    FakeApiServer,
+    NotFound,
+)

@@ -1,0 +1,253 @@
+"""An in-process API server with K8s storage semantics.
+
+Implements the parts of the K8s resource model that controller correctness
+depends on (the reference leaned on envtest for exactly this,
+`profile-controller/controllers/suite_test.go:29-54`):
+
+- optimistic concurrency (resourceVersion conflict on stale writes)
+- spec/status as separate update surfaces
+- label selectors on list
+- watch events (ADDED/MODIFIED/DELETED) delivered to subscribers
+- finalizers: delete marks deletionTimestamp; removal happens when the
+  last finalizer is cleared
+- owner references: cascading delete of dependents
+
+Thread-safe; watch delivery is synchronous (deterministic tests).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid, now
+
+WatchHandler = Callable[[str, Resource], None]  # (event_type, obj)
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFound(ApiError):
+    pass
+
+
+class AlreadyExists(ApiError):
+    pass
+
+
+class Conflict(ApiError):
+    pass
+
+
+def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class FakeApiServer:
+    def __init__(self):
+        self._objects: dict[tuple[str, str, str], Resource] = {}
+        self._rv = 0
+        self._lock = threading.RLock()
+        self._watchers: list[tuple[str | None, WatchHandler]] = []
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, handler: WatchHandler, kind: str | None = None) -> None:
+        """Subscribe to events; kind=None receives everything."""
+        with self._lock:
+            self._watchers.append((kind, handler))
+
+    def _emit(self, event: str, obj: Resource) -> None:
+        for kind, handler in list(self._watchers):
+            if kind is None or kind == obj.kind:
+                handler(event, obj.deepcopy())
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj: Resource) -> Resource:
+        with self._lock:
+            key = obj.key
+            if key in self._objects:
+                raise AlreadyExists(f"{key} already exists")
+            stored = obj.deepcopy()
+            self._rv += 1
+            stored.metadata.uid = fresh_uid()
+            stored.metadata.resource_version = self._rv
+            stored.metadata.generation = 1
+            stored.metadata.creation_timestamp = now()
+            self._objects[key] = stored
+            out = stored.deepcopy()
+        self._emit("ADDED", stored)
+        return out
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return obj.deepcopy()
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[Resource]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in sorted(self._objects.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not _matches(
+                    obj.metadata.labels, label_selector
+                ):
+                    continue
+                out.append(obj.deepcopy())
+            return out
+
+    def _update(self, obj: Resource, *, status_only: bool) -> Resource:
+        with self._lock:
+            key = obj.key
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFound(f"{key} not found")
+            if (
+                obj.metadata.resource_version
+                and obj.metadata.resource_version
+                != current.metadata.resource_version
+            ):
+                raise Conflict(
+                    f"{key}: stale resourceVersion "
+                    f"{obj.metadata.resource_version} != "
+                    f"{current.metadata.resource_version}"
+                )
+            stored = current.deepcopy()
+            if status_only:
+                stored.status = Resource.from_dict(obj.to_dict()).status
+            else:
+                incoming = Resource.from_dict(obj.to_dict())
+                if incoming.spec != stored.spec:
+                    stored.metadata.generation += 1
+                stored.spec = incoming.spec
+                stored.metadata.labels = incoming.metadata.labels
+                stored.metadata.annotations = incoming.metadata.annotations
+                stored.metadata.finalizers = incoming.metadata.finalizers
+                stored.metadata.owner_references = (
+                    incoming.metadata.owner_references
+                )
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            self._objects[key] = stored
+            deleted = self._maybe_finalize(stored)
+            out = stored.deepcopy()
+        if deleted:
+            self._emit("DELETED", stored)
+        else:
+            self._emit("MODIFIED", stored)
+        return out
+
+    def update(self, obj: Resource) -> Resource:
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: Resource) -> Resource:
+        return self._update(obj, status_only=True)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            obj = self._objects.get(key)
+            if obj is None:
+                raise NotFound(f"{key} not found")
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = now()
+                    self._rv += 1
+                    obj.metadata.resource_version = self._rv
+                    self._emit("MODIFIED", obj)
+                return
+            self._remove(key)
+
+    def _maybe_finalize(self, stored: Resource) -> bool:
+        """Remove an object whose deletion was pending and whose last
+        finalizer was just cleared. Returns True if removed."""
+        if (
+            stored.metadata.deletion_timestamp is not None
+            and not stored.metadata.finalizers
+        ):
+            self._remove(stored.key, emit_delete=False)
+            return True
+        return False
+
+    def _remove(self, key: tuple, *, emit_delete: bool = True) -> None:
+        obj = self._objects.pop(key)
+        if emit_delete:
+            self._emit("DELETED", obj)
+        self._cascade(obj)
+
+    def _cascade(self, owner: Resource) -> None:
+        """Delete dependents whose controller ownerReference matches."""
+        uid = owner.metadata.uid
+        dependents = [
+            o.key
+            for o in list(self._objects.values())
+            if any(
+                ref.get("uid") == uid
+                for ref in o.metadata.owner_references
+            )
+        ]
+        for key in dependents:
+            if key in self._objects:
+                kind, ns, name = key
+                try:
+                    self.delete(kind, name, ns)
+                except NotFound:
+                    pass
+
+    # -- conveniences ------------------------------------------------------
+
+    def apply(self, obj: Resource) -> Resource:
+        """Create-or-update by (kind, ns, name) — the reconcilehelper
+        pattern (`components/common/reconcilehelper/util.go:18-105`)."""
+        try:
+            current = self.get(obj.kind, obj.metadata.name, obj.metadata.namespace)
+        except NotFound:
+            return self.create(obj)
+        merged = obj.deepcopy()
+        merged.metadata.resource_version = current.metadata.resource_version
+        merged.metadata.uid = current.metadata.uid
+        return self.update(merged)
+
+    def record_event(
+        self,
+        about: Resource,
+        reason: str,
+        message: str,
+        *,
+        type_: str = "Normal",
+    ) -> Resource:
+        """Emit a K8s-style Event object (the reference mirrors these onto
+        CR statuses, `notebook_controller.go:87-103`)."""
+        name = f"{about.metadata.name}.{fresh_uid()[:8]}"
+        ev = Resource(
+            kind="Event",
+            metadata=ObjectMeta(
+                name=name, namespace=about.metadata.namespace
+            ),
+            spec={},
+            status={},
+        )
+        ev.spec = {
+            "involvedObject": {
+                "kind": about.kind,
+                "name": about.metadata.name,
+                "uid": about.metadata.uid,
+            },
+            "reason": reason,
+            "message": message,
+            "type": type_,
+        }
+        return self.create(ev)

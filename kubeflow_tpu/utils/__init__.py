@@ -1,0 +1,3 @@
+"""Shared utilities: metrics registry, structured logging helpers."""
+
+from kubeflow_tpu.utils.metrics import Counter, Gauge, MetricsRegistry

@@ -1,0 +1,49 @@
+"""Worker script for the E2E gang test: joins the gang via the TPUJOB_*
+contract, runs a cross-process psum on a dp mesh, verifies it, exits 0.
+
+(The payload of SURVEY.md §7.2's minimum slice, shrunk to a collective —
+ResNet training through the same path is covered on-mesh elsewhere.)
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Don't inherit the parent test harness's virtual-device flags: each gang
+# member is one process with its own (single) local device.
+os.environ["XLA_FLAGS"] = ""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel import MeshSpec, build_mesh, initialize_from_env
+
+
+def main() -> int:
+    pe = initialize_from_env()
+    assert jax.process_count() == pe.num_processes, (
+        jax.process_count(), pe.num_processes,
+    )
+    mesh = build_mesh(MeshSpec(dp=-1))
+    arr = jax.make_array_from_callback(
+        (jax.device_count(),),
+        NamedSharding(mesh, P("dp")),
+        lambda idx: jnp.ones((1,)) * (pe.process_id + 1),
+    )
+    total = float(
+        jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
+    )
+    expected = sum(range(1, pe.num_processes + 1))
+    assert total == expected, (total, expected)
+    print(f"rank {pe.process_id}: psum ok ({total})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""The minimum end-to-end slice (SURVEY.md §7.2), fully in-process:
+
+TpuJob CR → operator creates the gang + env contract → local runner execs
+N real JAX processes → gloo collectives across them → pod phases flow back
+→ operator marks the job Succeeded.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api import make_tpujob
+from kubeflow_tpu.api.tpujob import KIND
+from kubeflow_tpu.controllers.tpujob import TpuJobController
+from kubeflow_tpu.runtime import LocalPodRunner
+from kubeflow_tpu.testing import FakeApiServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+WORKER = os.path.join(REPO, "tests", "e2e", "gang_worker.py")
+
+
+def test_tpujob_gang_end_to_end(tmp_path):
+    api = FakeApiServer()
+    ctl = TpuJobController(api)
+    runner = LocalPodRunner(
+        api,
+        extra_env={"KFTPU_REPO": REPO},
+        capture_dir=str(tmp_path / "logs"),
+    )
+
+    api.create(
+        make_tpujob(
+            "e2e",
+            replicas=2,
+            tpu_chips_per_worker=0,  # CPU gang
+            command=(sys.executable, WORKER),
+        )
+    )
+
+    deadline = time.time() + 150
+    try:
+        while time.time() < deadline:
+            ctl.controller.run_until_idle()
+            runner.step()
+            phase = api.get(KIND, "e2e").status.get("phase")
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+    finally:
+        runner.shutdown()
+
+    logs = {
+        p.name: p.read_text() for p in (tmp_path / "logs").glob("*.log")
+    }
+    assert api.get(KIND, "e2e").status.get("phase") == "Succeeded", logs
+    assert "psum ok" in logs.get("e2e-worker-0.log", ""), logs
+    assert "psum ok" in logs.get("e2e-worker-1.log", ""), logs

@@ -1,0 +1,107 @@
+import pytest
+
+from kubeflow_tpu.api import new_resource, owner_ref
+from kubeflow_tpu.testing import AlreadyExists, Conflict, FakeApiServer, NotFound
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+def test_create_get_roundtrip(api):
+    obj = new_resource("Notebook", "nb1", "user1", spec={"image": "x"})
+    created = api.create(obj)
+    assert created.metadata.uid and created.metadata.resource_version > 0
+    got = api.get("Notebook", "nb1", "user1")
+    assert got.spec == {"image": "x"}
+
+
+def test_create_duplicate_rejected(api):
+    api.create(new_resource("Pod", "p", "ns"))
+    with pytest.raises(AlreadyExists):
+        api.create(new_resource("Pod", "p", "ns"))
+
+
+def test_stale_update_conflicts(api):
+    api.create(new_resource("Pod", "p"))
+    a = api.get("Pod", "p")
+    b = api.get("Pod", "p")
+    a.spec["x"] = 1
+    api.update(a)
+    b.spec["x"] = 2
+    with pytest.raises(Conflict):
+        api.update(b)
+
+
+def test_update_status_does_not_touch_spec(api):
+    api.create(new_resource("Pod", "p", spec={"a": 1}))
+    obj = api.get("Pod", "p")
+    obj.spec["a"] = 99
+    obj.status["phase"] = "Running"
+    api.update_status(obj)
+    fresh = api.get("Pod", "p")
+    assert fresh.spec == {"a": 1}
+    assert fresh.status == {"phase": "Running"}
+
+
+def test_generation_bumps_only_on_spec_change(api):
+    api.create(new_resource("Pod", "p", spec={"a": 1}))
+    obj = api.get("Pod", "p")
+    obj.metadata.labels["l"] = "v"
+    updated = api.update(obj)
+    assert updated.metadata.generation == 1
+    updated.spec["a"] = 2
+    assert api.update(updated).metadata.generation == 2
+
+
+def test_label_selector(api):
+    api.create(new_resource("Pod", "a", labels={"job": "j1"}))
+    api.create(new_resource("Pod", "b", labels={"job": "j2"}))
+    assert [p.metadata.name for p in api.list("Pod", label_selector={"job": "j1"})] == ["a"]
+
+
+def test_watch_events(api):
+    events = []
+    api.watch(lambda e, o: events.append((e, o.metadata.name)), "Pod")
+    api.create(new_resource("Pod", "p"))
+    api.create(new_resource("Service", "s"))  # different kind: not seen
+    obj = api.get("Pod", "p")
+    obj.spec["x"] = 1
+    api.update(obj)
+    api.delete("Pod", "p")
+    assert events == [("ADDED", "p"), ("MODIFIED", "p"), ("DELETED", "p")]
+
+
+def test_finalizers_defer_deletion(api):
+    obj = new_resource("Profile", "u1")
+    obj.metadata.finalizers = ["cleanup"]
+    api.create(obj)
+    api.delete("Profile", "u1")
+    pending = api.get("Profile", "u1")  # still there
+    assert pending.metadata.deletion_timestamp is not None
+    pending.metadata.finalizers = []
+    api.update(pending)
+    with pytest.raises(NotFound):
+        api.get("Profile", "u1")
+
+
+def test_owner_cascade(api):
+    parent = api.create(new_resource("TpuJob", "job"))
+    child = new_resource("Pod", "job-worker-0")
+    child.metadata.owner_references = [owner_ref(parent)]
+    api.create(child)
+    grand = new_resource("ConfigMap", "cm")
+    grand.metadata.owner_references = [owner_ref(api.get("Pod", "job-worker-0"))]
+    api.create(grand)
+    api.delete("TpuJob", "job")
+    with pytest.raises(NotFound):
+        api.get("Pod", "job-worker-0")
+    with pytest.raises(NotFound):
+        api.get("ConfigMap", "cm")
+
+
+def test_apply_create_or_update(api):
+    api.apply(new_resource("Service", "s", spec={"p": 1}))
+    api.apply(new_resource("Service", "s", spec={"p": 2}))
+    assert api.get("Service", "s").spec == {"p": 2}

@@ -1,0 +1,144 @@
+import pytest
+
+from kubeflow_tpu.api import make_tpujob
+from kubeflow_tpu.api.tpujob import KIND, TpuJobSpec
+from kubeflow_tpu.controllers.tpujob import (
+    LABEL_JOB,
+    TpuJobController,
+    worker_name,
+)
+from kubeflow_tpu.testing import FakeApiServer, NotFound
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+@pytest.fixture
+def ctl(api):
+    return TpuJobController(api)
+
+
+def _drain(ctl):
+    ctl.controller.run_until_idle()
+
+
+def _set_pod_phase(api, name, phase, ns="default"):
+    pod = api.get("Pod", name, ns)
+    pod.status["phase"] = phase
+    api.update_status(pod)
+
+
+def _all_pods_phase(api, job, phase, n, ns="default"):
+    for i in range(n):
+        _set_pod_phase(api, worker_name(job, i), phase, ns)
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        TpuJobSpec(replicas=0).validate()
+    with pytest.raises(ValueError):
+        TpuJobSpec(replicas=4, num_slices=3).validate()
+
+
+def test_gang_creation_and_env(api, ctl):
+    api.create(make_tpujob("mnist", replicas=4, tpu_chips_per_worker=4,
+                           topology="4x4", num_slices=2))
+    _drain(ctl)
+
+    pods = api.list("Pod", label_selector={LABEL_JOB: "mnist"})
+    assert len(pods) == 4
+    svc = api.get("Service", "mnist")
+    assert svc.spec["clusterIP"] == "None"
+
+    env = {
+        e["name"]: e["value"]
+        for e in api.get("Pod", "mnist-worker-2").spec["containers"][0]["env"]
+    }
+    assert env["TPUJOB_NUM_PROCESSES"] == "4"
+    assert env["TPUJOB_PROCESS_ID"] == "2"
+    assert env["TPUJOB_NUM_SLICES"] == "2"
+    assert env["TPUJOB_SLICE_ID"] == "1"  # workers 2,3 are slice 1
+    assert env["TPU_WORKER_ID"] == "0"
+    assert "mnist-worker-2.mnist.default.svc" in env["TPU_WORKER_HOSTNAMES"]
+    assert env["TPUJOB_COORDINATOR"].startswith("mnist-worker-0.mnist")
+    limits = api.get("Pod", "mnist-worker-2").spec["containers"][0][
+        "resources"]["limits"]
+    assert limits["google.com/tpu"] == 4
+    sel = api.get("Pod", "mnist-worker-2").spec["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+
+    assert api.get(KIND, "mnist").status["phase"] == "Pending"
+
+
+def test_running_then_succeeded(api, ctl):
+    api.create(make_tpujob("j", replicas=2))
+    _drain(ctl)
+    _all_pods_phase(api, "j", "Running", 2)
+    _drain(ctl)
+    assert api.get(KIND, "j").status["phase"] == "Running"
+    assert ctl.jobs_running.value() == 1
+
+    _all_pods_phase(api, "j", "Succeeded", 2)
+    _drain(ctl)
+    status = api.get(KIND, "j").status
+    assert status["phase"] == "Succeeded"
+    assert ctl.jobs_running.value() == 0
+    # Terminal: pods are left for log inspection, status frozen.
+    types = [c["type"] for c in status["conditions"]]
+    assert types == ["Pending", "Running", "Succeeded"]
+
+
+def test_whole_gang_restart_on_single_failure(api, ctl):
+    api.create(make_tpujob("j", replicas=4, max_restarts=2))
+    _drain(ctl)
+    _all_pods_phase(api, "j", "Running", 4)
+    _drain(ctl)
+
+    _set_pod_phase(api, worker_name("j", 1), "Failed")
+    _drain(ctl)
+    job = api.get(KIND, "j")
+    assert job.status["restarts"] == 1
+    # Gang fully recreated: all four pods exist and are fresh (Pending).
+    pods = api.list("Pod", label_selector={LABEL_JOB: "j"})
+    assert len(pods) == 4
+    assert all(p.status.get("phase") is None for p in pods)
+    assert ctl.gang_restarts.value(job="default/j") == 1
+
+
+def test_fails_after_max_restarts(api, ctl):
+    api.create(make_tpujob("j", replicas=2, max_restarts=1))
+    _drain(ctl)
+    _set_pod_phase(api, worker_name("j", 0), "Failed")
+    _drain(ctl)
+    assert api.get(KIND, "j").status["restarts"] == 1
+
+    _set_pod_phase(api, worker_name("j", 0), "Failed")
+    _drain(ctl)
+    assert api.get(KIND, "j").status["phase"] == "Failed"
+    # Terminal state: another pod event must not resurrect the job.
+    _set_pod_phase(api, worker_name("j", 1), "Failed")
+    _drain(ctl)
+    assert api.get(KIND, "j").status["phase"] == "Failed"
+
+
+def test_partial_gang_torn_down(api, ctl):
+    api.create(make_tpujob("j", replicas=3))
+    _drain(ctl)
+    api.delete("Pod", worker_name("j", 1))
+    _drain(ctl)
+    # all-or-nothing: the survivor pods were deleted and a fresh full gang
+    # was created by the follow-up reconcile.
+    pods = api.list("Pod", label_selector={LABEL_JOB: "j"})
+    assert len(pods) == 3
+
+
+def test_job_delete_cascades_to_pods(api, ctl):
+    api.create(make_tpujob("j", replicas=2))
+    _drain(ctl)
+    api.delete(KIND, "j")
+    _drain(ctl)
+    assert api.list("Pod", label_selector={LABEL_JOB: "j"}) == []
+    with pytest.raises(NotFound):
+        api.get("Service", "j")
