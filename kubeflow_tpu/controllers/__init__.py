@@ -12,4 +12,7 @@ from kubeflow_tpu.controllers.runtime import (
     ControllerManager,
     Result,
 )
+from kubeflow_tpu.controllers.notebook import NotebookController
+from kubeflow_tpu.controllers.profile import ProfileController
+from kubeflow_tpu.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controllers.tpujob import TpuJobController

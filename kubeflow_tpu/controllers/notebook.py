@@ -1,0 +1,255 @@
+"""Notebook controller: Notebook CR → StatefulSet + Service + VirtualService.
+
+Parity with the reference's most-exercised path (SURVEY.md §3.2,
+`notebook-controller/controllers/notebook_controller.go`):
+
+- `generateStatefulSet` (:279): one-replica STS — or zero when the
+  stop annotation is present (:279-283);
+- `generateService` (:346): port 80 → 8888, Istio-friendly naming;
+- `generateVirtualService` (:379): `/notebook/<ns>/<name>/` routing,
+  gated on USE_ISTIO (:180) — here always on, as a plain Resource;
+- pod state mirrored onto CR status/conditions (:197-228);
+- culling via periodic requeue (:230-248) with the idle probe from
+  `pkg/culler/culler.go:138-191`.
+
+Notebooks here default to the JAX-on-TPU image (the reference's
+`tensorflow-notebook-image` matrix becomes a jax[tpu] image — §2 item 21),
+and culling is a cost feature: an idle notebook may be holding TPU chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable
+
+from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.controllers.runtime import Controller, Key, Result
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+KIND = "Notebook"
+STOP_ANNOTATION = "kubeflow-resource-stopped"  # culler.go:37
+DEFAULT_IMAGE = "kubeflow-tpu/jax-notebook:latest"
+DEFAULT_PORT = 8888
+
+
+@dataclasses.dataclass(frozen=True)
+class CullerConfig:
+    """Env-knob parity with culler.go:24-27."""
+
+    enabled: bool = False
+    idle_seconds: float = 3600.0
+    check_period_seconds: float = 60.0
+
+
+# Probe returns the notebook's last-activity timestamp (epoch seconds) or
+# None if unreachable. The default HTTP probe hits Jupyter's
+# /api/status `last_activity` (culler.go:138-143); tests inject fakes.
+ActivityProbe = Callable[[Resource], float | None]
+
+
+def _never_active(_nb: Resource) -> float | None:
+    return None
+
+
+class NotebookController:
+    def __init__(
+        self,
+        api: FakeApiServer,
+        *,
+        culler: CullerConfig | None = None,
+        activity_probe: ActivityProbe = _never_active,
+        metrics: MetricsRegistry | None = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.api = api
+        self.culler = culler or CullerConfig()
+        self.probe = activity_probe
+        self.clock = clock
+        metrics = metrics or MetricsRegistry()
+        # Metric parity with pkg/metrics/metrics.go:22-99.
+        self.running = metrics.gauge(
+            "notebook_running", "notebooks with a running workload"
+        )
+        self.created_total = metrics.counter(
+            "notebook_create_total", "notebooks created"
+        )
+        self.culled_total = metrics.counter(
+            "notebook_culled_total", "notebooks culled for idleness"
+        )
+        self.controller = Controller(
+            api,
+            KIND,
+            self.reconcile,
+            owns=("StatefulSet", "Service", "VirtualService"),
+            name="notebook-controller",
+            metrics=metrics,
+        )
+        api.watch(self._count_created, KIND)
+        # Workload pods are created by the StatefulSet machinery, not by us,
+        # so they carry no ownerReference to the Notebook — map them back by
+        # label (SetupWithManager's pod watch, notebook_controller.go:516).
+        api.watch(self._on_pod, "Pod")
+
+    def _count_created(self, event: str, obj: Resource) -> None:
+        if event == "ADDED":
+            self.created_total.inc()
+
+    def _on_pod(self, event: str, pod: Resource) -> None:
+        name = pod.metadata.labels.get("notebook")
+        if name:
+            self.controller.enqueue((pod.metadata.namespace, name))
+
+    # -- desired children --------------------------------------------------
+
+    def _desired_sts(self, nb: Resource) -> Resource:
+        stopped = STOP_ANNOTATION in nb.metadata.annotations
+        container = {
+            "name": "notebook",
+            "image": nb.spec.get("image", DEFAULT_IMAGE),
+            "env": [
+                # NB_PREFIX parity (tensorflow-notebook-image start.sh).
+                {
+                    "name": "NB_PREFIX",
+                    "value": route_prefix(nb),
+                }
+            ],
+            "ports": [{"containerPort": DEFAULT_PORT}],
+            "resources": nb.spec.get("resources", {}),
+        }
+        sts = new_resource(
+            "StatefulSet",
+            nb.metadata.name,
+            nb.metadata.namespace,
+            spec={
+                "replicas": 0 if stopped else 1,
+                "selector": {"matchLabels": {"notebook": nb.metadata.name}},
+                "template": {
+                    "metadata": {"labels": {"notebook": nb.metadata.name}},
+                    "spec": {"containers": [container]},
+                },
+            },
+            labels={"notebook": nb.metadata.name},
+        )
+        sts.metadata.owner_references = [owner_ref(nb)]
+        return sts
+
+    def _desired_service(self, nb: Resource) -> Resource:
+        svc = new_resource(
+            "Service",
+            nb.metadata.name,
+            nb.metadata.namespace,
+            spec={
+                "selector": {"notebook": nb.metadata.name},
+                "ports": [{"port": 80, "targetPort": DEFAULT_PORT}],
+            },
+        )
+        svc.metadata.owner_references = [owner_ref(nb)]
+        return svc
+
+    def _desired_vs(self, nb: Resource) -> Resource:
+        # Trailing slash (notebook_controller.go:383): without it the
+        # prefix for "train" also captures "train2"'s routes.
+        prefix = route_prefix(nb) + "/"
+        vs = new_resource(
+            "VirtualService",
+            f"notebook-{nb.metadata.namespace}-{nb.metadata.name}",
+            nb.metadata.namespace,
+            spec={
+                "gateways": ["kubeflow/kubeflow-gateway"],
+                "hosts": ["*"],
+                "http": [
+                    {
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": prefix},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{nb.metadata.name}."
+                                    f"{nb.metadata.namespace}.svc",
+                                    "port": {"number": 80},
+                                }
+                            }
+                        ],
+                    }
+                ],
+            },
+        )
+        vs.metadata.owner_references = [owner_ref(nb)]
+        return vs
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, api: FakeApiServer, key: Key) -> Result:
+        ns, name = key
+        try:
+            nb = api.get(KIND, name, ns)
+        except NotFound:
+            self._census(api)
+            return Result()
+        if nb.metadata.deletion_timestamp is not None:
+            return Result()
+
+        api.apply(self._desired_sts(nb))
+        api.apply(self._desired_service(nb))
+        api.apply(self._desired_vs(nb))
+
+        # Mirror workload state to status (controller.go:197-228): ready iff
+        # the pod reports Running and not stop-annotated.
+        stopped = STOP_ANNOTATION in nb.metadata.annotations
+        pods = api.list("Pod", ns, label_selector={"notebook": name})
+        pod_phase = pods[0].status.get("phase") if pods else None
+        new_status = dict(nb.status)
+        new_status["readyReplicas"] = 1 if pod_phase == "Running" else 0
+        new_status["containerState"] = (
+            "Waiting" if (not stopped and pod_phase != "Running") else
+            ("Terminated" if stopped else "Running")
+        )
+        if new_status != nb.status:
+            nb.status = new_status
+            api.update_status(nb)
+
+        result = Result()
+        if self.culler.enabled and not stopped:
+            # Only probe a notebook that is actually serving — a pending or
+            # restarting one has no activity yet and must not be culled.
+            if pod_phase == "Running":
+                self._maybe_cull(api, nb)
+            result = Result(requeue_after=self.culler.check_period_seconds)
+        self._census(api)
+        return result
+
+    def _maybe_cull(self, api: FakeApiServer, nb: Resource) -> None:
+        """culler.go:171-191: idle iff last activity older than IDLE_TIME.
+        Unreachable probe => not culled (fail-safe, as upstream)."""
+        last = self.probe(nb)
+        if last is None:
+            return
+        if self.clock() - last < self.culler.idle_seconds:
+            return
+        fresh = api.get(KIND, nb.metadata.name, nb.metadata.namespace)
+        if STOP_ANNOTATION in fresh.metadata.annotations:
+            return
+        fresh.metadata.annotations[STOP_ANNOTATION] = str(self.clock())
+        api.update(fresh)
+        api.record_event(
+            fresh, "Culled", "notebook idle; scaling to zero", type_="Normal"
+        )
+        self.culled_total.inc()
+
+    def _census(self, api: FakeApiServer) -> None:
+        self.running.set(
+            sum(
+                1
+                for nb in api.list(KIND)
+                if nb.status.get("readyReplicas", 0) > 0
+            )
+        )
+
+
+def route_prefix(nb: Resource) -> str:
+    return f"/notebook/{nb.metadata.namespace}/{nb.metadata.name}"

@@ -1,0 +1,184 @@
+"""Profile controller: user → namespace multi-tenancy.
+
+Parity with `profile-controller/controllers/profile_controller.go:100-307`
+(SURVEY.md §3.4): a Profile CR owns a Namespace and the identity scaffolding
+inside it —
+
+- Namespace with istio-injection + owner annotation (:122-161), refusing to
+  take over a namespace it does not own (:168-186);
+- `default-editor` / `default-viewer` ServiceAccounts (:199-212);
+- namespaceAdmin RoleBinding for the owner (:218-239);
+- ResourceQuota when spec'd (:241-256) — with `google.com/tpu` quota as a
+  first-class key (idle TPU chips are the platform's dominant cost);
+- a plugin seam (`Plugin` interface :74-80; GCP workload identity / AWS IAM
+  in the reference) with finalizer-driven revoke on delete (:272-307).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.controllers.runtime import Controller, Key, Result
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+KIND = "Profile"
+OWNER_ANNOTATION = "owner"
+FINALIZER = "profile-finalizer.kubeflow-tpu.org"
+EDITOR_SA = "default-editor"
+VIEWER_SA = "default-viewer"
+
+
+class Plugin(Protocol):
+    """Cloud-credential plumbing seam (plugin_workload_identity.go:44,
+    plugin_iam.go:32)."""
+
+    name: str
+
+    def apply(self, api: FakeApiServer, profile: Resource) -> None: ...
+
+    def revoke(self, api: FakeApiServer, profile: Resource) -> None: ...
+
+
+class ProfileController:
+    def __init__(
+        self,
+        api: FakeApiServer,
+        plugins: dict[str, Plugin] | None = None,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.api = api
+        self.plugins = dict(plugins or {})
+        metrics = metrics or MetricsRegistry()
+        # monitoring.go:27-43 parity.
+        self.requests = metrics.counter("profile_request_kf", "reconciles")
+        self.failures = metrics.counter(
+            "profile_request_kf_failure", "failed reconciles", ("severity",)
+        )
+        self.controller = Controller(
+            api,
+            KIND,
+            self.reconcile,
+            owns=("Namespace",),
+            name="profile-controller",
+            metrics=metrics,
+        )
+
+    def reconcile(self, api: FakeApiServer, key: Key) -> Result:
+        obj_ns, name = key
+        self.requests.inc()
+        try:
+            profile = api.get(KIND, name, obj_ns)
+        except NotFound:
+            return Result()
+
+        if profile.metadata.deletion_timestamp is not None:
+            return self._finalize(api, profile)
+
+        if FINALIZER not in profile.metadata.finalizers:
+            profile.metadata.finalizers.append(FINALIZER)
+            profile = api.update(profile)
+
+        owner = profile.spec.get("owner", {})
+        owner_name = owner.get("name", "")
+
+        # Namespace: create owned, or verify ownership (no takeovers).
+        ns_name = name
+        try:
+            ns = api.get("Namespace", ns_name, "")
+            existing_owner = ns.metadata.annotations.get(OWNER_ANNOTATION)
+            if OWNER_ANNOTATION not in ns.metadata.annotations or (
+                existing_owner != owner_name
+            ):
+                self.failures.inc(severity="takeover")
+                api.record_event(
+                    profile,
+                    "NamespaceConflict",
+                    f"namespace {ns_name} exists and is not owned by "
+                    f"{owner_name!r}",
+                    type_="Warning",
+                )
+                # Retry: the conflicting namespace has no ownerReference to
+                # us, so no watch will fire when an admin removes it — a
+                # periodic requeue is the only way this self-heals.
+                self._set_condition(api, profile, "Failed")
+                return Result(requeue_after=30.0)
+        except NotFound:
+            ns = new_resource(
+                "Namespace",
+                ns_name,
+                "",
+                labels={
+                    "istio-injection": "enabled",
+                    "app.kubernetes.io/part-of": "kubeflow-tpu",
+                },
+                annotations={OWNER_ANNOTATION: owner_name},
+            )
+            ns.metadata.owner_references = [owner_ref(profile)]
+            api.create(ns)
+
+        for sa in (EDITOR_SA, VIEWER_SA):
+            api.apply(new_resource("ServiceAccount", sa, ns_name))
+
+        rb = new_resource(
+            "RoleBinding",
+            "namespaceAdmin",
+            ns_name,
+            spec={
+                "roleRef": {
+                    "kind": "ClusterRole",
+                    "name": "kubeflow-admin",
+                },
+                "subjects": [owner] if owner else [],
+            },
+        )
+        api.apply(rb)
+
+        quota = profile.spec.get("resourceQuotaSpec")
+        if quota:
+            api.apply(
+                new_resource(
+                    "ResourceQuota", "kf-resource-quota", ns_name,
+                    spec=quota,
+                )
+            )
+
+        for plugin_spec in profile.spec.get("plugins", []):
+            plugin = self.plugins.get(plugin_spec.get("kind", ""))
+            if plugin is None:
+                self.failures.inc(severity="unknown_plugin")
+                api.record_event(
+                    profile,
+                    "UnknownPlugin",
+                    f"no plugin registered for {plugin_spec.get('kind')!r}",
+                    type_="Warning",
+                )
+                continue
+            plugin.apply(api, profile)
+
+        return self._set_condition(api, profile, "Ready")
+
+    def _finalize(self, api: FakeApiServer, profile: Resource) -> Result:
+        for plugin_spec in profile.spec.get("plugins", []):
+            plugin = self.plugins.get(plugin_spec.get("kind", ""))
+            if plugin is not None:
+                plugin.revoke(api, profile)
+        if FINALIZER in profile.metadata.finalizers:
+            profile.metadata.finalizers.remove(FINALIZER)
+            api.update(profile)  # storage finalizes; namespace cascades
+        return Result()
+
+    def _set_condition(
+        self, api: FakeApiServer, profile: Resource, cond: str
+    ) -> Result:
+        fresh = api.get(
+            KIND, profile.metadata.name, profile.metadata.namespace
+        )
+        if fresh.status.get("condition") != cond:
+            fresh.status["condition"] = cond
+            api.update_status(fresh)
+        return Result()

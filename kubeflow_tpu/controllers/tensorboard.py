@@ -1,0 +1,168 @@
+"""Tensorboard controller: Tensorboard CR → Deployment + Service + VS.
+
+Parity with `tensorboard-controller/controllers/tensorboard_controller.go`
+(SURVEY.md §2 item 8): `generateDeployment` (:152) understands `logspath`
+on a PVC vs cloud storage, `generateVirtualService` (:294) routes
+`/tensorboard/<ns>/<name>/`. The RWO-PVC co-scheduling concern (:392-450)
+becomes a node-affinity annotation computed from the pod currently holding
+the volume.
+
+TPU twist: the served TensorBoard is also the platform's profiling UI —
+`jax.profiler` trace dirs are just a `logspath`, which is how this design
+delivers the tracing/profiling subsystem (SURVEY.md §5 tracing row).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.controllers.runtime import Controller, Key, Result
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+
+log = logging.getLogger(__name__)
+
+KIND = "Tensorboard"
+DEFAULT_IMAGE = "kubeflow-tpu/tensorboard:latest"
+
+
+def _is_cloud_path(path: str) -> bool:
+    return path.startswith(("gs://", "s3://"))
+
+
+class TensorboardController:
+    def __init__(self, api: FakeApiServer):
+        self.api = api
+        self.controller = Controller(
+            api,
+            KIND,
+            self.reconcile,
+            owns=("Deployment", "Service", "VirtualService"),
+            name="tensorboard-controller",
+        )
+
+    def _desired_deployment(self, tb: Resource) -> Resource:
+        logspath = tb.spec.get("logspath", "")
+        container = {
+            "name": "tensorboard",
+            "image": tb.spec.get("image", DEFAULT_IMAGE),
+            "command": [
+                "tensorboard",
+                f"--logdir={logspath}",
+                "--bind_all",
+                "--port=6006",
+            ],
+            "ports": [{"containerPort": 6006}],
+        }
+        pod_spec: dict = {"containers": [container]}
+        if logspath and not _is_cloud_path(logspath):
+            # PVC-backed logs: "<claim>/<sub/path>" mounts the claim with a
+            # SubPath so only the requested run directory is served
+            # (tensorboard_controller.go:155-177). Leading slashes are
+            # tolerated.
+            claim, _, subpath = logspath.strip("/").partition("/")
+            pvc_name = claim
+            mount = {"name": "logs", "mountPath": "/logs"}
+            if subpath:
+                mount["subPath"] = subpath
+            container["volumeMounts"] = [mount]
+            container["command"][1] = "--logdir=/logs"
+            pod_spec["volumes"] = [
+                {"name": "logs", "persistentVolumeClaim": {"claimName": pvc_name}}
+            ]
+            holder = self._pvc_holder(tb.metadata.namespace, pvc_name)
+            if holder is not None:
+                pod_spec["affinity"] = {
+                    "podAffinity": {"colocateWithPod": holder}
+                }
+        dep = new_resource(
+            "Deployment",
+            tb.metadata.name,
+            tb.metadata.namespace,
+            spec={
+                "replicas": 1,
+                "selector": {"matchLabels": {"tensorboard": tb.metadata.name}},
+                "template": {
+                    "metadata": {
+                        "labels": {"tensorboard": tb.metadata.name}
+                    },
+                    "spec": pod_spec,
+                },
+            },
+        )
+        dep.metadata.owner_references = [owner_ref(tb)]
+        return dep
+
+    def _pvc_holder(self, namespace: str, pvc_name: str) -> str | None:
+        """Name of a running pod already mounting the PVC (RWO
+        co-scheduling, tensorboard_controller.go:440)."""
+        for pod in self.api.list("Pod", namespace):
+            for vol in pod.spec.get("volumes", []):
+                claim = vol.get("persistentVolumeClaim", {})
+                if claim.get("claimName") == pvc_name and (
+                    pod.status.get("phase") == "Running"
+                ):
+                    return pod.metadata.name
+        return None
+
+    def _desired_service(self, tb: Resource) -> Resource:
+        svc = new_resource(
+            "Service",
+            tb.metadata.name,
+            tb.metadata.namespace,
+            spec={
+                "selector": {"tensorboard": tb.metadata.name},
+                "ports": [{"port": 80, "targetPort": 6006}],
+            },
+        )
+        svc.metadata.owner_references = [owner_ref(tb)]
+        return svc
+
+    def _desired_vs(self, tb: Resource) -> Resource:
+        prefix = f"/tensorboard/{tb.metadata.namespace}/{tb.metadata.name}/"
+        vs = new_resource(
+            "VirtualService",
+            f"tensorboard-{tb.metadata.namespace}-{tb.metadata.name}",
+            tb.metadata.namespace,
+            spec={
+                "gateways": ["kubeflow/kubeflow-gateway"],
+                "hosts": ["*"],
+                "http": [
+                    {
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{tb.metadata.name}."
+                                    f"{tb.metadata.namespace}.svc",
+                                    "port": {"number": 80},
+                                }
+                            }
+                        ],
+                    }
+                ],
+            },
+        )
+        vs.metadata.owner_references = [owner_ref(tb)]
+        return vs
+
+    def reconcile(self, api: FakeApiServer, key: Key) -> Result:
+        ns, name = key
+        try:
+            tb = api.get(KIND, name, ns)
+        except NotFound:
+            return Result()
+        if tb.metadata.deletion_timestamp is not None:
+            return Result()
+        api.apply(self._desired_deployment(tb))
+        api.apply(self._desired_service(tb))
+        api.apply(self._desired_vs(tb))
+
+        dep = api.get("Deployment", name, ns)
+        new_status = dict(tb.status)
+        new_status["readyReplicas"] = dep.status.get("readyReplicas", 0)
+        if new_status != tb.status:
+            tb.status = new_status
+            api.update_status(tb)
+        return Result()

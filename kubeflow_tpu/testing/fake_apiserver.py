@@ -51,6 +51,25 @@ class FakeApiServer:
         self._rv = 0
         self._lock = threading.RLock()
         self._watchers: list[tuple[str | None, WatchHandler]] = []
+        self._admission: list[tuple[str | None, Callable[[Resource], Resource]]] = []
+
+    # -- admission --------------------------------------------------------
+
+    def register_admission(
+        self, mutator: Callable[[Resource], Resource], kind: str | None = None
+    ) -> None:
+        """Mutating-admission hook applied on create AND update (real
+        mutating webhooks fire on both; the reference's boundary is
+        `admission-webhook/main.go:447`). Mutators must be idempotent —
+        updates re-run them over an already-mutated object."""
+        with self._lock:
+            self._admission.append((kind, mutator))
+
+    def _admit(self, obj: Resource) -> Resource:
+        for kind, mutator in list(self._admission):
+            if kind is None or kind == obj.kind:
+                obj = mutator(obj.deepcopy())
+        return obj
 
     # -- watch ------------------------------------------------------------
 
@@ -67,6 +86,7 @@ class FakeApiServer:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj: Resource) -> Resource:
+        obj = self._admit(obj)
         with self._lock:
             key = obj.key
             if key in self._objects:
@@ -151,7 +171,7 @@ class FakeApiServer:
         return out
 
     def update(self, obj: Resource) -> Resource:
-        return self._update(obj, status_only=False)
+        return self._update(self._admit(obj), status_only=False)
 
     def update_status(self, obj: Resource) -> Resource:
         return self._update(obj, status_only=True)
@@ -211,11 +231,23 @@ class FakeApiServer:
 
     def apply(self, obj: Resource) -> Resource:
         """Create-or-update by (kind, ns, name) — the reconcilehelper
-        pattern (`components/common/reconcilehelper/util.go:18-105`)."""
+        pattern (`components/common/reconcilehelper/util.go:18-105`):
+        no-op when the desired fields already match, so level-triggered
+        reconcilers don't re-trigger their own watches."""
         try:
             current = self.get(obj.kind, obj.metadata.name, obj.metadata.namespace)
         except NotFound:
             return self.create(obj)
+        # Compare post-admission desired state against stored state —
+        # otherwise an apply() of pre-admission spec would strip injected
+        # fields on every pass and never no-op.
+        obj = self._admit(obj)
+        if (
+            current.spec == obj.spec
+            and current.metadata.labels == obj.metadata.labels
+            and current.metadata.annotations == obj.metadata.annotations
+        ):
+            return current
         merged = obj.deepcopy()
         merged.metadata.resource_version = current.metadata.resource_version
         merged.metadata.uid = current.metadata.uid

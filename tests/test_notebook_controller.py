@@ -1,0 +1,126 @@
+import pytest
+
+from kubeflow_tpu.api import new_resource
+from kubeflow_tpu.controllers.notebook import (
+    KIND,
+    STOP_ANNOTATION,
+    CullerConfig,
+    NotebookController,
+)
+from kubeflow_tpu.testing import FakeApiServer
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+def _make_nb(api, name="nb", ns="user1", **spec):
+    return api.create(new_resource(KIND, name, ns, spec=spec))
+
+
+def test_children_created(api):
+    ctl = NotebookController(api)
+    _make_nb(api, image="jax-notebook:1")
+    ctl.controller.run_until_idle()
+
+    sts = api.get("StatefulSet", "nb", "user1")
+    assert sts.spec["replicas"] == 1
+    container = sts.spec["template"]["spec"]["containers"][0]
+    assert container["image"] == "jax-notebook:1"
+    assert {"name": "NB_PREFIX", "value": "/notebook/user1/nb"} in container["env"]
+
+    svc = api.get("Service", "nb", "user1")
+    assert svc.spec["ports"][0] == {"port": 80, "targetPort": 8888}
+
+    vs = api.get("VirtualService", "notebook-user1-nb", "user1")
+    assert vs.spec["http"][0]["match"][0]["uri"]["prefix"] == "/notebook/user1/nb/"
+    assert ctl.created_total.value() == 1
+
+
+def test_stop_annotation_scales_to_zero(api):
+    ctl = NotebookController(api)
+    _make_nb(api)
+    ctl.controller.run_until_idle()
+    nb = api.get(KIND, "nb", "user1")
+    nb.metadata.annotations[STOP_ANNOTATION] = "now"
+    api.update(nb)
+    ctl.controller.run_until_idle()
+    assert api.get("StatefulSet", "nb", "user1").spec["replicas"] == 0
+
+
+def test_status_mirrors_pod(api):
+    ctl = NotebookController(api)
+    _make_nb(api)
+    ctl.controller.run_until_idle()
+    pod = new_resource("Pod", "nb-0", "user1", labels={"notebook": "nb"})
+    api.create(pod)
+    pod = api.get("Pod", "nb-0", "user1")
+    pod.status["phase"] = "Running"
+    api.update_status(pod)
+    ctl.controller.run_until_idle()
+    status = api.get(KIND, "nb", "user1").status
+    assert status["readyReplicas"] == 1
+    assert status["containerState"] == "Running"
+    assert ctl.running.value() == 1
+
+
+def _run_pod(api, name="nb-0", ns="user1", nb="nb"):
+    api.create(new_resource("Pod", name, ns, labels={"notebook": nb},
+                            spec={"containers": [{"name": "nb"}]}))
+    pod = api.get("Pod", name, ns)
+    pod.status["phase"] = "Running"
+    api.update_status(pod)
+
+
+def test_culler_stops_idle_notebook(api):
+    clock = {"now": 10_000.0}
+    ctl = NotebookController(
+        api,
+        culler=CullerConfig(enabled=True, idle_seconds=600),
+        activity_probe=lambda nb: 9000.0,  # idle for 1000s
+        clock=lambda: clock["now"],
+    )
+    _make_nb(api)
+    _run_pod(api)  # culling only applies to a running workload
+    ctl.controller.run_until_idle()
+    nb = api.get(KIND, "nb", "user1")
+    assert STOP_ANNOTATION in nb.metadata.annotations
+    assert ctl.culled_total.value() == 1
+    ctl.controller.run_until_idle()
+    assert api.get("StatefulSet", "nb", "user1").spec["replicas"] == 0
+
+
+def test_culler_spares_active_notebook(api):
+    ctl = NotebookController(
+        api,
+        culler=CullerConfig(enabled=True, idle_seconds=600),
+        activity_probe=lambda nb: 9900.0,
+        clock=lambda: 10_000.0,
+    )
+    _make_nb(api)
+    ctl.controller.run_until_idle()
+    assert STOP_ANNOTATION not in api.get(KIND, "nb", "user1").metadata.annotations
+
+
+def test_unreachable_probe_fails_safe(api):
+    ctl = NotebookController(
+        api,
+        culler=CullerConfig(enabled=True, idle_seconds=0),
+        activity_probe=lambda nb: None,
+    )
+    _make_nb(api)
+    _run_pod(api)
+    ctl.controller.run_until_idle()
+    assert STOP_ANNOTATION not in api.get(KIND, "nb", "user1").metadata.annotations
+
+
+def test_pending_notebook_not_culled(api):
+    ctl = NotebookController(
+        api,
+        culler=CullerConfig(enabled=True, idle_seconds=0),
+        activity_probe=lambda nb: 0.0,  # "idle forever"
+    )
+    _make_nb(api)  # no running pod yet
+    ctl.controller.run_until_idle()
+    assert STOP_ANNOTATION not in api.get(KIND, "nb", "user1").metadata.annotations

@@ -1,0 +1,106 @@
+import pytest
+
+from kubeflow_tpu.api import new_resource
+from kubeflow_tpu.controllers import poddefault
+from kubeflow_tpu.testing import FakeApiServer
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer()
+    poddefault.register(srv)
+    return srv
+
+
+def _poddefault(name, ns="user1", **spec):
+    return new_resource(poddefault.KIND, name, ns, spec=spec)
+
+
+def _pod(name="p", ns="user1", labels=None, env=None):
+    return new_resource(
+        "Pod", name, ns,
+        spec={"containers": [{"name": "main", "env": list(env or [])}]},
+        labels=labels or {},
+    )
+
+
+def test_matching_poddefault_injected(api):
+    api.create(_poddefault(
+        "tpu-env",
+        selector={"matchLabels": {"add-tpu-env": "true"}},
+        env=[{"name": "TPU_ACCEL", "value": "v5e"}],
+        volumes=[{"name": "cache", "emptyDir": {}}],
+        volumeMounts=[{"name": "cache", "mountPath": "/cache"}],
+        annotations={"sidecar.istio.io/inject": "false"},
+    ))
+    created = api.create(_pod(labels={"add-tpu-env": "true"}))
+    c = created.spec["containers"][0]
+    assert {"name": "TPU_ACCEL", "value": "v5e"} in c["env"]
+    assert c["volumeMounts"][0]["mountPath"] == "/cache"
+    assert created.spec["volumes"][0]["name"] == "cache"
+    assert created.metadata.annotations["sidecar.istio.io/inject"] == "false"
+    assert (
+        created.metadata.annotations["poddefault.kubeflow-tpu.org/tpu-env"]
+        == "applied"
+    )
+
+
+def test_non_matching_ignored(api):
+    api.create(_poddefault(
+        "x", selector={"matchLabels": {"match": "yes"}},
+        env=[{"name": "A", "value": "1"}],
+    ))
+    created = api.create(_pod(labels={"match": "no"}))
+    assert created.spec["containers"][0]["env"] == []
+
+
+def test_existing_pod_values_win(api):
+    api.create(_poddefault(
+        "x", selector={"matchLabels": {"m": "y"}},
+        env=[{"name": "A", "value": "default"}],
+    ))
+    created = api.create(
+        _pod(labels={"m": "y"}, env=[{"name": "A", "value": "explicit"}])
+    )
+    assert created.spec["containers"][0]["env"] == [
+        {"name": "A", "value": "explicit"}
+    ]
+
+
+def test_conflicting_defaults_skip_injection(api):
+    api.create(_poddefault(
+        "a", selector={"matchLabels": {"m": "y"}},
+        env=[{"name": "X", "value": "1"}],
+    ))
+    api.create(_poddefault(
+        "b", selector={"matchLabels": {"m": "y"}},
+        env=[{"name": "X", "value": "2"}],
+    ))
+    created = api.create(_pod(labels={"m": "y"}))
+    assert created.spec["containers"][0]["env"] == []
+    assert "conflict" in created.metadata.annotations[
+        "poddefault.kubeflow-tpu.org/conflict"
+    ] or "X" in created.metadata.annotations[
+        "poddefault.kubeflow-tpu.org/conflict"
+    ]
+
+
+def test_tpujob_pods_get_poddefaults(api):
+    # Integration: the operator's gang pods pass through admission too.
+    from kubeflow_tpu.api import make_tpujob
+    from kubeflow_tpu.controllers.tpujob import TpuJobController
+
+    api.create(_poddefault(
+        "creds", ns="default",
+        selector={"matchLabels": {"kubeflow-tpu.org/job": "j"}},
+        env=[{"name": "GCS_KEY", "value": "/secrets/key.json"}],
+    ))
+    ctl = TpuJobController(api)
+    api.create(make_tpujob("j", replicas=2))
+    ctl.controller.run_until_idle()
+    env = {
+        e["name"]: e["value"]
+        for e in api.get("Pod", "j-worker-0").spec["containers"][0]["env"]
+    }
+    assert env["GCS_KEY"] == "/secrets/key.json"
+    assert env["TPUJOB_NUM_PROCESSES"] == "2"

@@ -1,0 +1,93 @@
+import pytest
+
+from kubeflow_tpu.api import new_resource
+from kubeflow_tpu.controllers.profile import (
+    EDITOR_SA,
+    FINALIZER,
+    KIND,
+    VIEWER_SA,
+    ProfileController,
+)
+from kubeflow_tpu.testing import FakeApiServer, NotFound
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+def _profile(name="alice", owner="alice@example.com", **extra):
+    spec = {"owner": {"kind": "User", "name": owner}, **extra}
+    return new_resource(KIND, name, "default", spec=spec)
+
+
+def test_profile_provisions_namespace(api):
+    ctl = ProfileController(api)
+    api.create(_profile())
+    ctl.controller.run_until_idle()
+
+    ns = api.get("Namespace", "alice", "")
+    assert ns.metadata.labels["istio-injection"] == "enabled"
+    assert ns.metadata.annotations["owner"] == "alice@example.com"
+    assert api.get("ServiceAccount", EDITOR_SA, "alice")
+    assert api.get("ServiceAccount", VIEWER_SA, "alice")
+    rb = api.get("RoleBinding", "namespaceAdmin", "alice")
+    assert rb.spec["subjects"][0]["name"] == "alice@example.com"
+    assert api.get(KIND, "alice").status["condition"] == "Ready"
+    assert FINALIZER in api.get(KIND, "alice").metadata.finalizers
+
+
+def test_tpu_resource_quota(api):
+    ctl = ProfileController(api)
+    api.create(
+        _profile(
+            resourceQuotaSpec={"hard": {"google.com/tpu": 16, "cpu": "64"}}
+        )
+    )
+    ctl.controller.run_until_idle()
+    rq = api.get("ResourceQuota", "kf-resource-quota", "alice")
+    assert rq.spec["hard"]["google.com/tpu"] == 16
+
+
+def test_foreign_namespace_not_taken_over(api):
+    api.create(new_resource("Namespace", "bob", ""))  # pre-existing, unowned
+    ctl = ProfileController(api)
+    api.create(_profile(name="bob", owner="mallory@example.com"))
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "bob").status["condition"] == "Failed"
+    with pytest.raises(NotFound):
+        api.get("ServiceAccount", EDITOR_SA, "bob")
+
+
+def test_delete_revokes_plugins_and_cascades(api):
+    revoked = []
+
+    class FakePlugin:
+        name = "TestPlugin"
+
+        def apply(self, api_, profile):
+            pass
+
+        def revoke(self, api_, profile):
+            revoked.append(profile.metadata.name)
+
+    ctl = ProfileController(api, plugins={"TestPlugin": FakePlugin()})
+    api.create(_profile(plugins=[{"kind": "TestPlugin"}]))
+    ctl.controller.run_until_idle()
+    api.delete(KIND, "alice")
+    ctl.controller.run_until_idle()
+    assert revoked == ["alice"]
+    with pytest.raises(NotFound):
+        api.get(KIND, "alice")
+    with pytest.raises(NotFound):
+        api.get("Namespace", "alice", "")
+
+
+def test_unknown_plugin_warns_but_provisions(api):
+    ctl = ProfileController(api)
+    api.create(_profile(plugins=[{"kind": "NoSuchPlugin"}]))
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "alice").status["condition"] == "Ready"
+    assert ctl.failures.value(severity="unknown_plugin") >= 1
+    reasons = [e.spec["reason"] for e in api.list("Event")]
+    assert "UnknownPlugin" in reasons

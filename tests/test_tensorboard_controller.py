@@ -1,0 +1,58 @@
+import pytest
+
+from kubeflow_tpu.api import new_resource
+from kubeflow_tpu.controllers.tensorboard import KIND, TensorboardController
+from kubeflow_tpu.testing import FakeApiServer
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+def test_cloud_logspath(api):
+    ctl = TensorboardController(api)
+    api.create(
+        new_resource(KIND, "tb", "user1", spec={"logspath": "gs://bkt/logs"})
+    )
+    ctl.controller.run_until_idle()
+    dep = api.get("Deployment", "tb", "user1")
+    cmd = dep.spec["template"]["spec"]["containers"][0]["command"]
+    assert "--logdir=gs://bkt/logs" in cmd
+    assert "volumes" not in dep.spec["template"]["spec"]
+    vs = api.get("VirtualService", "tensorboard-user1-tb", "user1")
+    assert vs.spec["http"][0]["match"][0]["uri"]["prefix"] == "/tensorboard/user1/tb/"
+
+
+def test_pvc_logspath_mounts_and_colocates(api):
+    # A running pod already holds the PVC: the tensorboard pod co-locates.
+    holder = new_resource(
+        "Pod", "train-0", "user1",
+        spec={"volumes": [{"persistentVolumeClaim": {"claimName": "logs-pvc"},
+                           "name": "x"}]},
+    )
+    api.create(holder)
+    p = api.get("Pod", "train-0", "user1")
+    p.status["phase"] = "Running"
+    api.update_status(p)
+
+    ctl = TensorboardController(api)
+    api.create(
+        new_resource(KIND, "tb", "user1", spec={"logspath": "logs-pvc/run1"})
+    )
+    ctl.controller.run_until_idle()
+    spec = api.get("Deployment", "tb", "user1").spec["template"]["spec"]
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "logs-pvc"
+    assert spec["affinity"]["podAffinity"]["colocateWithPod"] == "train-0"
+    assert "--logdir=/logs" in spec["containers"][0]["command"]
+
+
+def test_status_mirrors_deployment(api):
+    ctl = TensorboardController(api)
+    api.create(new_resource(KIND, "tb", "u", spec={"logspath": "gs://b/l"}))
+    ctl.controller.run_until_idle()
+    dep = api.get("Deployment", "tb", "u")
+    dep.status["readyReplicas"] = 1
+    api.update_status(dep)
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "tb", "u").status["readyReplicas"] == 1
