@@ -51,9 +51,13 @@ def coordinator_address(job: Resource) -> str:
 
 class TpuJobController:
     def __init__(
-        self, api: FakeApiServer, metrics: MetricsRegistry | None = None
+        self,
+        api: FakeApiServer,
+        metrics: MetricsRegistry | None = None,
+        scheduler=None,
     ):
         self.api = api
+        self._scheduler_factory = scheduler
         metrics = metrics or MetricsRegistry()
         self.jobs_running = metrics.gauge(
             "tpujob_running", "TpuJobs currently running"
@@ -152,6 +156,48 @@ class TpuJobController:
         pod.metadata.owner_references = [owner_ref(job)]
         return pod
 
+    # -- native placement -------------------------------------------------
+
+    def _build_scheduler(self, api: FakeApiServer, placing_job: str):
+        """Construct a fresh native scheduler from OBSERVED state — current
+        Nodes plus reservations implied by live pods' nodeName — for one
+        placement decision. No long-lived mirror: deleted/recreated nodes,
+        spec edits, and operator restarts can't desynchronize what doesn't
+        persist. Returns None when the cluster model has no Nodes."""
+        nodes = api.list("Node")
+        if not nodes:
+            return None
+        from kubeflow_tpu.native import GangScheduler
+
+        sched = (
+            self._scheduler_factory()
+            if self._scheduler_factory is not None
+            else GangScheduler()
+        )
+        for n in nodes:
+            sched.add_node(
+                n.metadata.name,
+                n.spec.get("pool", "default"),
+                x=n.spec.get("x", 0),
+                y=n.spec.get("y", 0),
+                chips=n.spec.get("chips", 4),
+            )
+        for pod in api.list("Pod"):
+            node = pod.spec.get("nodeName")
+            if not node or pod.status.get("phase") in ("Succeeded", "Failed"):
+                continue
+            owner = pod.metadata.labels.get(LABEL_JOB, "")
+            gang = f"{pod.metadata.namespace}/{owner}"
+            if gang == placing_job:
+                continue  # our own stale pods are being replaced
+            limits = (
+                pod.spec.get("containers", [{}])[0]
+                .get("resources", {})
+                .get("limits", {})
+            )
+            sched.reserve(gang, node, int(limits.get("google.com/tpu", 0)))
+        return sched
+
     # -- reconcile --------------------------------------------------------
 
     def reconcile(self, api: FakeApiServer, key: Key) -> Result:
@@ -159,7 +205,7 @@ class TpuJobController:
         try:
             job = api.get(KIND, name, ns)
         except NotFound:
-            return Result()  # deleted; dependents cascade via owner refs
+            return Result()  # deleted; pods cascade, freeing capacity
         if job.metadata.deletion_timestamp is not None:
             return Result()
         phase = job.status.get("phase")
@@ -182,10 +228,48 @@ class TpuJobController:
         by_index = {p.metadata.labels.get(LABEL_WORKER): p for p in pods}
 
         if not pods:
-            # Gang creation: all pods in one pass.
+            # Gang creation: all pods in one pass, with topology-aware
+            # placement when a cluster node model exists.
+            assignment: list[str] | None = None
+            gang_id = f"{ns}/{name}"
+            sched = (
+                self._build_scheduler(api, gang_id) if spec.topology else None
+            )
+            if sched is not None:
+                from kubeflow_tpu.native import PlacementError
+
+                try:
+                    assignment, ring_cost = sched.place_gang(
+                        gang_id, spec.topology, spec.replicas,
+                        spec.tpu_chips_per_worker,
+                    )
+                except PlacementError as e:
+                    # Record the event once per stuck episode, not per
+                    # 10s retry — unbounded Event growth otherwise.
+                    if job.status.get("reason") != "Unschedulable":
+                        api.record_event(
+                            job, "Unschedulable", str(e), type_="Warning"
+                        )
+                        fresh = api.get(KIND, name, ns)
+                        fresh.status["reason"] = "Unschedulable"
+                        api.update_status(fresh)
+                    self._set_phase(api, job, "Pending")
+                    return Result(requeue_after=10.0)
+                api.record_event(
+                    job, "GangPlaced",
+                    f"placed on {len(set(assignment))} node(s), "
+                    f"ring cost {ring_cost}",
+                )
+                if job.status.get("reason") == "Unschedulable":
+                    fresh = api.get(KIND, name, ns)
+                    fresh.status.pop("reason", None)
+                    api.update_status(fresh)
             incarnation = job.status.get("restarts", 0)
             for i in range(spec.replicas):
-                api.create(self._desired_pod(job, spec, i, incarnation))
+                pod = self._desired_pod(job, spec, i, incarnation)
+                if assignment is not None:
+                    pod.spec["nodeName"] = assignment[i]
+                api.create(pod)
             api.record_event(
                 job, "GangCreated", f"created {spec.replicas} workers"
             )

@@ -1,0 +1,1 @@
+from kubeflow_tpu.native.scheduler import GangScheduler, PlacementError

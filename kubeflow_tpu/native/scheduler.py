@@ -1,0 +1,148 @@
+"""ctypes bindings for the native gang scheduler (native/src/scheduler.cc).
+
+The shared library is built on demand with cmake+ninja into native/build —
+no packaging step, no pybind11 (not in the image); the C ABI plus ctypes is
+the binding layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent.parent
+_NATIVE = _REPO / "native"
+_LIB = _NATIVE / "build" / "libkftpu_sched.so"
+_build_lock = threading.Lock()
+
+
+class PlacementError(RuntimeError):
+    pass
+
+
+def _ensure_built() -> Path:
+    with _build_lock:
+        src_newest = max(
+            p.stat().st_mtime for p in (_NATIVE / "src").glob("*.cc")
+        )
+        if not _LIB.exists() or _LIB.stat().st_mtime < src_newest:
+            subprocess.run(
+                ["cmake", "-S", str(_NATIVE), "-B", str(_NATIVE / "build"),
+                 "-G", "Ninja"],
+                check=True, capture_output=True,
+            )
+            subprocess.run(
+                ["cmake", "--build", str(_NATIVE / "build")],
+                check=True, capture_output=True,
+            )
+    return _LIB
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(str(_ensure_built()))
+            lib.kftpu_sched_new.restype = ctypes.c_void_p
+            lib.kftpu_sched_free.argtypes = [ctypes.c_void_p]
+            lib.kftpu_sched_add_node.restype = ctypes.c_int32
+            lib.kftpu_sched_add_node.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ]
+            lib.kftpu_sched_remove_node.restype = ctypes.c_int32
+            lib.kftpu_sched_remove_node.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.kftpu_sched_place_gang.restype = ctypes.c_int64
+            lib.kftpu_sched_place_gang.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+                ctypes.c_int32,
+            ]
+            lib.kftpu_sched_release_gang.restype = ctypes.c_int32
+            lib.kftpu_sched_release_gang.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.kftpu_sched_reserve.restype = ctypes.c_int32
+            lib.kftpu_sched_reserve.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int32,
+            ]
+            lib.kftpu_sched_free_chips.restype = ctypes.c_int64
+            lib.kftpu_sched_free_chips.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            _lib = lib
+    return _lib
+
+
+class GangScheduler:
+    """Topology-aware, all-or-nothing gang placement (native-backed)."""
+
+    def __init__(self):
+        self._lib = _load()
+        self._handle = self._lib.kftpu_sched_new()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.kftpu_sched_free(handle)
+            self._handle = None
+
+    def add_node(
+        self, name: str, pool: str, *, x: int = 0, y: int = 0, chips: int = 4
+    ) -> None:
+        rc = self._lib.kftpu_sched_add_node(
+            self._handle, name.encode(), pool.encode(), x, y, chips
+        )
+        if rc != 0:
+            raise PlacementError(f"node {name!r} already registered")
+
+    def remove_node(self, name: str) -> bool:
+        return (
+            self._lib.kftpu_sched_remove_node(self._handle, name.encode()) == 0
+        )
+
+    def place_gang(
+        self, job: str, pool: str, workers: int, chips_per_worker: int
+    ) -> tuple[list[str], int]:
+        """Returns (node per rank, ring cost). Raises PlacementError if the
+        pool cannot hold the whole gang (nothing is reserved)."""
+        buf = ctypes.create_string_buffer(64 * max(1, workers) + 64)
+        cost = self._lib.kftpu_sched_place_gang(
+            self._handle, job.encode(), pool.encode(), workers,
+            chips_per_worker, buf, len(buf),
+        )
+        if cost == -1:
+            raise PlacementError(
+                f"pool {pool!r} lacks capacity for {workers}x"
+                f"{chips_per_worker} chips"
+            )
+        if cost < 0:
+            raise PlacementError(f"placement failed (code {cost}) for {job!r}")
+        return buf.value.decode().split(";"), int(cost)
+
+    def reserve(self, job: str, node: str, chips: int) -> bool:
+        """Record an observed placement (rebuilding state from pods)."""
+        return (
+            self._lib.kftpu_sched_reserve(
+                self._handle, job.encode(), node.encode(), chips
+            )
+            == 0
+        )
+
+    def release_gang(self, job: str) -> int:
+        n = self._lib.kftpu_sched_release_gang(self._handle, job.encode())
+        return max(0, n)
+
+    def free_chips(self, pool: str) -> int:
+        return int(
+            self._lib.kftpu_sched_free_chips(self._handle, pool.encode())
+        )
