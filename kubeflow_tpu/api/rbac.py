@@ -1,0 +1,144 @@
+"""RBAC model + SubjectAccessReview evaluation over the API server.
+
+The reference's web tier authorizes every request with a K8s
+`SubjectAccessReview` (`crud_backend/authz.py:46-80`,
+`jupyter-web-app/.../auth.py:41-106`), which the real API server answers by
+walking (Cluster)RoleBindings. Our in-process API server stores the same
+objects — Role / ClusterRole / RoleBinding / ClusterRoleBinding as plain
+Resources — so SARs are answered here with the standard K8s match rules:
+a binding's subjects name the user, its roleRef names a role, and a rule
+allows (verb, resource) with `*` wildcards.
+
+Role/ClusterRole spec shape: {"rules": [{"verbs": [...], "resources":
+[...], "apiGroups": [...]}]}. Binding spec shape: {"roleRef": {"kind":
+..., "name": ...}, "subjects": [{"kind": "User", "name": ...}]}.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.objects import Resource, new_resource
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+
+CLUSTER_ADMIN_ROLE = "kubeflow-admin"
+EDIT_ROLE = "kubeflow-edit"
+VIEW_ROLE = "kubeflow-view"
+
+_VIEW_VERBS = ["get", "list", "watch"]
+_EDIT_VERBS = _VIEW_VERBS + ["create", "update", "patch", "delete"]
+
+
+def seed_cluster_roles(api: FakeApiServer) -> None:
+    """Install the platform ClusterRoles the controllers bind against
+    (the reference ships these as kustomize RBAC manifests under
+    `*/config/rbac/`; profile-controller binds `kubeflow-admin` at
+    `profile_controller.go:218-239`)."""
+    roles = [
+        (CLUSTER_ADMIN_ROLE, [{"verbs": ["*"], "resources": ["*"]}]),
+        (EDIT_ROLE, [{"verbs": _EDIT_VERBS, "resources": ["*"]}]),
+        (VIEW_ROLE, [{"verbs": _VIEW_VERBS, "resources": ["*"]}]),
+    ]
+    for name, rules in roles:
+        try:
+            api.get("ClusterRole", name, "")
+        except Exception:
+            api.create(
+                new_resource("ClusterRole", name, "", spec={"rules": rules})
+            )
+
+
+def make_cluster_role_binding(name: str, role: str, user: str) -> Resource:
+    return new_resource(
+        "ClusterRoleBinding",
+        name,
+        "",
+        spec={
+            "roleRef": {"kind": "ClusterRole", "name": role},
+            "subjects": [{"kind": "User", "name": user}],
+        },
+    )
+
+
+def _rule_allows(rule: dict, verb: str, resource: str) -> bool:
+    verbs = rule.get("verbs", [])
+    resources = rule.get("resources", [])
+    return ("*" in verbs or verb in verbs) and (
+        "*" in resources or resource in resources
+    )
+
+
+def _role_allows(role: Resource | None, verb: str, resource: str) -> bool:
+    if role is None:
+        return False
+    return any(
+        _rule_allows(rule, verb, resource)
+        for rule in role.spec.get("rules", [])
+    )
+
+
+def _binds_user(binding: Resource, user: str) -> bool:
+    return any(
+        s.get("kind", "User") in ("User", "ServiceAccount")
+        and s.get("name") == user
+        for s in binding.spec.get("subjects", [])
+    )
+
+
+def _resolve_role(
+    api: FakeApiServer, role_ref: dict, namespace: str
+) -> Resource | None:
+    kind = role_ref.get("kind", "ClusterRole")
+    name = role_ref.get("name", "")
+    try:
+        if kind == "ClusterRole":
+            return api.get("ClusterRole", name, "")
+        return api.get("Role", name, namespace)
+    except Exception:
+        return None
+
+
+def subject_access_review(
+    api: FakeApiServer,
+    user: str,
+    verb: str,
+    resource: str,
+    namespace: str = "",
+) -> bool:
+    """Answer: may `user` perform `verb` on `resource` in `namespace`?
+
+    ClusterRoleBindings grant cluster-wide; RoleBindings grant inside their
+    own namespace (and may reference a ClusterRole, which is how the
+    reference's per-namespace `namespaceAdmin` binding to the
+    `kubeflow-admin` ClusterRole works)."""
+    for crb in api.list("ClusterRoleBinding", ""):
+        if _binds_user(crb, user) and _role_allows(
+            _resolve_role(api, crb.spec.get("roleRef", {}), ""),
+            verb,
+            resource,
+        ):
+            return True
+    if namespace:
+        for rb in api.list("RoleBinding", namespace):
+            if _binds_user(rb, user) and _role_allows(
+                _resolve_role(api, rb.spec.get("roleRef", {}), namespace),
+                verb,
+                resource,
+            ):
+                return True
+    return False
+
+
+def is_cluster_admin(api: FakeApiServer, user: str) -> bool:
+    """kfam's QueryClusterAdmin check (`kfam/api_default.go:270-292`)."""
+    return subject_access_review(api, user, "*", "*", "")
+
+
+def namespaces_for(api: FakeApiServer, user: str) -> list[str]:
+    """Namespaces where the user can list pods — the dashboard's
+    namespace-selector population (`api_workgroup.ts:249-338` derives the
+    same from kfam bindings)."""
+    out = []
+    for ns in api.list("Namespace", ""):
+        name = ns.metadata.name
+        if subject_access_review(api, user, "list", "pods", name):
+            out.append(name)
+    return out

@@ -1,0 +1,11 @@
+"""User-facing application backends (the reference's L3 web tier).
+
+Each module builds an `App` on `kubeflow_tpu.web`:
+
+- `kfam` — access management: profiles + contributor bindings
+  (`components/access-management/`)
+- `jupyter` — notebook spawner backend (`components/jupyter-web-app/`,
+  `crud-web-apps/jupyter/backend/`)
+- `tensorboards` — tensorboard CRUD (`crud-web-apps/tensorboards/`)
+- `dashboard` — the central hub API (`components/centraldashboard/`)
+"""

@@ -207,6 +207,19 @@ class FakeApiServer:
         if emit_delete:
             self._emit("DELETED", obj)
         self._cascade(obj)
+        if obj.kind == "Namespace":
+            self._drain_namespace(obj.metadata.name)
+
+    def _drain_namespace(self, namespace: str) -> None:
+        """Real apiserver semantics: deleting a Namespace deletes every
+        namespaced object inside it (not just owner-ref dependents)."""
+        for kind, ns, name in [
+            k for k in self._objects if k[1] == namespace
+        ]:
+            try:
+                self.delete(kind, name, ns)
+            except NotFound:
+                pass
 
     def _cascade(self, owner: Resource) -> None:
         """Delete dependents whose controller ownerReference matches."""

@@ -1,0 +1,36 @@
+"""Shared web-application core — the `crud_backend` analog.
+
+The reference ships a shared Flask library
+(`components/crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend/`)
+that every CRUD UI backend builds on: before-request header authn
+(`authn.py:39`), SubjectAccessReview authz (`authz.py:46-80`), typed K8s
+API wrappers, uniform success/error JSON envelopes (`api/utils.py:6`), and
+liveness probes. This package provides the same core on the stdlib WSGI
+interface (no Flask in the image) so every app in `kubeflow_tpu.apps`
+shares one authn/authz/error surface.
+"""
+
+from kubeflow_tpu.web.authn import HeaderAuthn
+from kubeflow_tpu.web.authz import Forbidden, ensure_authorized
+from kubeflow_tpu.web.wsgi import (
+    App,
+    HttpError,
+    Request,
+    Response,
+    TestClient,
+    json_response,
+    success_response,
+)
+
+__all__ = [
+    "App",
+    "Forbidden",
+    "HeaderAuthn",
+    "HttpError",
+    "Request",
+    "Response",
+    "TestClient",
+    "ensure_authorized",
+    "json_response",
+    "success_response",
+]

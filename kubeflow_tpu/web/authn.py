@@ -1,0 +1,61 @@
+"""Header-based authentication — the platform's trust model.
+
+The reference trusts the mesh gateway to authenticate and inject a user-id
+header; backends read it and strip an optional prefix
+(`crud_backend/authn.py:39`, `jupyter-web-app/.../auth.py:41`,
+`centraldashboard/app/attach_user_middleware.ts`). Knobs mirror the
+reference's: USERID_HEADER (default `x-goog-authenticated-user-email`,
+`access-management/main.go:38`) and USERID_PREFIX (`accounts.google.com:`).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.web.wsgi import Request, Response, error_response
+
+DEFAULT_HEADER = "x-goog-authenticated-user-email"
+DEFAULT_PREFIX = "accounts.google.com:"
+
+# Probe/static paths that must work without identity (kubelet probes).
+SKIP_PATHS = ("/healthz", "/metrics")
+
+
+class HeaderAuthn:
+    """Before-request hook: resolve `request.user` or 401."""
+
+    def __init__(
+        self,
+        header: str | None = None,
+        prefix: str | None = None,
+        anonymous: str | None = None,
+    ):
+        self.header = (
+            header
+            if header is not None
+            else os.environ.get("USERID_HEADER", DEFAULT_HEADER)
+        ).lower()
+        self.prefix = (
+            prefix
+            if prefix is not None
+            else os.environ.get("USERID_PREFIX", DEFAULT_PREFIX)
+        )
+        # Dev-mode escape hatch (crud_backend config.py dev mode): treat
+        # unauthenticated requests as this fixed user instead of 401.
+        self.anonymous = anonymous
+
+    def __call__(self, req: Request) -> Response | None:
+        if req.path in SKIP_PATHS:
+            return None
+        raw = req.headers.get(self.header, "")
+        if raw.startswith(self.prefix):
+            raw = raw[len(self.prefix):]
+        if not raw:
+            if self.anonymous:
+                req.user = self.anonymous
+                return None
+            return error_response(
+                401, f"no user identity in header {self.header!r}"
+            )
+        req.user = raw
+        return None

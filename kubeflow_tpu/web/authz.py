@@ -1,0 +1,34 @@
+"""Per-request authorization via SubjectAccessReview.
+
+Every reference backend guards each handler with an SAR
+(`crud_backend/authz.py:46-80`: build SAR for (user, verb, resource,
+namespace), 403 with a readable message on deny). Same surface here,
+answered by the in-process RBAC evaluator.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.rbac import subject_access_review
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.wsgi import HttpError
+
+
+class Forbidden(HttpError):
+    def __init__(self, message: str):
+        super().__init__(403, message)
+
+
+def ensure_authorized(
+    api: FakeApiServer,
+    user: str | None,
+    verb: str,
+    resource: str,
+    namespace: str = "",
+) -> None:
+    if user is None:
+        raise HttpError(401, "request has no authenticated user")
+    if not subject_access_review(api, user, verb, resource, namespace):
+        scope = f"in namespace {namespace!r}" if namespace else "cluster-wide"
+        raise Forbidden(
+            f"user {user!r} is not allowed to {verb} {resource} {scope}"
+        )

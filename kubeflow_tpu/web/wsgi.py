@@ -1,0 +1,291 @@
+"""Minimal WSGI application core: routing, JSON envelopes, error mapping.
+
+Plays the role Flask plays for the reference's web backends
+(`crud_backend/serving.py`, `base_app.py:22-175`): path-parameter routing,
+before-request hooks (authn slots in here), JSON request/response helpers,
+and a uniform error surface that maps storage errors onto HTTP statuses.
+Runs under any WSGI server; `serve()` uses the stdlib threading server and
+`TestClient` drives the app in-process for tests (the reference tests its
+Flask apps the same way, via `app.test_client()`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import traceback
+from typing import Any, Callable
+from urllib.parse import parse_qs
+import socketserver
+from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
+
+from kubeflow_tpu.testing import fake_apiserver as storage
+
+log = logging.getLogger(__name__)
+
+_STATUS_REASON = {
+    200: "OK",
+    201: "Created",
+    204: "No Content",
+    400: "Bad Request",
+    401: "Unauthorized",
+    403: "Forbidden",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    409: "Conflict",
+    500: "Internal Server Error",
+}
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, environ: dict):
+        self.environ = environ
+        self.method = environ.get("REQUEST_METHOD", "GET").upper()
+        self.path = environ.get("PATH_INFO", "/")
+        self.query: dict[str, str] = {
+            k: v[-1]
+            for k, v in parse_qs(environ.get("QUERY_STRING", "")).items()
+        }
+        self.headers: dict[str, str] = {}
+        for key, value in environ.items():
+            if key.startswith("HTTP_"):
+                self.headers[key[5:].replace("_", "-").lower()] = value
+        if "CONTENT_TYPE" in environ:
+            self.headers["content-type"] = environ["CONTENT_TYPE"]
+        self.path_params: dict[str, str] = {}
+        self.user: str | None = None  # set by the authn hook
+        self._body: bytes | None = None
+
+    @property
+    def body(self) -> bytes:
+        if self._body is None:
+            try:
+                length = int(self.environ.get("CONTENT_LENGTH") or 0)
+            except ValueError:
+                length = 0
+            stream = self.environ.get("wsgi.input")
+            self._body = stream.read(length) if stream and length else b""
+        return self._body
+
+    def json(self) -> dict:
+        if not self.body:
+            return {}
+        try:
+            parsed = json.loads(self.body)
+        except ValueError as e:
+            raise HttpError(400, f"invalid JSON body: {e}") from e
+        if not isinstance(parsed, dict):
+            raise HttpError(400, "JSON body must be an object")
+        return parsed
+
+
+class Response:
+    def __init__(
+        self,
+        body: bytes = b"",
+        status: int = 200,
+        content_type: str = "application/json",
+        headers: list[tuple[str, str]] | None = None,
+    ):
+        self.body = body
+        self.status = status
+        self.headers = list(headers or [])
+        self.headers.append(("Content-Type", content_type))
+
+    @property
+    def status_line(self) -> str:
+        return f"{self.status} {_STATUS_REASON.get(self.status, 'Unknown')}"
+
+    def json(self) -> dict:
+        return json.loads(self.body)
+
+
+def json_response(payload: Any, status: int = 200) -> Response:
+    return Response(json.dumps(payload).encode(), status=status)
+
+
+def success_response(field: str | None = None, value: Any = None) -> Response:
+    """The crud_backend envelope (`api/utils.py:6`): always
+    `{"success": true, "status": 200, <field>: <value>}`."""
+    body: dict[str, Any] = {"success": True, "status": 200}
+    if field is not None:
+        body[field] = value
+    return json_response(body)
+
+
+def error_response(status: int, message: str) -> Response:
+    return json_response(
+        {"success": False, "status": status, "log": message}, status=status
+    )
+
+
+class _Route:
+    def __init__(self, pattern: str, methods: tuple[str, ...], handler):
+        self.methods = methods
+        self.handler = handler
+        regex = re.sub(r"<([a-zA-Z_][a-zA-Z0-9_]*)>", r"(?P<\1>[^/]+)", pattern)
+        self.regex = re.compile(f"^{regex}$")
+
+
+class App:
+    """A WSGI application with path-param routes and before-request hooks."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._routes: list[_Route] = []
+        self._before: list[Callable[[Request], Response | None]] = []
+        self.add_route("/healthz", self._healthz, methods=("GET",))
+
+    def _healthz(self, req: Request) -> Response:
+        # Probe endpoint (crud_backend registers the same; authn hooks
+        # must skip it so kubelet probes don't need identity headers).
+        return json_response({"app": self.name, "ok": True})
+
+    def before_request(
+        self, hook: Callable[[Request], Response | None]
+    ) -> None:
+        self._before.append(hook)
+
+    def add_route(
+        self,
+        pattern: str,
+        handler: Callable[[Request], Response],
+        methods: tuple[str, ...] = ("GET",),
+    ) -> None:
+        self._routes.append(
+            _Route(pattern, tuple(m.upper() for m in methods), handler)
+        )
+
+    def route(self, pattern: str, methods: tuple[str, ...] = ("GET",)):
+        def deco(handler):
+            self.add_route(pattern, handler, methods)
+            return handler
+
+        return deco
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle(self, req: Request) -> Response:
+        try:
+            return self._dispatch(req)
+        except HttpError as e:
+            return error_response(e.status, e.message)
+        except storage.NotFound as e:
+            return error_response(404, str(e))
+        except storage.AlreadyExists as e:
+            return error_response(409, str(e))
+        except storage.Conflict as e:
+            return error_response(409, str(e))
+        except Exception as e:  # crud_backend's catch-all 500 handler
+            log.error("%s: unhandled error: %s", self.name, e)
+            log.debug("%s", traceback.format_exc())
+            return error_response(500, f"internal error: {e}")
+
+    def _dispatch(self, req: Request) -> Response:
+        matched_path = False
+        for route in self._routes:
+            m = route.regex.match(req.path)
+            if not m:
+                continue
+            matched_path = True
+            if req.method not in route.methods:
+                continue
+            req.path_params = m.groupdict()
+            for hook in self._before:
+                resp = hook(req)
+                if resp is not None:
+                    return resp
+            return route.handler(req)
+        if matched_path:
+            raise HttpError(405, f"{req.method} not allowed on {req.path}")
+        raise HttpError(404, f"no route for {req.path}")
+
+    # -- WSGI --------------------------------------------------------------
+
+    def __call__(self, environ: dict, start_response) -> list[bytes]:
+        resp = self.handle(Request(environ))
+        start_response(resp.status_line, resp.headers)
+        return [resp.body]
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, format, *args):  # noqa: A002 - WSGI signature
+        log.debug("%s %s", self.address_string(), format % args)
+
+
+class _ThreadingWSGIServer(socketserver.ThreadingMixIn, WSGIServer):
+    daemon_threads = True
+
+
+def serve(app: App, host: str = "0.0.0.0", port: int = 8080):
+    """Serve on a background thread; returns (server, thread).
+
+    Connections are handled on per-request threads so a stalled client
+    can't block /healthz probes. `server.server_port` gives the bound
+    port (use port=0 in tests)."""
+    server = make_server(
+        host,
+        port,
+        app,
+        server_class=_ThreadingWSGIServer,
+        handler_class=_QuietHandler,
+    )
+    thread = threading.Thread(
+        target=server.serve_forever, name=f"{app.name}-http", daemon=True
+    )
+    thread.start()
+    return server, thread
+
+
+class TestClient:
+    """In-process client: builds a WSGI environ and calls the app."""
+
+    __test__ = False  # not a pytest test class
+
+    def __init__(self, app: App, headers: dict[str, str] | None = None):
+        self.app = app
+        self.headers = dict(headers or {})
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> Response:
+        import io
+
+        path, _, query = path.partition("?")
+        raw = json.dumps(body).encode() if body is not None else b""
+        environ = {
+            "REQUEST_METHOD": method,
+            "PATH_INFO": path,
+            "QUERY_STRING": query,
+            "CONTENT_LENGTH": str(len(raw)),
+            "CONTENT_TYPE": "application/json",
+            "wsgi.input": io.BytesIO(raw),
+        }
+        for key, value in {**self.headers, **(headers or {})}.items():
+            environ["HTTP_" + key.upper().replace("-", "_")] = value
+        return self.app.handle(Request(environ))
+
+    def get(self, path: str, **kw) -> Response:
+        return self.request("GET", path, **kw)
+
+    def post(self, path: str, body: dict | None = None, **kw) -> Response:
+        return self.request("POST", path, body=body, **kw)
+
+    def patch(self, path: str, body: dict | None = None, **kw) -> Response:
+        return self.request("PATCH", path, body=body, **kw)
+
+    def delete(self, path: str, **kw) -> Response:
+        return self.request("DELETE", path, **kw)

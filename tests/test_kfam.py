@@ -1,0 +1,209 @@
+"""kfam access-management API: profiles, contributor bindings, authz."""
+
+import pytest
+
+from kubeflow_tpu.api.rbac import (
+    make_cluster_role_binding,
+    seed_cluster_roles,
+    subject_access_review,
+)
+from kubeflow_tpu.apps.kfam import KfamApp
+from kubeflow_tpu.controllers.profile import ProfileController
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.web import TestClient
+
+HDR = "x-goog-authenticated-user-email"
+
+
+def client(app, user):
+    return TestClient(app, headers={HDR: f"accounts.google.com:{user}"})
+
+
+@pytest.fixture
+def world():
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    api.create(make_cluster_role_binding("admin", "kubeflow-admin", "admin@x.co"))
+    ctl = ProfileController(api)
+    app = KfamApp(api)
+    return api, ctl, app
+
+
+def test_create_profile_self_service(world):
+    api, ctl, app = world
+    r = client(app, "alice@x.co").post(
+        "/kfam/v1/profiles", body={"metadata": {"name": "alice"}}
+    )
+    assert r.status == 200, r.body
+    ctl.controller.run_until_idle()
+    assert api.get("Namespace", "alice", "").metadata.annotations["owner"] == (
+        "alice@x.co"
+    )
+
+
+def test_cannot_create_profile_for_other_user(world):
+    _, _, app = world
+    r = client(app, "mallory@x.co").post(
+        "/kfam/v1/profiles",
+        body={
+            "metadata": {"name": "victim"},
+            "spec": {"owner": {"kind": "User", "name": "alice@x.co"}},
+        },
+    )
+    assert r.status == 403
+
+
+def test_admin_can_create_for_other_user(world):
+    api, ctl, app = world
+    r = client(app, "admin@x.co").post(
+        "/kfam/v1/profiles",
+        body={
+            "metadata": {"name": "bob"},
+            "spec": {"owner": {"kind": "User", "name": "bob@x.co"}},
+        },
+    )
+    assert r.status == 200
+    assert api.get("Profile", "bob").spec["owner"]["name"] == "bob@x.co"
+
+
+def test_contributor_binding_lifecycle(world):
+    api, ctl, app = world
+    client(app, "alice@x.co").post(
+        "/kfam/v1/profiles", body={"metadata": {"name": "alice"}}
+    )
+    ctl.controller.run_until_idle()
+
+    # Owner shares her namespace with bob as editor.
+    binding = {
+        "user": {"kind": "User", "name": "bob@x.co"},
+        "referredNamespace": "alice",
+        "roleRef": {"kind": "ClusterRole", "name": "edit"},
+    }
+    r = client(app, "alice@x.co").post("/kfam/v1/bindings", body=binding)
+    assert r.status == 200, r.body
+
+    # The pair exists: RBAC + mesh policy (bindings.go:76-128 parity).
+    assert subject_access_review(api, "bob@x.co", "create", "notebooks", "alice")
+    [ap] = api.list("AuthorizationPolicy", "alice")
+    assert ap.spec["rules"][0]["from"][0]["source"]["principals"] == ["bob@x.co"]
+
+    listed = client(app, "alice@x.co").get("/kfam/v1/bindings?namespace=alice")
+    assert [b["user"]["name"] for b in listed.json()["bindings"]] == ["bob@x.co"]
+
+    # DELETE requires the binding in the body; bodyless is a 400.
+    assert client(app, "alice@x.co").delete("/kfam/v1/bindings").status == 400
+    r = client(app, "alice@x.co").request(
+        "DELETE", "/kfam/v1/bindings", body=binding
+    )
+    assert r.status == 200
+    assert not subject_access_review(
+        api, "bob@x.co", "create", "notebooks", "alice"
+    )
+    assert api.list("AuthorizationPolicy", "alice") == []
+
+
+def test_non_owner_cannot_bind(world):
+    api, ctl, app = world
+    client(app, "alice@x.co").post(
+        "/kfam/v1/profiles", body={"metadata": {"name": "alice"}}
+    )
+    ctl.controller.run_until_idle()
+    r = client(app, "mallory@x.co").post(
+        "/kfam/v1/bindings",
+        body={
+            "user": {"kind": "User", "name": "mallory@x.co"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "edit"},
+        },
+    )
+    assert r.status == 403
+
+
+def test_query_cluster_admin(world):
+    _, _, app = world
+    assert client(app, "admin@x.co").get("/kfam/v1/role/clusteradmin").json() is True
+    assert (
+        client(app, "alice@x.co")
+        .get("/kfam/v1/role/clusteradmin?user=alice@x.co")
+        .json()
+        is False
+    )
+
+
+def test_profile_delete_cascades_contributor_bindings(world):
+    """Deleting a profile must not leave grants behind for a future
+    same-named profile (the bindings are owner-ref'd to the Namespace)."""
+    api, ctl, app = world
+    client(app, "alice@x.co").post(
+        "/kfam/v1/profiles", body={"metadata": {"name": "team"}}
+    )
+    ctl.controller.run_until_idle()
+    client(app, "alice@x.co").post(
+        "/kfam/v1/bindings",
+        body={
+            "user": {"kind": "User", "name": "bob@x.co"},
+            "referredNamespace": "team",
+            "roleRef": {"kind": "ClusterRole", "name": "edit"},
+        },
+    )
+    assert subject_access_review(api, "bob@x.co", "create", "notebooks", "team")
+
+    r = client(app, "alice@x.co").delete("/kfam/v1/profiles/team")
+    assert r.status == 200
+    ctl.controller.run_until_idle()
+    assert api.list("RoleBinding", "team") == []
+    assert api.list("AuthorizationPolicy", "team") == []
+    assert not subject_access_review(
+        api, "bob@x.co", "create", "notebooks", "team"
+    )
+
+
+def test_read_bindings_scoped_for_non_admins(world):
+    api, ctl, app = world
+    client(app, "alice@x.co").post(
+        "/kfam/v1/profiles", body={"metadata": {"name": "alice"}}
+    )
+    ctl.controller.run_until_idle()
+    # Unscoped enumeration by a non-admin is forbidden.
+    assert client(app, "mallory@x.co").get("/kfam/v1/bindings").status == 403
+    # Your own bindings are always visible; admins see everything.
+    assert (
+        client(app, "mallory@x.co")
+        .get("/kfam/v1/bindings?user=mallory@x.co")
+        .status
+        == 200
+    )
+    assert client(app, "admin@x.co").get("/kfam/v1/bindings").status == 200
+
+
+def test_binding_names_do_not_collide(world):
+    from kubeflow_tpu.apps.kfam import _binding_name
+
+    assert _binding_name("bob@x.co", "edit") != _binding_name("bob.x.co", "edit")
+
+
+def test_client_cannot_override_owner_via_spec(world):
+    api, ctl, app = world
+    r = client(app, "alice@x.co").post(
+        "/kfam/v1/profiles",
+        body={"metadata": {"name": "sneaky"}, "spec": {"owner": None}},
+    )
+    assert r.status == 200
+    assert api.get("Profile", "sneaky").spec["owner"]["name"] == "alice@x.co"
+
+
+def test_unsupported_role_rejected(world):
+    _, ctl, app = world
+    client(app, "alice@x.co").post(
+        "/kfam/v1/profiles", body={"metadata": {"name": "alice"}}
+    )
+    ctl.controller.run_until_idle()
+    r = client(app, "alice@x.co").post(
+        "/kfam/v1/bindings",
+        body={
+            "user": {"kind": "User", "name": "bob@x.co"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": "admin"},
+        },
+    )
+    assert r.status == 400

@@ -1,0 +1,175 @@
+"""Web core: routing, envelopes, authn, RBAC/SAR authz."""
+
+import json
+
+from kubeflow_tpu.api.rbac import (
+    is_cluster_admin,
+    make_cluster_role_binding,
+    namespaces_for,
+    seed_cluster_roles,
+    subject_access_review,
+)
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web import (
+    App,
+    HeaderAuthn,
+    TestClient,
+    ensure_authorized,
+    json_response,
+    success_response,
+)
+
+
+def make_app():
+    app = App("t")
+
+    @app.route("/api/items/<name>", methods=("GET",))
+    def get_item(req):
+        return json_response({"name": req.path_params["name"]})
+
+    @app.route("/api/items", methods=("POST",))
+    def post_item(req):
+        return success_response("item", req.json())
+
+    return app
+
+
+def test_routing_and_path_params():
+    c = TestClient(make_app())
+    assert c.get("/api/items/abc").json()["name"] == "abc"
+    r = c.post("/api/items", body={"x": 1})
+    assert r.json() == {"success": True, "status": 200, "item": {"x": 1}}
+
+
+def test_404_405_and_bad_json():
+    c = TestClient(make_app())
+    assert c.get("/nope").status == 404
+    assert c.delete("/api/items/abc").status == 405
+    r = c.request("POST", "/api/items", body=None)
+    assert r.status == 200  # empty body -> {}
+    app = make_app()
+
+    @app.route("/echo", methods=("POST",))
+    def echo(req):
+        return json_response(req.json())
+
+    raw = TestClient(app)
+    resp = raw.request("POST", "/echo", body=None)
+    assert resp.status == 200
+
+
+def test_storage_errors_map_to_http():
+    api = FakeApiServer()
+    app = App("t")
+
+    @app.route("/missing")
+    def missing(req):
+        return json_response(api.get("Pod", "nope").to_dict())
+
+    c = TestClient(app)
+    r = c.get("/missing")
+    assert r.status == 404
+    assert r.json()["success"] is False
+
+
+def test_healthz_skips_authn():
+    app = make_app()
+    app.before_request(HeaderAuthn())
+    c = TestClient(app)
+    assert c.get("/healthz").status == 200
+    assert c.get("/api/items/x").status == 401
+
+
+def test_authn_prefix_strip():
+    app = App("t")
+    app.before_request(HeaderAuthn())
+
+    @app.route("/whoami")
+    def whoami(req):
+        return json_response({"user": req.user})
+
+    c = TestClient(
+        app,
+        headers={
+            "x-goog-authenticated-user-email": "accounts.google.com:a@b.co"
+        },
+    )
+    assert c.get("/whoami").json()["user"] == "a@b.co"
+
+
+def rbac_api():
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    api.create(new_resource("Namespace", "team-a", ""))
+    api.create(new_resource("Namespace", "team-b", ""))
+    return api
+
+
+def test_cluster_admin_binding():
+    api = rbac_api()
+    api.create(make_cluster_role_binding("admin-alice", "kubeflow-admin", "alice"))
+    assert is_cluster_admin(api, "alice")
+    assert not is_cluster_admin(api, "bob")
+    assert subject_access_review(api, "alice", "delete", "notebooks", "team-a")
+
+
+def test_namespace_rolebinding_scopes_access():
+    api = rbac_api()
+    api.create(
+        new_resource(
+            "RoleBinding",
+            "edit-bob",
+            "team-a",
+            spec={
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+                "subjects": [{"kind": "User", "name": "bob"}],
+            },
+        )
+    )
+    assert subject_access_review(api, "bob", "create", "notebooks", "team-a")
+    assert not subject_access_review(api, "bob", "create", "notebooks", "team-b")
+    assert namespaces_for(api, "bob") == ["team-a"]
+
+
+def test_view_role_denies_writes():
+    api = rbac_api()
+    api.create(
+        new_resource(
+            "RoleBinding",
+            "view-eve",
+            "team-a",
+            spec={
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-view"},
+                "subjects": [{"kind": "User", "name": "eve"}],
+            },
+        )
+    )
+    assert subject_access_review(api, "eve", "list", "notebooks", "team-a")
+    assert not subject_access_review(api, "eve", "delete", "notebooks", "team-a")
+
+
+def test_ensure_authorized_raises():
+    import pytest
+
+    from kubeflow_tpu.web import Forbidden
+
+    api = rbac_api()
+    with pytest.raises(Forbidden):
+        ensure_authorized(api, "mallory", "create", "notebooks", "team-a")
+
+
+def test_real_http_roundtrip():
+    """serve() binds a real socket; exercise one request through it."""
+    import urllib.request
+
+    from kubeflow_tpu.web.wsgi import serve
+
+    app = make_app()
+    server, _ = serve(app, host="127.0.0.1", port=0)
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/api/items/net"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert json.loads(resp.read())["name"] == "net"
+    finally:
+        server.shutdown()
