@@ -1,0 +1,84 @@
+"""Platform-in-a-box launcher: all backends against one API server.
+
+The reference deploys each web app as its own pod behind the mesh gateway;
+for local development and E2E tests we boot the same set in one process:
+
+    python -m kubeflow_tpu.apps [--port-base 8080] [--anonymous me@x.co]
+
+Ports: base+0 dashboard, +1 kfam, +2 jupyter, +3 tensorboards.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+from kubeflow_tpu.api.rbac import make_cluster_role_binding, seed_cluster_roles
+from kubeflow_tpu.apps.dashboard import DashboardApp
+from kubeflow_tpu.apps.jupyter import JupyterApp
+from kubeflow_tpu.apps.kfam import KfamApp
+from kubeflow_tpu.apps.tensorboards import TensorboardsApp
+from kubeflow_tpu.controllers import poddefault
+from kubeflow_tpu.controllers.notebook import NotebookController
+from kubeflow_tpu.controllers.profile import ProfileController
+from kubeflow_tpu.controllers.runtime import ControllerManager
+from kubeflow_tpu.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.authn import HeaderAuthn
+from kubeflow_tpu.web.wsgi import serve
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port-base", type=int, default=8080)
+    parser.add_argument(
+        "--anonymous",
+        default=None,
+        help="dev-mode user for unauthenticated requests "
+        "(crud_backend config.py dev mode)",
+    )
+    parser.add_argument(
+        "--admin", default=None, help="grant this user cluster-admin"
+    )
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    if args.admin:
+        api.create(make_cluster_role_binding("boot-admin", "kubeflow-admin", args.admin))
+
+    manager = ControllerManager()
+    for ctl in (
+        ProfileController(api),
+        NotebookController(api),
+        TensorboardController(api),
+    ):
+        manager.add(ctl.controller)
+    poddefault.register(api)
+    manager.start()
+
+    authn = HeaderAuthn(anonymous=args.anonymous)
+    apps = [
+        DashboardApp(api, authn=authn),
+        KfamApp(api, authn=authn),
+        JupyterApp(api, authn=authn),
+        TensorboardsApp(api, authn=authn),
+    ]
+    servers = []
+    for offset, app in enumerate(apps):
+        server, _ = serve(app, host=args.host, port=args.port_base + offset)
+        servers.append(server)
+        print(f"{app.name}: http://{args.host}:{server.server_port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        for server in servers:
+            server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,326 @@
+"""Notebook spawner backend — the jupyter-web-app analog.
+
+Parity with `components/jupyter-web-app/backend/` and
+`crud-web-apps/jupyter/backend/` (SURVEY.md §2 #13/#16):
+
+- GET  `/api/config` — the admin spawner form config
+  (`base_app.py:22-50`, `spawner_ui_config.yaml`);
+- GET  `/api/namespaces/<ns>/notebooks` — list with mirrored status
+  (`crud-web-apps/jupyter/.../get.py:42`);
+- POST `/api/namespaces/<ns>/notebooks` — form → Notebook CR + PVCs
+  (`default/app.py:13-76`, transforms `common/utils.py:359-586`);
+- PATCH `.../notebooks/<name>` — stop/start via the culler's
+  `kubeflow-resource-stopped` annotation (`patch.py`);
+- DELETE `.../notebooks/<name>`;
+- GET  `/api/namespaces/<ns>/pvcs`, `/api/namespaces/<ns>/poddefaults`,
+  `/api/storageclasses` — form data sources (`common/api.py:81-197`).
+
+Every handler is SAR-guarded per (verb, resource, namespace) exactly like
+`common/auth.py:41-106`.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import time
+
+import yaml
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.controllers.notebook import STOP_ANNOTATION
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web import (
+    App,
+    HeaderAuthn,
+    HttpError,
+    Request,
+    Response,
+    ensure_authorized,
+    success_response,
+)
+
+CONFIG_PATH = pathlib.Path(__file__).parent / "config" / "spawner_ui_config.yaml"
+TPU_RESOURCE = "google.com/tpu"
+TOPOLOGY_SELECTOR = "cloud.google.com/tpu-topology"
+
+
+def load_spawner_config(path: pathlib.Path | str = CONFIG_PATH) -> dict:
+    with open(path) as f:
+        return yaml.safe_load(f)["spawnerFormDefaults"]
+
+
+class JupyterApp(App):
+    def __init__(
+        self,
+        api: FakeApiServer,
+        *,
+        config_path: pathlib.Path | str = CONFIG_PATH,
+        authn: HeaderAuthn | None = None,
+    ):
+        super().__init__("jupyter")
+        self.api = api
+        self.config = load_spawner_config(config_path)
+        self.before_request(authn or HeaderAuthn())
+        self.add_route("/api/config", self.get_config)
+        self.add_route("/api/namespaces/<ns>/notebooks", self.list_notebooks)
+        self.add_route(
+            "/api/namespaces/<ns>/notebooks", self.post_notebook, ("POST",)
+        )
+        self.add_route(
+            "/api/namespaces/<ns>/notebooks/<name>",
+            self.patch_notebook,
+            ("PATCH",),
+        )
+        self.add_route(
+            "/api/namespaces/<ns>/notebooks/<name>",
+            self.delete_notebook,
+            ("DELETE",),
+        )
+        self.add_route("/api/namespaces/<ns>/pvcs", self.list_pvcs)
+        self.add_route(
+            "/api/namespaces/<ns>/poddefaults", self.list_poddefaults
+        )
+        self.add_route("/api/storageclasses", self.list_storageclasses)
+
+    # -- reads -------------------------------------------------------------
+
+    def get_config(self, req: Request) -> Response:
+        return success_response("config", self.config)
+
+    def list_notebooks(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "list", "notebooks", ns)
+        items = []
+        for nb in self.api.list("Notebook", ns):
+            items.append(
+                {
+                    "name": nb.metadata.name,
+                    "namespace": ns,
+                    "image": nb.spec.get("image"),
+                    "shortImage": str(nb.spec.get("image", "")).split("/")[-1],
+                    "cpu": nb.spec.get("resources", {})
+                    .get("requests", {})
+                    .get("cpu"),
+                    "memory": nb.spec.get("resources", {})
+                    .get("requests", {})
+                    .get("memory"),
+                    "tpus": nb.spec.get("resources", {})
+                    .get("limits", {})
+                    .get(TPU_RESOURCE, 0),
+                    "status": self._status_phase(nb),
+                    "reason": nb.status.get("containerState", ""),
+                    "age": nb.metadata.creation_timestamp,
+                    "volumes": [
+                        v.get("name") for v in nb.spec.get("volumes", [])
+                    ],
+                    "serverType": "jupyter",
+                }
+            )
+        return success_response("notebooks", items)
+
+    @staticmethod
+    def _status_phase(nb) -> str:
+        # The frontend's row-status mapping (crud-web-apps status utils):
+        # stopped > ready > waiting.
+        if STOP_ANNOTATION in nb.metadata.annotations:
+            return "stopped"
+        if nb.status.get("readyReplicas", 0) > 0:
+            return "running"
+        return "waiting"
+
+    def list_pvcs(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "list", "persistentvolumeclaims", ns)
+        pvcs = [
+            {
+                "name": p.metadata.name,
+                "size": p.spec.get("resources", {})
+                .get("requests", {})
+                .get("storage"),
+                "mode": (p.spec.get("accessModes") or [""])[0],
+            }
+            for p in self.api.list("PersistentVolumeClaim", ns)
+        ]
+        return success_response("pvcs", pvcs)
+
+    def list_poddefaults(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "list", "poddefaults", ns)
+        pds = [
+            {
+                "label": pd.spec.get("selector", {}).get("matchLabels", {}),
+                "desc": pd.spec.get("desc", pd.metadata.name),
+                "name": pd.metadata.name,
+            }
+            for pd in self.api.list("PodDefault", ns)
+        ]
+        return success_response("poddefaults", pds)
+
+    def list_storageclasses(self, req: Request) -> Response:
+        ensure_authorized(self.api, req.user, "list", "storageclasses", "")
+        return success_response(
+            "storageclasses",
+            [sc.metadata.name for sc in self.api.list("StorageClass", "")],
+        )
+
+    # -- create ------------------------------------------------------------
+
+    def post_notebook(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "create", "notebooks", ns)
+        body = req.json()
+        name = body.get("name")
+        if not name:
+            raise HttpError(400, "notebook needs a name")
+
+        spec: dict = {}
+        self._set_image(spec, body)
+        self._set_resources(spec, body)
+        self._set_volumes(spec, body, ns, name)
+        self._set_scheduling(spec, body)
+        self._set_configurations(spec, body)
+
+        nb = new_resource(
+            "Notebook",
+            name,
+            ns,
+            spec=spec,
+            labels={"app": name},
+        )
+        self.api.create(nb)
+        return success_response("notebook", nb.to_dict())
+
+    def _form_default(self, field: str, body: dict):
+        """Honor readOnly: a pinned field ignores the client's value
+        (`utils.py` checks `readOnly` before every set_notebook_*)."""
+        cfg = self.config.get(field, {})
+        if cfg.get("readOnly"):
+            return cfg.get("value")
+        return body.get(field, cfg.get("value"))
+
+    def _set_image(self, spec: dict, body: dict) -> None:
+        image = body.get("customImage") or self._form_default("image", body)
+        spec["image"] = image
+
+    def _set_resources(self, spec: dict, body: dict) -> None:
+        cpu = str(self._form_default("cpu", body))
+        memory = str(self._form_default("memory", body))
+        requests = {"cpu": cpu, "memory": memory}
+        limits: dict = {}
+        tpu = str(self._form_default("tpu", body) or "none")
+        if tpu not in ("none", "0", "None"):
+            # TPU chips are limits-only and integral, like the reference's
+            # `nvidia.com/gpu` (`utils.py set_notebook_gpus`,
+            # `create_job_specs.py:168`).
+            limits[TPU_RESOURCE] = int(tpu)
+            topology = body.get("tpuTopology", "")
+            if topology:
+                spec.setdefault("nodeSelector", {})[
+                    TOPOLOGY_SELECTOR
+                ] = topology
+        spec["resources"] = {"requests": requests}
+        if limits:
+            spec["resources"]["limits"] = limits
+
+    def _set_volumes(
+        self, spec: dict, body: dict, ns: str, name: str
+    ) -> None:
+        """Workspace + data volumes; type New creates the PVC
+        (`default/app.py:36-68` → `common/api.py:174`)."""
+        volumes: list[dict] = []
+        mounts: list[dict] = []
+        ws = self._form_default("workspaceVolume", body)
+        vols = [ws] if ws else []
+        vols += list(body.get("dataVolumes") or [])
+        for vol in vols:
+            vol_name = str(vol.get("name", "")).replace("{name}", name)
+            if not vol_name:
+                continue
+            if vol.get("type", "New") == "New":
+                pvc = new_resource(
+                    "PersistentVolumeClaim",
+                    vol_name,
+                    ns,
+                    spec={
+                        "accessModes": [vol.get("accessMode", "ReadWriteOnce")],
+                        "resources": {
+                            "requests": {"storage": vol.get("size", "10Gi")}
+                        },
+                    },
+                )
+                if body.get("storageClass"):
+                    pvc.spec["storageClassName"] = body["storageClass"]
+                try:
+                    self.api.create(pvc)
+                except Exception:
+                    # Existing PVC with the same name: reuse it (the
+                    # reference 409s inside a loop and carries on).
+                    pass
+            volumes.append(
+                {
+                    "name": vol_name,
+                    "persistentVolumeClaim": {"claimName": vol_name},
+                }
+            )
+            mounts.append(
+                {
+                    "name": vol_name,
+                    "mountPath": vol.get("mountPath", f"/data/{vol_name}"),
+                }
+            )
+        if self._form_default("shm", body):
+            # set_notebook_shm: a memory-backed emptyDir on /dev/shm.
+            volumes.append(
+                {"name": "dshm", "emptyDir": {"medium": "Memory"}}
+            )
+            mounts.append({"name": "dshm", "mountPath": "/dev/shm"})
+        if volumes:
+            spec["volumes"] = volumes
+            spec["volumeMounts"] = mounts
+
+    def _set_scheduling(self, spec: dict, body: dict) -> None:
+        group = self._form_default("tolerationGroup", body)
+        if isinstance(group, str) and group:
+            for option in self.config.get("tolerationGroup", {}).get(
+                "options", []
+            ):
+                if option.get("group") == group:
+                    spec["tolerations"] = option.get("tolerations", [])
+        affinity = self._form_default("affinityConfig", body)
+        if isinstance(affinity, dict) and affinity:
+            spec["affinity"] = affinity
+
+    def _set_configurations(self, spec: dict, body: dict) -> None:
+        """PodDefault labels (`utils.py set_notebook_configurations`)."""
+        labels = {}
+        for conf in self._form_default("configurations", body) or []:
+            labels[str(conf)] = "true"
+        if labels:
+            spec["podLabels"] = labels
+
+    # -- mutate/delete -----------------------------------------------------
+
+    def patch_notebook(self, req: Request) -> Response:
+        ns, name = req.path_params["ns"], req.path_params["name"]
+        ensure_authorized(self.api, req.user, "update", "notebooks", ns)
+        body = req.json()
+        if "stopped" not in body:
+            raise HttpError(400, "PATCH body needs {'stopped': bool}")
+        nb = self.api.get("Notebook", name, ns)
+        if body["stopped"]:
+            nb.metadata.annotations.setdefault(
+                STOP_ANNOTATION, str(time.time())
+            )
+        else:
+            nb.metadata.annotations.pop(STOP_ANNOTATION, None)
+        self.api.update(nb)
+        return success_response()
+
+    def delete_notebook(self, req: Request) -> Response:
+        ns, name = req.path_params["ns"], req.path_params["name"]
+        ensure_authorized(self.api, req.user, "delete", "notebooks", ns)
+        self.api.delete("Notebook", name, ns)
+        return success_response()
+
+
+__all__ = ["JupyterApp", "load_spawner_config", "TPU_RESOURCE"]

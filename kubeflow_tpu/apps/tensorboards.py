@@ -1,0 +1,83 @@
+"""Tensorboard CRUD backend — `crud-web-apps/tensorboards` analog.
+
+Parity with `crud-web-apps/tensorboards/backend/app/` (SURVEY.md §2 #17):
+list/create/delete `Tensorboard` CRs plus the PVC listing the create form
+needs (routes `get.py:9-28`, `post.py:14-38`, CR builder `utils.py:34`).
+`logspath` points at a PVC (`pvc://<claim>/<subpath>`) or cloud storage
+(`gs://...`) — for TPU training jobs this is where `jax.profiler` trace
+dirs land, so serving them through Tensorboard is the platform's profiling
+story (SURVEY.md §5, tracing row).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web import (
+    App,
+    HeaderAuthn,
+    HttpError,
+    Request,
+    Response,
+    ensure_authorized,
+    success_response,
+)
+
+
+class TensorboardsApp(App):
+    def __init__(self, api: FakeApiServer, *, authn: HeaderAuthn | None = None):
+        super().__init__("tensorboards")
+        self.api = api
+        self.before_request(authn or HeaderAuthn())
+        self.add_route("/api/namespaces/<ns>/tensorboards", self.list_tbs)
+        self.add_route(
+            "/api/namespaces/<ns>/tensorboards", self.post_tb, ("POST",)
+        )
+        self.add_route(
+            "/api/namespaces/<ns>/tensorboards/<name>",
+            self.delete_tb,
+            ("DELETE",),
+        )
+        self.add_route("/api/namespaces/<ns>/pvcs", self.list_pvcs)
+
+    def list_tbs(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "list", "tensorboards", ns)
+        items = [
+            {
+                "name": tb.metadata.name,
+                "namespace": ns,
+                "logspath": tb.spec.get("logspath", ""),
+                "age": tb.metadata.creation_timestamp,
+                "status": "ready"
+                if tb.status.get("readyReplicas", 0) > 0
+                else "waiting",
+            }
+            for tb in self.api.list("Tensorboard", ns)
+        ]
+        return success_response("tensorboards", items)
+
+    def post_tb(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "create", "tensorboards", ns)
+        body = req.json()
+        name, logspath = body.get("name"), body.get("logspath")
+        if not name or not logspath:
+            raise HttpError(400, "tensorboard needs name and logspath")
+        tb = new_resource("Tensorboard", name, ns, spec={"logspath": logspath})
+        self.api.create(tb)
+        return success_response("tensorboard", tb.to_dict())
+
+    def delete_tb(self, req: Request) -> Response:
+        ns, name = req.path_params["ns"], req.path_params["name"]
+        ensure_authorized(self.api, req.user, "delete", "tensorboards", ns)
+        self.api.delete("Tensorboard", name, ns)
+        return success_response()
+
+    def list_pvcs(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "list", "persistentvolumeclaims", ns)
+        return success_response(
+            "pvcs",
+            [p.metadata.name for p in self.api.list("PersistentVolumeClaim", ns)],
+        )

@@ -108,6 +108,10 @@ class NotebookController:
 
     def _desired_sts(self, nb: Resource) -> Resource:
         stopped = STOP_ANNOTATION in nb.metadata.annotations
+        # The Notebook spec embeds pod-template fields the spawner sets
+        # (volumes, env, tolerations, affinity, shm) — the reference CRD
+        # carries a full PodSpec (`notebook_types.go:30-85`, populated by
+        # `jupyter-web-app/.../utils.py:359-586`).
         container = {
             "name": "notebook",
             "image": nb.spec.get("image", DEFAULT_IMAGE),
@@ -117,10 +121,25 @@ class NotebookController:
                     "name": "NB_PREFIX",
                     "value": route_prefix(nb),
                 }
-            ],
+            ]
+            + list(nb.spec.get("env", [])),
             "ports": [{"containerPort": DEFAULT_PORT}],
             "resources": nb.spec.get("resources", {}),
         }
+        if nb.spec.get("volumeMounts"):
+            container["volumeMounts"] = list(nb.spec["volumeMounts"])
+        pod_spec: dict = {"containers": [container]}
+        for field in ("volumes", "tolerations", "affinity", "nodeSelector"):
+            if nb.spec.get(field):
+                pod_spec[field] = nb.spec[field]
+        template_meta: dict = {"labels": {"notebook": nb.metadata.name}}
+        # PodDefault selection labels flow onto the pod template so the
+        # admission webhook can match them (`poddefault_types.go` selector).
+        extra_labels = nb.spec.get("podLabels", {})
+        template_meta["labels"].update(extra_labels)
+        # The selector label is reserved — a user-chosen podLabel must not
+        # break the STS selector / Service routing / pod lookup.
+        template_meta["labels"]["notebook"] = nb.metadata.name
         sts = new_resource(
             "StatefulSet",
             nb.metadata.name,
@@ -129,8 +148,8 @@ class NotebookController:
                 "replicas": 0 if stopped else 1,
                 "selector": {"matchLabels": {"notebook": nb.metadata.name}},
                 "template": {
-                    "metadata": {"labels": {"notebook": nb.metadata.name}},
-                    "spec": {"containers": [container]},
+                    "metadata": template_meta,
+                    "spec": pod_spec,
                 },
             },
             labels={"notebook": nb.metadata.name},

@@ -11,6 +11,7 @@ Flask apps the same way, via `app.test_client()`).
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import re
@@ -25,18 +26,6 @@ from kubeflow_tpu.testing import fake_apiserver as storage
 
 log = logging.getLogger(__name__)
 
-_STATUS_REASON = {
-    200: "OK",
-    201: "Created",
-    204: "No Content",
-    400: "Bad Request",
-    401: "Unauthorized",
-    403: "Forbidden",
-    404: "Not Found",
-    405: "Method Not Allowed",
-    409: "Conflict",
-    500: "Internal Server Error",
-}
 
 
 class HttpError(Exception):
@@ -103,7 +92,7 @@ class Response:
 
     @property
     def status_line(self) -> str:
-        return f"{self.status} {_STATUS_REASON.get(self.status, 'Unknown')}"
+        return f"{self.status} {http.client.responses.get(self.status, 'Unknown')}"
 
     def json(self) -> dict:
         return json.loads(self.body)
@@ -191,6 +180,13 @@ class App:
             return error_response(500, f"internal error: {e}")
 
     def _dispatch(self, req: Request) -> Response:
+        # Hooks run on EVERY request, matched or not (crud_backend's
+        # global before_request): unauthenticated clients must not be able
+        # to probe the route table via 404-vs-405 responses.
+        for hook in self._before:
+            resp = hook(req)
+            if resp is not None:
+                return resp
         matched_path = False
         for route in self._routes:
             m = route.regex.match(req.path)
@@ -200,10 +196,6 @@ class App:
             if req.method not in route.methods:
                 continue
             req.path_params = m.groupdict()
-            for hook in self._before:
-                resp = hook(req)
-                if resp is not None:
-                    return resp
             return route.handler(req)
         if matched_path:
             raise HttpError(405, f"{req.method} not allowed on {req.path}")

@@ -1,0 +1,119 @@
+"""Central dashboard API: namespaces, activities, metrics, workgroup flow."""
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import (
+    make_cluster_role_binding,
+    seed_cluster_roles,
+)
+from kubeflow_tpu.apps.dashboard import DashboardApp
+from kubeflow_tpu.controllers.profile import ProfileController
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.web import TestClient
+
+HDR = "x-goog-authenticated-user-email"
+
+
+def client(app, user):
+    return TestClient(app, headers={HDR: f"accounts.google.com:{user}"})
+
+
+@pytest.fixture
+def world():
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    api.create(make_cluster_role_binding("adm", "kubeflow-admin", "admin@x.co"))
+    ctl = ProfileController(api)
+    app = DashboardApp(api)
+    return api, ctl, app
+
+
+def test_registration_flow(world):
+    """§3.4: exists → create → profile controller provisions → env-info."""
+    api, ctl, app = world
+    c = client(app, "alice@x.co")
+
+    assert c.get("/api/workgroup/exists").json()["hasWorkgroup"] is False
+    r = c.post("/api/workgroup/create", body={})
+    assert r.status == 200
+    assert r.json()["namespace"] == "alice"
+    ctl.controller.run_until_idle()
+
+    info = c.get("/api/workgroup/env-info").json()
+    assert info["hasWorkgroup"] is True
+    assert info["namespaces"] == ["alice"]
+    assert info["isClusterAdmin"] is False
+    assert c.get("/api/namespaces").json() == ["alice"]
+
+
+def test_activities_surface_events(world):
+    api, ctl, app = world
+    c = client(app, "alice@x.co")
+    c.post("/api/workgroup/create", body={})
+    ctl.controller.run_until_idle()
+    nb = api.create(new_resource("Notebook", "nb", "alice"))
+    api.record_event(nb, "Created", "notebook created")
+
+    acts = c.get("/api/activities/alice").json()
+    assert acts and acts[0]["reason"] == "Created"
+
+
+def test_metrics_series(world):
+    api, _, app = world
+    node = new_resource("Node", "tpu-node-0", "")
+    api.create(node)
+    node = api.get("Node", "tpu-node-0", "")
+    node.status = {
+        "cpuUtilization": 0.4,
+        "memoryUtilization": 0.6,
+        "tpuDutyCycle": 0.95,
+    }
+    api.update_status(node)
+    c = client(app, "alice@x.co")
+    [pt] = c.get("/api/metrics/tpuduty").json()
+    assert pt["value"] == 0.95
+    assert c.get("/api/metrics/bogus").status == 400
+
+
+def test_dashboard_links_configmap_override(world):
+    api, _, app = world
+    c = client(app, "alice@x.co")
+    links = c.get("/api/dashboard-links").json()
+    assert any("/jupyter/" in m["link"] for m in links["menuLinks"])
+
+    api.create(
+        new_resource(
+            "ConfigMap",
+            "dashboard-links",
+            "kubeflow",
+            spec={"data": {"menuLinks": [{"link": "/custom/", "text": "X"}]}},
+        )
+    )
+    links = c.get("/api/dashboard-links").json()
+    assert links["menuLinks"][0]["link"] == "/custom/"
+
+
+def test_nuke_self_removes_profiles(world):
+    api, ctl, app = world
+    c = client(app, "alice@x.co")
+    c.post("/api/workgroup/create", body={})
+    ctl.controller.run_until_idle()
+    assert c.request("DELETE", "/api/workgroup/nuke-self").status == 200
+    ctl.controller.run_until_idle()
+    assert api.list("Profile") == []
+    assert c.get("/api/workgroup/exists").json()["hasWorkgroup"] is False
+    assert c.request("DELETE", "/api/workgroup/nuke-self").status == 404
+
+
+def test_all_namespaces_admin_only(world):
+    api, ctl, app = world
+    client(app, "alice@x.co").post("/api/workgroup/create", body={})
+    ctl.controller.run_until_idle()
+    assert client(app, "alice@x.co").get(
+        "/api/workgroup/get-all-namespaces"
+    ).status == 403
+    rows = client(app, "admin@x.co").get(
+        "/api/workgroup/get-all-namespaces"
+    ).json()
+    assert ["alice", "alice@x.co"] in rows

@@ -1,0 +1,199 @@
+"""Spawner backend: form → Notebook CR + PVCs → reconciled StatefulSet."""
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import seed_cluster_roles
+from kubeflow_tpu.apps.jupyter import TPU_RESOURCE, JupyterApp
+from kubeflow_tpu.apps.tensorboards import TensorboardsApp
+from kubeflow_tpu.controllers.notebook import NotebookController
+from kubeflow_tpu.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.testing import FakeApiServer, NotFound
+from kubeflow_tpu.web import TestClient
+
+HDR = "x-goog-authenticated-user-email"
+USER = "alice@x.co"
+
+
+@pytest.fixture
+def world():
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    api.create(new_resource("Namespace", "team", ""))
+    api.create(
+        new_resource(
+            "RoleBinding",
+            "edit-alice",
+            "team",
+            spec={
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+                "subjects": [{"kind": "User", "name": USER}],
+            },
+        )
+    )
+    nb_ctl = NotebookController(api)
+    app = JupyterApp(api)
+    client = TestClient(app, headers={HDR: f"accounts.google.com:{USER}"})
+    return api, nb_ctl, client
+
+
+def test_config_served(world):
+    _, _, client = world
+    cfg = client.get("/api/config").json()["config"]
+    assert cfg["tpu"]["resource"] == TPU_RESOURCE
+    assert cfg["image"]["options"]
+
+
+def test_spawn_creates_cr_pvc_and_sts(world):
+    api, ctl, client = world
+    r = client.post(
+        "/api/namespaces/team/notebooks",
+        body={
+            "name": "nb1",
+            "image": "kubeflow-tpu/jax-notebook:0.4-tpu",
+            "cpu": "2",
+            "memory": "4Gi",
+            "tpu": "4",
+            "tpuTopology": "2x2",
+            "dataVolumes": [
+                {"type": "New", "name": "scratch", "size": "5Gi",
+                 "mountPath": "/scratch"}
+            ],
+        },
+    )
+    assert r.status == 200, r.body
+    # PVCs: templated workspace + data volume (default/app.py:36-68).
+    assert api.get("PersistentVolumeClaim", "nb1-workspace", "team")
+    scratch = api.get("PersistentVolumeClaim", "scratch", "team")
+    assert scratch.spec["resources"]["requests"]["storage"] == "5Gi"
+
+    nb = api.get("Notebook", "nb1", "team")
+    assert nb.spec["resources"]["limits"][TPU_RESOURCE] == 4
+    assert nb.spec["nodeSelector"]["cloud.google.com/tpu-topology"] == "2x2"
+
+    ctl.controller.run_until_idle()
+    sts = api.get("StatefulSet", "nb1", "team")
+    pod_spec = sts.spec["template"]["spec"]
+    mounts = pod_spec["containers"][0]["volumeMounts"]
+    assert {m["mountPath"] for m in mounts} == {
+        "/home/jovyan", "/scratch", "/dev/shm"
+    }
+    names = {v["name"] for v in pod_spec["volumes"]}
+    assert names == {"nb1-workspace", "scratch", "dshm"}
+
+
+def test_spawn_respects_readonly_field(world):
+    api, _, client = world
+    # Pin the image server-side; the client's choice must be ignored.
+    app = JupyterApp(api)
+    app.config["image"]["readOnly"] = True
+    pinned = app.config["image"]["value"]
+    c = TestClient(app, headers={HDR: f"accounts.google.com:{USER}"})
+    c.post(
+        "/api/namespaces/team/notebooks",
+        body={"name": "nb2", "image": "evil/image:latest"},
+    )
+    assert api.get("Notebook", "nb2", "team").spec["image"] == pinned
+
+
+def test_list_stop_start_delete(world):
+    api, ctl, client = world
+    client.post("/api/namespaces/team/notebooks", body={"name": "nb1"})
+    ctl.controller.run_until_idle()
+
+    [row] = client.get("/api/namespaces/team/notebooks").json()["notebooks"]
+    assert row["name"] == "nb1" and row["status"] == "waiting"
+
+    # Stop: annotation lands, STS scales to 0 (culler.go:37 semantics).
+    assert (
+        client.patch(
+            "/api/namespaces/team/notebooks/nb1", body={"stopped": True}
+        ).status
+        == 200
+    )
+    ctl.controller.run_until_idle()
+    assert api.get("StatefulSet", "nb1", "team").spec["replicas"] == 0
+    [row] = client.get("/api/namespaces/team/notebooks").json()["notebooks"]
+    assert row["status"] == "stopped"
+
+    # Restart.
+    client.patch("/api/namespaces/team/notebooks/nb1", body={"stopped": False})
+    ctl.controller.run_until_idle()
+    assert api.get("StatefulSet", "nb1", "team").spec["replicas"] == 1
+
+    # Delete cascades the STS via ownerReferences.
+    client.delete("/api/namespaces/team/notebooks/nb1")
+    ctl.controller.run_until_idle()
+    with pytest.raises(NotFound):
+        api.get("StatefulSet", "nb1", "team")
+    # The workspace PVC survives deletion (PVC-backed workspaces outlive
+    # the notebook, SURVEY.md §5 checkpoint row).
+    assert api.get("PersistentVolumeClaim", "nb1-workspace", "team")
+
+
+def test_poddefault_labels_flow_to_pod_template(world):
+    api, ctl, client = world
+    api.create(
+        new_resource(
+            "PodDefault",
+            "tpu-tools",
+            "team",
+            spec={
+                "selector": {"matchLabels": {"tpu-tools": "true"}},
+                "desc": "mount TPU profiling tools",
+            },
+        )
+    )
+    pds = client.get("/api/namespaces/team/poddefaults").json()["poddefaults"]
+    assert pds[0]["name"] == "tpu-tools"
+
+    client.post(
+        "/api/namespaces/team/notebooks",
+        body={"name": "nb3", "configurations": ["tpu-tools"]},
+    )
+    ctl.controller.run_until_idle()
+    sts = api.get("StatefulSet", "nb3", "team")
+    assert sts.spec["template"]["metadata"]["labels"]["tpu-tools"] == "true"
+
+
+def test_reserved_selector_label_cannot_be_overridden(world):
+    """A PodDefault named 'notebook' must not clobber the STS selector."""
+    api, ctl, client = world
+    client.post(
+        "/api/namespaces/team/notebooks",
+        body={"name": "nb4", "configurations": ["notebook"]},
+    )
+    ctl.controller.run_until_idle()
+    sts = api.get("StatefulSet", "nb4", "team")
+    assert sts.spec["template"]["metadata"]["labels"]["notebook"] == "nb4"
+
+
+def test_authz_denied_outside_namespace(world):
+    _, _, client = world
+    r = client.post("/api/namespaces/other/notebooks", body={"name": "nb"})
+    assert r.status == 403
+
+
+def test_tensorboards_crud(world):
+    api, _, _ = world
+    tb_ctl = TensorboardController(api)
+    app = TensorboardsApp(api)
+    c = TestClient(app, headers={HDR: f"accounts.google.com:{USER}"})
+
+    r = c.post(
+        "/api/namespaces/team/tensorboards",
+        body={"name": "tb1", "logspath": "pvc://nb1-workspace/logs"},
+    )
+    assert r.status == 200, r.body
+    tb_ctl.controller.run_until_idle()
+    assert api.get("Deployment", "tb1", "team")
+
+    rows = c.get("/api/namespaces/team/tensorboards").json()["tensorboards"]
+    assert rows[0]["logspath"] == "pvc://nb1-workspace/logs"
+
+    assert c.delete("/api/namespaces/team/tensorboards/tb1").status == 200
+    tb_ctl.controller.run_until_idle()
+    with pytest.raises(NotFound):
+        api.get("Deployment", "tb1", "team")
+
+    assert c.post("/api/namespaces/team/tensorboards", body={"name": "x"}).status == 400

@@ -79,6 +79,10 @@ def test_healthz_skips_authn():
     c = TestClient(app)
     assert c.get("/healthz").status == 200
     assert c.get("/api/items/x").status == 401
+    # Authn runs before routing: unmatched paths / wrong methods must not
+    # leak the route table (401, not 404/405) to anonymous clients.
+    assert c.get("/no/such/route").status == 401
+    assert c.delete("/api/items/x").status == 401
 
 
 def test_authn_prefix_strip():
