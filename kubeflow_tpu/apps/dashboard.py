@@ -29,6 +29,7 @@ from kubeflow_tpu.web import (
     HttpError,
     Request,
     Response,
+    ensure_authorized,
     json_response,
     success_response,
 )
@@ -57,7 +58,9 @@ class MetricsService(Protocol):
 
 class LocalMetricsService:
     """Reads utilization mirrored onto Node resources (the TPU analog of
-    the Stackdriver node/pod CPU+memory series)."""
+    the Stackdriver node/pod CPU+memory series). Serves the instantaneous
+    sample only — the `minutes` window is honored by history-backed
+    implementations (Stackdriver in the reference)."""
 
     SERIES = ("nodecpu", "nodemem", "tpuduty")
     FIELD = {
@@ -127,6 +130,7 @@ class DashboardApp(App):
 
     def get_activities(self, req: Request) -> Response:
         ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "list", "events", ns)
         events = [
             {
                 "reason": ev.spec.get("reason"),
@@ -141,7 +145,10 @@ class DashboardApp(App):
         return json_response(events)
 
     def get_metrics(self, req: Request) -> Response:
-        minutes = int(req.query.get("window", "15"))
+        try:
+            minutes = int(req.query.get("window", "15"))
+        except ValueError:
+            raise HttpError(400, "window must be an integer (minutes)")
         return json_response(
             self.metrics_service.query(req.path_params["metric"], minutes)
         )
@@ -175,6 +182,8 @@ class DashboardApp(App):
         )
 
     def workgroup_create(self, req: Request) -> Response:
+        if not self.registration_flow:
+            raise HttpError(403, "self-service registration is disabled")
         body = req.json()
         name = body.get("namespace") or req.user.split("@")[0].replace(
             ".", "-"

@@ -28,7 +28,7 @@ import yaml
 
 from kubeflow_tpu.api.objects import new_resource
 from kubeflow_tpu.controllers.notebook import STOP_ANNOTATION
-from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.testing.fake_apiserver import AlreadyExists, FakeApiServer
 from kubeflow_tpu.web import (
     App,
     HeaderAuthn,
@@ -199,8 +199,14 @@ class JupyterApp(App):
         return body.get(field, cfg.get("value"))
 
     def _set_image(self, spec: dict, body: dict) -> None:
-        image = body.get("customImage") or self._form_default("image", body)
-        spec["image"] = image
+        # customImage is only honored when the image field is NOT pinned —
+        # otherwise it would bypass the admin's allowlist entirely.
+        if not self.config.get("image", {}).get("readOnly") and body.get(
+            "customImage"
+        ):
+            spec["image"] = body["customImage"]
+            return
+        spec["image"] = self._form_default("image", body)
 
     def _set_resources(self, spec: dict, body: dict) -> None:
         cpu = str(self._form_default("cpu", body))
@@ -209,6 +215,10 @@ class JupyterApp(App):
         limits: dict = {}
         tpu = str(self._form_default("tpu", body) or "none")
         if tpu not in ("none", "0", "None"):
+            if not tpu.isdigit():
+                raise HttpError(
+                    400, f"tpu must be a chip count or 'none', got {tpu!r}"
+                )
             # TPU chips are limits-only and integral, like the reference's
             # `nvidia.com/gpu` (`utils.py set_notebook_gpus`,
             # `create_job_specs.py:168`).
@@ -231,7 +241,7 @@ class JupyterApp(App):
         mounts: list[dict] = []
         ws = self._form_default("workspaceVolume", body)
         vols = [ws] if ws else []
-        vols += list(body.get("dataVolumes") or [])
+        vols += list(self._form_default("dataVolumes", body) or [])
         for vol in vols:
             vol_name = str(vol.get("name", "")).replace("{name}", name)
             if not vol_name:
@@ -252,9 +262,11 @@ class JupyterApp(App):
                     pvc.spec["storageClassName"] = body["storageClass"]
                 try:
                     self.api.create(pvc)
-                except Exception:
+                except AlreadyExists:
                     # Existing PVC with the same name: reuse it (the
-                    # reference 409s inside a loop and carries on).
+                    # reference 409s inside a loop and carries on). Any
+                    # other failure must surface, not leave the notebook
+                    # pointing at a PVC that was never provisioned.
                     pass
             volumes.append(
                 {

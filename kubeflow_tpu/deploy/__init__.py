@@ -1,0 +1,36 @@
+"""Deployment tooling — the kfctl / bootstrap analog (SURVEY.md §2 #24).
+
+The reference's L0 is "kfctl-as-a-service": a KfDef CR describing the
+whole platform, driven through a two-phase apply — `Apply(PLATFORM)`
+creates cloud infrastructure, `Apply(K8S)` kustomize-applies every
+component manifest (`bootstrap/cmd/bootstrap/app/kfctlServer.go:105-294`).
+
+TPU-native equivalents:
+
+- `PlatformSpec` (kfdef.py) — the KfDef: platform block describes TPU
+  slice node pools (accelerator type + topology) instead of GPU pools;
+- `CloudProvider` / `FakeCloud` (provisioner.py) — the PLATFORM phase
+  boundary (Deployment Manager in the reference);
+- component bundles (bundles.py) — the kustomize bundles;
+- `apply_platform` (apply.py) — the two-phase driver with retried K8S
+  apply and KfAvailable/KfDegraded conditions;
+- `DeployServer` (server.py) — the click-to-deploy HTTP service with the
+  router/worker split and gc.
+"""
+
+from kubeflow_tpu.deploy.apply import ApplyResult, apply_platform, delete_platform
+from kubeflow_tpu.deploy.bundles import BUNDLES, bundle_resources
+from kubeflow_tpu.deploy.kfdef import NodePool, PlatformSpec
+from kubeflow_tpu.deploy.provisioner import CloudProvider, FakeCloud
+
+__all__ = [
+    "BUNDLES",
+    "ApplyResult",
+    "CloudProvider",
+    "FakeCloud",
+    "NodePool",
+    "PlatformSpec",
+    "apply_platform",
+    "bundle_resources",
+    "delete_platform",
+]

@@ -1,0 +1,76 @@
+"""Deploy CLI — the kfctl binary analog.
+
+    python -m kubeflow_tpu.deploy apply  -f platform.yaml
+    python -m kubeflow_tpu.deploy delete -f platform.yaml
+    python -m kubeflow_tpu.deploy generate > platform.yaml   # default spec
+    python -m kubeflow_tpu.deploy serve  --port 8085         # deploy service
+
+Mode dispatch mirrors `bootstrap/cmd/bootstrap/app/server.go:293-344`
+(router | kfctl | gc); apply/delete are the kfctl-CLI-style one-shots.
+Local mode runs against an in-process API server + FakeCloud and prints
+what was applied — the real-cluster provider slots in behind
+`CloudProvider`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from kubeflow_tpu.deploy.apply import apply_platform, delete_platform
+from kubeflow_tpu.deploy.kfdef import PlatformSpec, default_spec
+from kubeflow_tpu.deploy.provisioner import FakeCloud
+from kubeflow_tpu.deploy.server import DeployServer
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.wsgi import serve
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu-deploy")
+    sub = parser.add_subparsers(dest="mode", required=True)
+    for mode in ("apply", "delete"):
+        p = sub.add_parser(mode)
+        p.add_argument("-f", "--file", required=True)
+    sub.add_parser("generate")
+    p = sub.add_parser("serve")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8085)
+    args = parser.parse_args()
+
+    if args.mode == "generate":
+        print(default_spec().to_yaml(), end="")
+        return 0
+
+    api = FakeApiServer()
+    cloud = FakeCloud(api)
+
+    if args.mode == "serve":
+        server, _ = serve(DeployServer(api, cloud), host=args.host, port=args.port)
+        print(f"deploy-server: http://{args.host}:{server.server_port}")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.shutdown()
+        return 0
+
+    with open(args.file) as f:
+        spec = PlatformSpec.from_yaml(f.read())
+    if args.mode == "apply":
+        result = apply_platform(spec, api, cloud)
+        nodes = api.list("Node", "")
+        deployments = api.list("Deployment", "kubeflow")
+        print(
+            f"{spec.name}: succeeded={result.succeeded} "
+            f"resources={result.applied_count} nodes={len(nodes)} "
+            f"deployments={len(deployments)}"
+        )
+        return 0 if result.succeeded else 1
+    delete_platform(spec, api, cloud)
+    print(f"{spec.name}: deleted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""PlatformSpec — the KfDef analog.
+
+The reference's KfDef CR is the platform's entire desired state as one
+YAML document, versioned and processed by the deploy service
+(`kfctlServer.go:105-140` writes it to app.yaml and loads it via
+`coordinator.NewLoadKfAppFromURI`). Ours describes:
+
+- `platform`: the cloud side — project/zone and **TPU slice node pools**
+  (accelerator type like `v5e`, topology like `4x4`, preemptible flag) —
+  the analog of the reference's GCP Deployment Manager config, with
+  `google.com/tpu` capacity in place of `nvidia.com/gpu`;
+- `applications`: which component bundles to apply (kustomize analog),
+  each with optional overlay patches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import yaml
+
+TPU_CHIPS_PER_HOST = {
+    # chips exposed per host VM for common generations (host topology is
+    # 4 chips/VM for v4/v5e/v5p pods; 8 for v5e-8 single-host).
+    "v4": 4,
+    "v5e": 4,
+    "v5p": 4,
+    "v6e": 4,
+}
+
+
+def topology_chips(topology: str) -> int:
+    """'2x2' -> 4, '4x4x4' -> 64. Empty -> 1."""
+    if not topology:
+        return 1
+    n = 1
+    for part in topology.lower().split("x"):
+        n *= int(part)
+    return n
+
+
+@dataclasses.dataclass
+class NodePool:
+    name: str
+    accelerator: str = "v5e"  # TPU generation
+    topology: str = "2x2"  # slice topology, e.g. 2x2, 2x4, 4x4
+    preemptible: bool = False
+
+    @property
+    def num_chips(self) -> int:
+        return topology_chips(self.topology)
+
+    @property
+    def num_hosts(self) -> int:
+        per_host = TPU_CHIPS_PER_HOST.get(self.accelerator, 4)
+        return max(1, self.num_chips // per_host)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "accelerator": self.accelerator,
+            "topology": self.topology,
+            "preemptible": self.preemptible,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodePool":
+        return cls(
+            name=d["name"],
+            accelerator=d.get("accelerator", "v5e"),
+            topology=d.get("topology", "2x2"),
+            preemptible=bool(d.get("preemptible", False)),
+        )
+
+
+@dataclasses.dataclass
+class PlatformSpec:
+    name: str
+    project: str = "local"
+    zone: str = "local-a"
+    node_pools: list[NodePool] = dataclasses.field(default_factory=list)
+    applications: list[str] = dataclasses.field(default_factory=list)
+    email: str | None = None  # platform admin (IAM seed)
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": "kubeflow-tpu.org/v1",
+            "kind": "PlatformSpec",
+            "metadata": {"name": self.name},
+            "spec": {
+                "project": self.project,
+                "zone": self.zone,
+                "email": self.email,
+                "nodePools": [p.to_dict() for p in self.node_pools],
+                "applications": list(self.applications),
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlatformSpec":
+        spec = d.get("spec", {})
+        return cls(
+            name=d.get("metadata", {}).get("name", "kubeflow-tpu"),
+            project=spec.get("project", "local"),
+            zone=spec.get("zone", "local-a"),
+            email=spec.get("email"),
+            node_pools=[
+                NodePool.from_dict(p) for p in spec.get("nodePools", [])
+            ],
+            applications=list(spec.get("applications", [])),
+        )
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "PlatformSpec":
+        return cls.from_dict(yaml.safe_load(text))
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+
+def default_spec(name: str = "kubeflow-tpu") -> PlatformSpec:
+    """The default full deployment (every bundle, one v5e-16 pool) — what
+    the reference's default KfDef config gives you."""
+    from kubeflow_tpu.deploy.bundles import BUNDLES
+
+    return PlatformSpec(
+        name=name,
+        node_pools=[NodePool(name="tpu-pool-0", accelerator="v5e", topology="4x4")],
+        applications=list(BUNDLES),
+    )

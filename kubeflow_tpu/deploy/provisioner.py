@@ -1,0 +1,105 @@
+"""Cloud provisioning boundary — the PLATFORM phase.
+
+The reference's PLATFORM apply drives GCP Deployment Manager to create
+the GKE cluster + GPU node pools (`kfctlServer.go:219`, gcp plugin). The
+TPU equivalent provisions **TPU slice node pools**: each pool is a gang
+of host VMs wired into one ICI domain, surfaced to Kubernetes as Nodes
+carrying `google.com/tpu` capacity plus the topology/accelerator labels
+the gang scheduler matches on (`native/src/scheduler.cc` and
+`kubeflow_tpu/native/scheduler.py` read the same labels).
+
+`CloudProvider` is the seam (the reference injects a TokenSource-backed
+client the same way, `kfctlServer.go:179-201`); `FakeCloud` implements it
+against the in-process API server for tests/local dev, with injectable
+flakiness because idempotent-retry-on-cloud-flake is the behavior the
+reference's deploy loop most depends on (`kfctlServer.go:290-294`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.deploy.kfdef import NodePool, PlatformSpec
+from kubeflow_tpu.testing.fake_apiserver import AlreadyExists, FakeApiServer
+
+ACCELERATOR_LABEL = "cloud.google.com/tpu-accelerator"
+TOPOLOGY_LABEL = "cloud.google.com/tpu-topology"
+POOL_LABEL = "cloud.google.com/tpu-node-pool"
+TPU_RESOURCE = "google.com/tpu"
+
+
+class CloudError(Exception):
+    """Transient cloud-API failure (the retried class)."""
+
+
+class CloudProvider(Protocol):
+    def ensure_node_pool(self, spec: PlatformSpec, pool: NodePool) -> None: ...
+
+    def delete_node_pool(self, spec: PlatformSpec, pool_name: str) -> None: ...
+
+    def list_node_pools(self, spec: PlatformSpec) -> list[str]: ...
+
+
+class FakeCloud:
+    """In-process provider: a node pool materializes as `num_hosts` Node
+    objects with TPU capacity + topology labels."""
+
+    def __init__(self, api: FakeApiServer, *, fail_next: int = 0):
+        self.api = api
+        self._lock = threading.Lock()
+        self._pools: dict[tuple[str, str], NodePool] = {}
+        self.fail_next = fail_next  # injectable flakiness
+        self.calls = 0
+
+    def _maybe_fail(self) -> None:
+        with self._lock:
+            self.calls += 1
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise CloudError("injected transient cloud failure")
+
+    def ensure_node_pool(self, spec: PlatformSpec, pool: NodePool) -> None:
+        self._maybe_fail()
+        with self._lock:
+            self._pools[(spec.name, pool.name)] = pool
+        chips_per_host = max(1, pool.num_chips // pool.num_hosts)
+        for host in range(pool.num_hosts):
+            node = new_resource(
+                "Node",
+                f"{spec.name}-{pool.name}-{host}",
+                "",
+                labels={
+                    POOL_LABEL: pool.name,
+                    ACCELERATOR_LABEL: pool.accelerator,
+                    TOPOLOGY_LABEL: pool.topology,
+                    "cloud.google.com/gke-preemptible": str(
+                        pool.preemptible
+                    ).lower(),
+                },
+            )
+            node.spec = {
+                "capacity": {TPU_RESOURCE: chips_per_host},
+                "podCIDR": f"10.{host}.0.0/24",
+            }
+            try:
+                self.api.create(node)
+            except AlreadyExists:
+                pass  # idempotent re-apply
+
+    def delete_node_pool(self, spec: PlatformSpec, pool_name: str) -> None:
+        self._maybe_fail()
+        with self._lock:
+            self._pools.pop((spec.name, pool_name), None)
+        for node in self.api.list("Node", ""):
+            if node.metadata.labels.get(POOL_LABEL) == pool_name and (
+                node.metadata.name.startswith(f"{spec.name}-")
+            ):
+                self.api.delete("Node", node.metadata.name, "")
+
+    def list_node_pools(self, spec: PlatformSpec) -> list[str]:
+        with self._lock:
+            return sorted(
+                name for (dep, name) in self._pools if dep == spec.name
+            )

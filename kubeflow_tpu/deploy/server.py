@@ -1,0 +1,149 @@
+"""Deploy service — the click-to-deploy bootstrap server analog.
+
+Parity with `bootstrap/cmd/bootstrap/app/` (SURVEY.md §3.1): the router
+accepts `POST /kfctl/apps/v1/create` and hands each named deployment to a
+dedicated worker (the reference spawns a per-deployment kfctl StatefulSet,
+`router.go:275`; here a per-deployment worker thread), which serializes
+that deployment's applies through a queue (`kfctlServer.go:311-330`) and
+reports status via the PlatformDeployment conditions. `gc_older_than`
+mirrors the gc mode (`server.go:293-344` mode dispatch).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+from kubeflow_tpu.deploy.apply import apply_platform, delete_platform
+from kubeflow_tpu.deploy.kfdef import PlatformSpec
+from kubeflow_tpu.deploy.provisioner import CloudProvider
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+from kubeflow_tpu.web import (
+    App,
+    HttpError,
+    Request,
+    Response,
+    json_response,
+    success_response,
+)
+
+log = logging.getLogger(__name__)
+
+
+class _Worker:
+    """Per-deployment serializer: one queue, one thread — concurrent
+    applies for the same deployment cannot interleave."""
+
+    def __init__(self, api: FakeApiServer, cloud: CloudProvider):
+        self.api = api
+        self.cloud = cloud
+        self.queue: "queue.Queue[PlatformSpec | None]" = queue.Queue()
+        self.last_applied: float = 0.0
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self) -> None:
+        while True:
+            spec = self.queue.get()
+            if spec is None:
+                return
+            try:
+                apply_platform(spec, self.api, self.cloud)
+            except Exception:
+                log.exception("deploy %s failed", spec.name)
+            finally:
+                self.last_applied = time.time()
+                self.queue.task_done()
+
+    def stop(self) -> None:
+        self.queue.put(None)
+
+
+class DeployServer(App):
+    def __init__(self, api: FakeApiServer, cloud: CloudProvider):
+        super().__init__("deploy-server")
+        self.api = api
+        self.cloud = cloud
+        self._workers: dict[str, _Worker] = {}
+        self._specs: dict[str, PlatformSpec] = {}
+        self._lock = threading.Lock()
+        self.add_route("/kfctl/apps/v1/create", self.create, ("POST",))
+        self.add_route("/kfctl/apps/v1/status/<name>", self.status)
+        self.add_route("/kfctl/apps/v1/delete/<name>", self.delete, ("DELETE",))
+
+    # -- routing (router.go:91-407) ---------------------------------------
+
+    def _worker_for(self, name: str) -> _Worker:
+        with self._lock:
+            worker = self._workers.get(name)
+            if worker is None:
+                worker = self._workers[name] = _Worker(self.api, self.cloud)
+            return worker
+
+    def create(self, req: Request) -> Response:
+        body = req.json()
+        if not body:
+            raise HttpError(400, "body must be a PlatformSpec document")
+        spec = PlatformSpec.from_dict(body)
+        if not spec.name:
+            raise HttpError(400, "spec needs metadata.name")
+        with self._lock:
+            self._specs[spec.name] = spec
+        self._worker_for(spec.name).queue.put(spec)
+        return success_response("name", spec.name)
+
+    def status(self, req: Request) -> Response:
+        name = req.path_params["name"]
+        try:
+            dep = self.api.get("PlatformDeployment", name, "")
+        except NotFound:
+            raise HttpError(404, f"deployment {name!r} not found")
+        return json_response(
+            {"name": name, "status": dep.status}
+        )
+
+    def delete(self, req: Request) -> Response:
+        name = req.path_params["name"]
+        with self._lock:
+            spec = self._specs.pop(name, None)
+            worker = self._workers.pop(name, None)
+        if spec is None:
+            raise HttpError(404, f"deployment {name!r} not found")
+        if worker:
+            worker.queue.join()  # drain in-flight applies first
+            worker.stop()
+        delete_platform(spec, self.api, self.cloud)
+        return success_response()
+
+    # -- gc mode -----------------------------------------------------------
+
+    def gc_older_than(self, max_age_seconds: float) -> list[str]:
+        """Collect deployments whose last apply is older than the cutoff
+        (bootstrap's `gc` mode garbage-collects stale click-to-deploy
+        instances the same way)."""
+        now = time.time()
+        doomed = []
+        with self._lock:
+            for name, worker in list(self._workers.items()):
+                if (
+                    worker.queue.empty()
+                    and worker.last_applied
+                    and now - worker.last_applied > max_age_seconds
+                ):
+                    doomed.append(name)
+        for name in doomed:
+            with self._lock:
+                spec = self._specs.pop(name, None)
+                worker = self._workers.pop(name, None)
+            if worker:
+                worker.stop()
+            if spec is not None:
+                delete_platform(spec, self.api, self.cloud)
+        return doomed
+
+    def wait_idle(self) -> None:
+        """Block until every queued apply has finished (tests)."""
+        for worker in list(self._workers.values()):
+            worker.queue.join()

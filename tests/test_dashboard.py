@@ -106,6 +106,28 @@ def test_nuke_self_removes_profiles(world):
     assert c.request("DELETE", "/api/workgroup/nuke-self").status == 404
 
 
+def test_activities_authz(world):
+    api, ctl, app = world
+    client(app, "alice@x.co").post("/api/workgroup/create", body={})
+    ctl.controller.run_until_idle()
+    # Another user cannot read alice's event stream.
+    assert client(app, "bob@x.co").get("/api/activities/alice").status == 403
+
+
+def test_registration_flow_disabled(world):
+    api, _, _ = world
+    app = DashboardApp(api, registration_flow=False)
+    c = client(app, "alice@x.co")
+    assert c.get("/api/workgroup/exists").json()["registrationFlowAllowed"] is False
+    assert c.post("/api/workgroup/create", body={}).status == 403
+    assert api.list("Profile") == []
+
+
+def test_metrics_bad_window_is_400(world):
+    _, _, app = world
+    assert client(app, "a@x.co").get("/api/metrics/tpuduty?window=abc").status == 400
+
+
 def test_all_namespaces_admin_only(world):
     api, ctl, app = world
     client(app, "alice@x.co").post("/api/workgroup/create", body={})

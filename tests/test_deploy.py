@@ -1,0 +1,168 @@
+"""Deploy tool: two-phase apply, idempotency, retries, readiness parity.
+
+Mirrors the reference's deploy test suite (`testing/kfctl/`):
+- `kf_is_ready_test.py:101-115` → test_platform_is_ready asserts the
+  core deployment set;
+- `kfctl_second_apply.py` → test_second_apply_idempotent;
+- the retried K8S apply (`kfctlServer.go:290-294`) → flaky-cloud tests;
+- `kfctl_delete_test.py` → teardown test.
+"""
+
+import pytest
+
+from kubeflow_tpu.deploy import (
+    FakeCloud,
+    NodePool,
+    PlatformSpec,
+    apply_platform,
+    delete_platform,
+)
+from kubeflow_tpu.deploy.bundles import BUNDLES, CORE_DEPLOYMENTS
+from kubeflow_tpu.deploy.kfdef import default_spec, topology_chips
+from kubeflow_tpu.deploy.provisioner import TOPOLOGY_LABEL, TPU_RESOURCE
+from kubeflow_tpu.deploy.server import DeployServer
+from kubeflow_tpu.testing import FakeApiServer, NotFound
+from kubeflow_tpu.web import TestClient
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+def full_spec(name="kf-test"):
+    spec = default_spec(name)
+    spec.email = "admin@x.co"
+    return spec
+
+
+def test_platform_is_ready(api):
+    """kf_is_ready_test parity: every core deployment must exist."""
+    cloud = FakeCloud(api)
+    result = apply_platform(full_spec(), api, cloud)
+    assert result.succeeded, result.error
+
+    deployed = {d.metadata.name for d in api.list("Deployment", "kubeflow")}
+    for name in CORE_DEPLOYMENTS:
+        assert name in deployed, f"missing core deployment {name}"
+    # CRDs registered for every operator.
+    crds = {c.metadata.name for c in api.list("CustomResourceDefinition", "")}
+    for plural in ("tpujobs", "notebooks", "profiles", "tensorboards", "poddefaults"):
+        assert f"{plural}.kubeflow-tpu.org" in crds
+
+    dep = api.get("PlatformDeployment", "kf-test", "")
+    assert dep.status["phase"] == "Ready"
+    assert dep.status["conditions"][0]["type"] == "KfAvailable"
+
+
+def test_tpu_node_pool_provisioning(api):
+    """PLATFORM phase creates one Node per slice host with TPU capacity
+    + topology labels (the scheduler's gang-matching inputs)."""
+    cloud = FakeCloud(api)
+    spec = PlatformSpec(
+        name="kf",
+        node_pools=[NodePool(name="pool-a", accelerator="v5e", topology="4x4")],
+        applications=["namespace"],
+    )
+    assert apply_platform(spec, api, cloud).succeeded
+
+    nodes = api.list("Node", "")
+    assert len(nodes) == 4  # 16 chips / 4 per host
+    total = sum(n.spec["capacity"][TPU_RESOURCE] for n in nodes)
+    assert total == topology_chips("4x4") == 16
+    assert all(n.metadata.labels[TOPOLOGY_LABEL] == "4x4" for n in nodes)
+
+
+def test_second_apply_idempotent(api):
+    cloud = FakeCloud(api)
+    spec = full_spec()
+    r1 = apply_platform(spec, api, cloud)
+    rv_before = {
+        (d.metadata.name): d.metadata.resource_version
+        for d in api.list("Deployment", "kubeflow")
+    }
+    r2 = apply_platform(spec, api, cloud)
+    assert r1.succeeded and r2.succeeded
+    assert r1.applied_count == r2.applied_count
+    # apply() is create-or-update with no-op detection: nothing rewritten.
+    rv_after = {
+        (d.metadata.name): d.metadata.resource_version
+        for d in api.list("Deployment", "kubeflow")
+    }
+    assert rv_before == rv_after
+    # Node pool not duplicated.
+    assert len(api.list("Node", "")) == 4
+
+
+def test_flaky_cloud_is_retried(api):
+    cloud = FakeCloud(api, fail_next=2)  # first two calls blow up
+    result = apply_platform(full_spec(), api, cloud)
+    assert result.succeeded
+    assert cloud.calls >= 3
+
+
+def test_cloud_outage_fails_with_degraded_condition(api):
+    cloud = FakeCloud(api, fail_next=10)  # more failures than retries
+    result = apply_platform(full_spec(), api, cloud)
+    assert not result.succeeded
+    assert not result.platform_applied
+    dep = api.get("PlatformDeployment", "kf-test", "")
+    assert dep.status["phase"] == "Failed"
+    assert dep.status["conditions"][0]["type"] == "KfDegraded"
+
+
+def test_unknown_application_rejected(api):
+    cloud = FakeCloud(api)
+    spec = PlatformSpec(name="kf", applications=["nonsense"])
+    result = apply_platform(spec, api, cloud)
+    assert not result.succeeded
+    assert "nonsense" in result.error
+
+
+def test_delete_platform(api):
+    cloud = FakeCloud(api)
+    spec = full_spec()
+    apply_platform(spec, api, cloud)
+    delete_platform(spec, api, cloud)
+    assert api.list("Deployment", "kubeflow") == []
+    assert api.list("Node", "") == []
+    with pytest.raises(NotFound):
+        api.get("PlatformDeployment", "kf-test", "")
+
+
+def test_deploy_server_flow(api):
+    """Router → worker → status → delete (§3.1 call stack)."""
+    cloud = FakeCloud(api)
+    server = DeployServer(api, cloud)
+    c = TestClient(server)
+
+    r = c.post("/kfctl/apps/v1/create", body=full_spec("web-kf").to_dict())
+    assert r.status == 200
+    server.wait_idle()
+
+    status = c.get("/kfctl/apps/v1/status/web-kf").json()
+    assert status["status"]["phase"] == "Ready"
+    assert {d.metadata.name for d in api.list("Deployment", "kubeflow")} >= set(
+        CORE_DEPLOYMENTS
+    )
+
+    assert c.delete("/kfctl/apps/v1/delete/web-kf").status == 200
+    assert c.get("/kfctl/apps/v1/status/web-kf").status == 404
+    assert api.list("Deployment", "kubeflow") == []
+
+
+def test_deploy_server_gc(api):
+    cloud = FakeCloud(api)
+    server = DeployServer(api, cloud)
+    c = TestClient(server)
+    c.post("/kfctl/apps/v1/create", body=full_spec("old-kf").to_dict())
+    server.wait_idle()
+    assert server.gc_older_than(0.0) == ["old-kf"]
+    assert api.list("Deployment", "kubeflow") == []
+
+
+def test_spec_yaml_roundtrip():
+    spec = full_spec()
+    again = PlatformSpec.from_yaml(spec.to_yaml())
+    assert again == spec
+    assert set(spec.applications) == set(BUNDLES)

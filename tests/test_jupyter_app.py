@@ -84,7 +84,8 @@ def test_spawn_creates_cr_pvc_and_sts(world):
 
 def test_spawn_respects_readonly_field(world):
     api, _, client = world
-    # Pin the image server-side; the client's choice must be ignored.
+    # Pin the image server-side; the client's choice must be ignored —
+    # through BOTH the image field and the customImage escape hatch.
     app = JupyterApp(api)
     app.config["image"]["readOnly"] = True
     pinned = app.config["image"]["value"]
@@ -94,6 +95,28 @@ def test_spawn_respects_readonly_field(world):
         body={"name": "nb2", "image": "evil/image:latest"},
     )
     assert api.get("Notebook", "nb2", "team").spec["image"] == pinned
+    c.post(
+        "/api/namespaces/team/notebooks",
+        body={"name": "nb2b", "customImage": "evil/image:latest"},
+    )
+    assert api.get("Notebook", "nb2b", "team").spec["image"] == pinned
+
+
+def test_custom_image_honored_when_not_pinned(world):
+    api, _, client = world
+    client.post(
+        "/api/namespaces/team/notebooks",
+        body={"name": "nb2c", "customImage": "my/研究:latest"},
+    )
+    assert api.get("Notebook", "nb2c", "team").spec["image"] == "my/研究:latest"
+
+
+def test_bad_tpu_count_is_400(world):
+    _, _, client = world
+    r = client.post(
+        "/api/namespaces/team/notebooks", body={"name": "nbx", "tpu": "two"}
+    )
+    assert r.status == 400
 
 
 def test_list_stop_start_delete(world):
