@@ -28,7 +28,11 @@ from kubeflow_tpu.api.rbac import (
 from kubeflow_tpu.deploy.bundles import bundle_resources
 from kubeflow_tpu.deploy.kfdef import PlatformSpec
 from kubeflow_tpu.deploy.provisioner import CloudProvider
-from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    FakeApiServer,
+    NotFound,
+)
 
 log = logging.getLogger(__name__)
 
@@ -126,8 +130,8 @@ def apply_platform(
                         f"{spec.name}-admin", "kubeflow-admin", spec.email
                     )
                 )
-            except Exception:
-                pass  # second apply
+            except AlreadyExists:
+                pass  # second apply; anything else must fail the phase
         result.k8s_applied = True
     except Exception as e:
         result.error = f"K8S phase: {e}"

@@ -22,11 +22,12 @@ from typing import Protocol
 
 from kubeflow_tpu.api.objects import new_resource
 from kubeflow_tpu.deploy.kfdef import NodePool, PlatformSpec
-from kubeflow_tpu.testing.fake_apiserver import AlreadyExists, FakeApiServer
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 
 ACCELERATOR_LABEL = "cloud.google.com/tpu-accelerator"
 TOPOLOGY_LABEL = "cloud.google.com/tpu-topology"
 POOL_LABEL = "cloud.google.com/tpu-node-pool"
+PLATFORM_LABEL = "kubeflow-tpu.org/platform"
 TPU_RESOURCE = "google.com/tpu"
 
 
@@ -71,6 +72,7 @@ class FakeCloud:
                 f"{spec.name}-{pool.name}-{host}",
                 "",
                 labels={
+                    PLATFORM_LABEL: spec.name,
                     POOL_LABEL: pool.name,
                     ACCELERATOR_LABEL: pool.accelerator,
                     TOPOLOGY_LABEL: pool.topology,
@@ -83,20 +85,22 @@ class FakeCloud:
                 "capacity": {TPU_RESOURCE: chips_per_host},
                 "podCIDR": f"10.{host}.0.0/24",
             }
-            try:
-                self.api.create(node)
-            except AlreadyExists:
-                pass  # idempotent re-apply
+            # Create-or-update: a re-apply after a pool spec change must
+            # refresh topology/capacity, not keep stale labels.
+            self.api.apply(node)
 
     def delete_node_pool(self, spec: PlatformSpec, pool_name: str) -> None:
         self._maybe_fail()
         with self._lock:
             self._pools.pop((spec.name, pool_name), None)
-        for node in self.api.list("Node", ""):
-            if node.metadata.labels.get(POOL_LABEL) == pool_name and (
-                node.metadata.name.startswith(f"{spec.name}-")
-            ):
-                self.api.delete("Node", node.metadata.name, "")
+        # Filter on the platform label, never a name prefix — platform
+        # 'kf' must not collect platform 'kf-2's nodes.
+        for node in self.api.list(
+            "Node",
+            "",
+            label_selector={PLATFORM_LABEL: spec.name, POOL_LABEL: pool_name},
+        ):
+            self.api.delete("Node", node.metadata.name, "")
 
     def list_node_pools(self, spec: PlatformSpec) -> list[str]:
         with self._lock:

@@ -84,11 +84,11 @@ class DeployServer(App):
 
     def create(self, req: Request) -> Response:
         body = req.json()
-        if not body:
-            raise HttpError(400, "body must be a PlatformSpec document")
-        spec = PlatformSpec.from_dict(body)
-        if not spec.name:
+        # Validate before from_dict — the parser defaults a missing name,
+        # which would silently merge into an existing deployment.
+        if not body.get("metadata", {}).get("name"):
             raise HttpError(400, "spec needs metadata.name")
+        spec = PlatformSpec.from_dict(body)
         with self._lock:
             self._specs[spec.name] = spec
         self._worker_for(spec.name).queue.put(spec)
@@ -127,8 +127,10 @@ class DeployServer(App):
         doomed = []
         with self._lock:
             for name, worker in list(self._workers.items()):
+                # unfinished_tasks counts queued AND in-flight applies —
+                # queue.empty() alone would let gc race a running apply.
                 if (
-                    worker.queue.empty()
+                    worker.queue.unfinished_tasks == 0
                     and worker.last_applied
                     and now - worker.last_applied > max_age_seconds
                 ):

@@ -1,0 +1,13 @@
+"""Worker sidecar for gang-scheduled TPU jobs.
+
+The openmpi-controller analog (SURVEY.md §2 #18): a per-worker sidecar
+that sequences the main container against the rest of the gang.
+"""
+
+from kubeflow_tpu.sidecar.controller import (
+    SIGCONT_FILE,
+    SIGTERM_FILE,
+    SidecarController,
+)
+
+__all__ = ["SIGCONT_FILE", "SIGTERM_FILE", "SidecarController"]

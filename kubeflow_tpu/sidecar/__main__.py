@@ -1,0 +1,74 @@
+"""Sidecar CLI (`openmpi-controller/controller/main.py:7-29` analog).
+
+    python -m kubeflow_tpu.sidecar \
+        --workdir /kubeflow-tpu/data --job myjob --namespace team \
+        [--coordinator host:port] [--results /out --artifacts /store]
+
+Main-container entrypoints block on the SIGCONT file in --workdir before
+starting, and exit when SIGTERM appears — identical contract to the
+reference's shared-volume signal files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from kubeflow_tpu.sidecar.controller import (
+    SidecarController,
+    default_device_probe,
+    local_dir_uploader,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu-sidecar")
+    parser.add_argument("--workdir", required=True)
+    parser.add_argument("--job", required=True)
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--coordinator", default=None)
+    parser.add_argument(
+        "--apiserver",
+        default=None,
+        help="API server base URL to watch the TpuJob phase "
+        "(e.g. http://apiserver:8001)",
+    )
+    parser.add_argument("--results", default=None)
+    parser.add_argument("--artifacts", default=None)
+    parser.add_argument("--poll-seconds", type=float, default=10.0)
+    parser.add_argument("--timeout-seconds", type=float, default=600.0)
+    parser.add_argument(
+        "--skip-device-probe",
+        action="store_true",
+        help="don't wait for the TPU runtime (CPU smoke tests)",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    api = None
+    if args.apiserver:
+        from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+
+        api = HttpApiClient(args.apiserver)
+
+    controller = SidecarController(
+        workdir=args.workdir,
+        job_name=args.job,
+        namespace=args.namespace,
+        api=api,
+        coordinator=args.coordinator,
+        device_probe=(
+            (lambda: True) if args.skip_device_probe else default_device_probe
+        ),
+        upload=local_dir_uploader(args.artifacts) if args.artifacts else None,
+        poll_seconds=args.poll_seconds,
+        timeout_seconds=args.timeout_seconds,
+    )
+    phase = controller.run(results_dir=args.results)
+    print(f"sidecar: job {args.job} terminal phase: {phase}")
+    return 0 if phase == "Succeeded" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""HTTP facade + client for the in-process API server.
+
+The reference's sidecars and tests talk to the real K8s apiserver over
+HTTP (`openmpi-controller/controller/util.py` uses the kubernetes client;
+`testing/deploy_utils.py:31-71`). Our control plane stores resources in
+`FakeApiServer`; this module serves that store over REST so *separate
+processes* (sidecar CLI, e2e workers, probers) get the same boundary:
+
+    GET    /apis/<kind>                      ?namespace=&labelSelector=k=v
+    GET    /apis/<kind>/<ns>/<name>          ('_' namespace = cluster scope)
+    POST   /apis/<kind>
+    PUT    /apis/<kind>/<ns>/<name>[/status]
+    DELETE /apis/<kind>/<ns>/<name>
+
+`HttpApiClient` mirrors the FakeApiServer method surface (get/list/create/
+update/update_status/delete) so controller-side code is client-agnostic.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from kubeflow_tpu.api.objects import Resource
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    Conflict,
+    FakeApiServer,
+    NotFound,
+)
+from kubeflow_tpu.web.wsgi import App, HttpError, Request, Response, json_response
+
+
+def _ns_seg(namespace: str) -> str:
+    return namespace or "_"
+
+
+def _seg_ns(seg: str) -> str:
+    return "" if seg == "_" else seg
+
+
+class ApiServerApp(App):
+    """REST facade. Unauthenticated — this is the in-cluster trust domain
+    (the reference controllers talk to the apiserver with pod
+    serviceaccounts; web-tier authn/authz stays in the web apps)."""
+
+    def __init__(self, api: FakeApiServer):
+        super().__init__("apiserver")
+        self.api = api
+        self.add_route("/apis/<kind>", self.list_kind)
+        self.add_route("/apis/<kind>", self.create, ("POST",))
+        self.add_route("/apis/<kind>/<ns>/<name>", self.get)
+        self.add_route("/apis/<kind>/<ns>/<name>", self.update, ("PUT",))
+        self.add_route("/apis/<kind>/<ns>/<name>", self.delete, ("DELETE",))
+        self.add_route(
+            "/apis/<kind>/<ns>/<name>/status", self.update_status, ("PUT",)
+        )
+
+    def list_kind(self, req: Request) -> Response:
+        selector = None
+        if "labelSelector" in req.query:
+            selector = dict(
+                part.split("=", 1)
+                for part in req.query["labelSelector"].split(",")
+                if "=" in part
+            )
+        namespace = req.query.get("namespace")
+        items = self.api.list(
+            req.path_params["kind"],
+            namespace=_seg_ns(namespace) if namespace is not None else None,
+            label_selector=selector,
+        )
+        return json_response({"items": [r.to_dict() for r in items]})
+
+    def get(self, req: Request) -> Response:
+        obj = self.api.get(
+            req.path_params["kind"],
+            req.path_params["name"],
+            _seg_ns(req.path_params["ns"]),
+        )
+        return json_response(obj.to_dict())
+
+    def create(self, req: Request) -> Response:
+        obj = Resource.from_dict(req.json())
+        if obj.kind != req.path_params["kind"]:
+            raise HttpError(400, "kind mismatch between path and body")
+        return json_response(self.api.create(obj).to_dict(), status=201)
+
+    def update(self, req: Request) -> Response:
+        return json_response(
+            self.api.update(Resource.from_dict(req.json())).to_dict()
+        )
+
+    def update_status(self, req: Request) -> Response:
+        return json_response(
+            self.api.update_status(Resource.from_dict(req.json())).to_dict()
+        )
+
+    def delete(self, req: Request) -> Response:
+        self.api.delete(
+            req.path_params["kind"],
+            req.path_params["name"],
+            _seg_ns(req.path_params["ns"]),
+        )
+        return json_response({"deleted": True})
+
+
+class HttpApiClient:
+    """Remote twin of FakeApiServer's CRUD surface."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body: dict | None = None) -> dict:
+        req = urllib.request.Request(
+            self.base_url + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise NotFound(detail)
+            if e.code == 409:
+                # The server folds AlreadyExists and Conflict onto 409;
+                # disambiguate from the message.
+                if "already exists" in detail:
+                    raise AlreadyExists(detail)
+                raise Conflict(detail)
+            raise
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        return Resource.from_dict(
+            self._call("GET", f"/apis/{kind}/{_ns_seg(namespace)}/{name}")
+        )
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[Resource]:
+        params = {}
+        if namespace is not None:
+            params["namespace"] = _ns_seg(namespace)
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items()
+            )
+        query = f"?{urllib.parse.urlencode(params)}" if params else ""
+        data = self._call("GET", f"/apis/{kind}{query}")
+        return [Resource.from_dict(d) for d in data["items"]]
+
+    def create(self, obj: Resource) -> Resource:
+        return Resource.from_dict(
+            self._call("POST", f"/apis/{obj.kind}", obj.to_dict())
+        )
+
+    def update(self, obj: Resource) -> Resource:
+        return Resource.from_dict(
+            self._call(
+                "PUT",
+                f"/apis/{obj.kind}/{_ns_seg(obj.metadata.namespace)}/"
+                f"{obj.metadata.name}",
+                obj.to_dict(),
+            )
+        )
+
+    def update_status(self, obj: Resource) -> Resource:
+        return Resource.from_dict(
+            self._call(
+                "PUT",
+                f"/apis/{obj.kind}/{_ns_seg(obj.metadata.namespace)}/"
+                f"{obj.metadata.name}/status",
+                obj.to_dict(),
+            )
+        )
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._call("DELETE", f"/apis/{kind}/{_ns_seg(namespace)}/{name}")

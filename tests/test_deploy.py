@@ -161,6 +161,42 @@ def test_deploy_server_gc(api):
     assert api.list("Deployment", "kubeflow") == []
 
 
+def test_pool_respec_updates_nodes(api):
+    """Re-apply after a topology change must refresh node labels."""
+    cloud = FakeCloud(api)
+    spec = PlatformSpec(
+        name="kf",
+        node_pools=[NodePool(name="p", topology="2x2")],
+        applications=["namespace"],
+    )
+    apply_platform(spec, api, cloud)
+    spec.node_pools = [NodePool(name="p", topology="2x2", preemptible=True)]
+    apply_platform(spec, api, cloud)
+    node = api.list("Node", "")[0]
+    assert node.metadata.labels["cloud.google.com/gke-preemptible"] == "true"
+
+
+def test_prefix_named_platforms_do_not_cross_delete(api):
+    cloud = FakeCloud(api)
+    a = PlatformSpec(
+        name="kf", node_pools=[NodePool(name="pool-a")], applications=[]
+    )
+    b = PlatformSpec(
+        name="kf-2", node_pools=[NodePool(name="pool-a")], applications=[]
+    )
+    apply_platform(a, api, cloud)
+    apply_platform(b, api, cloud)
+    delete_platform(a, api, cloud)
+    remaining = {n.metadata.name for n in api.list("Node", "")}
+    assert remaining == {"kf-2-pool-a-0"}
+
+
+def test_deploy_server_rejects_missing_name(api):
+    server = DeployServer(api, FakeCloud(api))
+    c = TestClient(server)
+    assert c.post("/kfctl/apps/v1/create", body={"spec": {}}).status == 400
+
+
 def test_spec_yaml_roundtrip():
     spec = full_spec()
     again = PlatformSpec.from_yaml(spec.to_yaml())

@@ -1,0 +1,229 @@
+"""Gang-worker sidecar sequencing + HTTP apiserver facade."""
+
+import pathlib
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.sidecar import SIGCONT_FILE, SIGTERM_FILE, SidecarController
+from kubeflow_tpu.sidecar.controller import local_dir_uploader
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.testing.fake_apiserver import NotFound
+from kubeflow_tpu.web.wsgi import serve
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def make_job(api, phase=None):
+    job = api.create(new_resource("TpuJob", "job1", "team"))
+    if phase:
+        job.status["phase"] = phase
+        api.update_status(job)
+    return job
+
+
+def controller(api, tmp_path, **kw):
+    clock = FakeClock()
+    kw.setdefault("clock", clock)
+    kw.setdefault("sleep", clock.sleep)
+    kw.setdefault("poll_seconds", 1.0)
+    kw.setdefault("timeout_seconds", 30.0)
+    return (
+        SidecarController(
+            workdir=tmp_path / "sig", job_name="job1", namespace="team",
+            api=api, **kw
+        ),
+        clock,
+    )
+
+
+def test_wait_ready_gates_on_probes(tmp_path):
+    api = FakeApiServer()
+    state = {"device": False, "coord": False, "downloaded": False}
+    ctl, clock = controller(
+        api,
+        tmp_path,
+        device_probe=lambda: state["device"],
+        coordinator_probe=lambda: state["coord"],
+        download=lambda: state.__setitem__("downloaded", True),
+    )
+
+    # Flip the probes as "time" passes.
+    orig_sleep = clock.sleep
+
+    def sleep(dt):
+        orig_sleep(dt)
+        if clock.t >= 2:
+            state["device"] = True
+        if clock.t >= 4:
+            state["coord"] = True
+
+    ctl.sleep = sleep
+    ctl.wait_ready()
+    assert state["downloaded"]
+    assert ctl.has_signal(SIGCONT_FILE)
+    assert not ctl.has_signal(SIGTERM_FILE)
+
+
+def test_wait_ready_times_out(tmp_path):
+    ctl, _ = controller(FakeApiServer(), tmp_path, device_probe=lambda: False)
+    with pytest.raises(TimeoutError):
+        ctl.wait_ready()
+
+
+def test_wait_done_signals_on_terminal_phase(tmp_path):
+    api = FakeApiServer()
+    make_job(api, phase="Running")
+    ctl, clock = controller(api, tmp_path)
+
+    def flip():
+        job = api.get("TpuJob", "job1", "team")
+        job.status["phase"] = "Succeeded"
+        api.update_status(job)
+
+    orig_sleep = clock.sleep
+
+    def sleep(dt):
+        orig_sleep(dt)
+        if clock.t >= 3:
+            flip()
+
+    ctl.sleep = sleep
+    assert ctl.wait_done() == "Succeeded"
+    assert ctl.has_signal(SIGTERM_FILE)
+
+
+def test_vanished_job_is_failed(tmp_path):
+    """Master object gone ⇒ terminate (controller.py:95-99 semantics)."""
+    api = FakeApiServer()
+    ctl, _ = controller(api, tmp_path)
+    assert ctl.wait_done() == "Failed"
+    assert ctl.has_signal(SIGTERM_FILE)
+
+
+def test_watch_timeout_forces_sigterm(tmp_path):
+    api = FakeApiServer()
+    make_job(api, phase="Running")  # never terminates
+    ctl, _ = controller(api, tmp_path, timeout_seconds=5.0)
+    assert ctl.wait_done() == "Failed"
+    assert ctl.has_signal(SIGTERM_FILE)
+
+
+def test_artifact_upload(tmp_path):
+    api = FakeApiServer()
+    make_job(api, phase="Succeeded")
+    results = tmp_path / "results"
+    results.mkdir()
+    (results / "metrics.json").write_text("{}")
+    store = tmp_path / "store"
+    ctl, _ = controller(api, tmp_path, upload=local_dir_uploader(store))
+    assert ctl.run(results_dir=results) == "Succeeded"
+    assert (store / "metrics.json").exists()
+
+
+# -- HTTP facade ----------------------------------------------------------
+
+
+@pytest.fixture
+def http_api():
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    client = HttpApiClient(f"http://127.0.0.1:{server.server_port}")
+    yield api, client
+    server.shutdown()
+
+
+def test_http_facade_crud(http_api):
+    api, client = http_api
+    created = client.create(
+        new_resource("TpuJob", "j", "team", labels={"a": "b"})
+    )
+    assert created.metadata.uid
+
+    got = client.get("TpuJob", "j", "team")
+    assert got.metadata.name == "j"
+
+    got.status["phase"] = "Running"
+    client.update_status(got)
+    assert api.get("TpuJob", "j", "team").status["phase"] == "Running"
+
+    assert [r.metadata.name for r in client.list("TpuJob", "team")] == ["j"]
+    assert client.list("TpuJob", "team", label_selector={"a": "b"})
+    assert not client.list("TpuJob", "team", label_selector={"a": "x"})
+
+    # Cluster-scoped objects round-trip through the '_' segment.
+    client.create(new_resource("Namespace", "ns1", ""))
+    assert client.get("Namespace", "ns1", "").metadata.name == "ns1"
+
+    client.delete("TpuJob", "j", "team")
+    with pytest.raises(NotFound):
+        client.get("TpuJob", "j", "team")
+
+
+def test_http_facade_conflict_mapping(http_api):
+    _, client = http_api
+    client.create(new_resource("TpuJob", "j", "team"))
+    from kubeflow_tpu.testing.fake_apiserver import AlreadyExists, Conflict
+
+    with pytest.raises(AlreadyExists):
+        client.create(new_resource("TpuJob", "j", "team"))
+
+    stale = client.get("TpuJob", "j", "team")
+    fresh = client.get("TpuJob", "j", "team")
+    fresh.metadata.labels["x"] = "y"
+    client.update(fresh)
+    stale.metadata.labels["x"] = "z"
+    with pytest.raises(Conflict):
+        client.update(stale)
+
+
+def test_sidecar_cli_against_http_apiserver(tmp_path):
+    """Cross-process: the sidecar CLI watches a real HTTP apiserver."""
+    api = FakeApiServer()
+    job = api.create(new_resource("TpuJob", "job1", "team"))
+    job.status["phase"] = "Running"
+    api.update_status(job)
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    url = f"http://127.0.0.1:{server.server_port}"
+
+    def finish_soon():
+        import time
+
+        time.sleep(1.0)
+        fresh = api.get("TpuJob", "job1", "team")
+        fresh.status["phase"] = "Succeeded"
+        api.update_status(fresh)
+
+    threading.Thread(target=finish_soon, daemon=True).start()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "kubeflow_tpu.sidecar",
+            "--workdir", str(tmp_path / "sig"),
+            "--job", "job1", "--namespace", "team",
+            "--apiserver", url,
+            "--poll-seconds", "0.2", "--timeout-seconds", "30",
+            "--skip-device-probe",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=pathlib.Path(__file__).parent.parent,
+    )
+    server.shutdown()
+    assert proc.returncode == 0, proc.stderr
+    assert "Succeeded" in proc.stdout
+    assert (tmp_path / "sig" / SIGCONT_FILE).exists()
+    assert (tmp_path / "sig" / SIGTERM_FILE).exists()
