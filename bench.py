@@ -30,6 +30,9 @@ def main() -> None:
     parser.add_argument("--warmup-steps", type=int, default=5)
     parser.add_argument("--steps", type=int, default=30)
     args = parser.parse_args()
+    if args.steps < 1:
+        parser.error("--steps must be >= 1 (the timing fence reads the "
+                     "last step's metrics)")
 
     import jax.numpy as jnp
 
@@ -63,14 +66,19 @@ def main() -> None:
     step = trainer.make_train_step()
     it = iter(data)
 
+    # On tunneled/remote platforms block_until_ready can return before the
+    # device has executed; a scalar device_get is the only reliable fence.
+    # Fence the start the same way so warmup work can't leak into the
+    # timed window.
     for _ in range(args.warmup_steps):
         state, metrics = step(state, next(it))
-    jax.block_until_ready(state)
+    if args.warmup_steps:
+        float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = step(state, next(it))
-    jax.block_until_ready(state)
+    final_loss = float(metrics["loss"])  # fences: forces all steps to finish
     elapsed = time.perf_counter() - t0
 
     images_per_sec = config.batch_size * args.steps / elapsed
@@ -90,7 +98,7 @@ def main() -> None:
     print(
         f"# devices={n_chips} global_batch={config.batch_size} "
         f"steps={args.steps} elapsed={elapsed:.2f}s "
-        f"total={images_per_sec:.1f} img/s loss={float(metrics['loss']):.3f}",
+        f"total={images_per_sec:.1f} img/s loss={final_loss:.3f}",
         file=sys.stderr,
     )
 

@@ -69,7 +69,14 @@ def _crd(kind: str, plural: str, *, cluster_scoped: bool = False) -> Resource:
     )
 
 
-def _vs(name: str, prefix: str, port: int) -> Resource:
+def _vs(
+    name: str, prefix: str, port: int, *, rewrite: str | None = "/"
+) -> Resource:
+    """rewrite=None keeps the matched prefix (for backends whose routes
+    include it, e.g. the model server's /v1/models/...)."""
+    http_route: dict = {"match": [{"uri": {"prefix": prefix}}]}
+    if rewrite is not None:
+        http_route["rewrite"] = {"uri": rewrite}
     return new_resource(
         "VirtualService",
         name,
@@ -79,8 +86,7 @@ def _vs(name: str, prefix: str, port: int) -> Resource:
             "hosts": ["*"],
             "http": [
                 {
-                    "match": [{"uri": {"prefix": prefix}}],
-                    "rewrite": {"uri": "/"},
+                    **http_route,
                     "route": [
                         {
                             "destination": {
@@ -249,6 +255,19 @@ def metrics_collector_bundle(spec: PlatformSpec) -> list[Resource]:
     ]
 
 
+def model_serving_bundle(spec: PlatformSpec) -> list[Resource]:
+    """The tf-serving analog: the JAX model server
+    (`kubeflow_tpu.serving`), reached at the same REST surface the
+    reference's golden-prediction E2E drives (`test_tf_serving.py:107`)."""
+    return [
+        _deployment(
+            "model-server", "kubeflow-tpu/model-server:v1", port=8500
+        ),
+        _service("model-server", 8500),
+        _vs("model-server", "/v1/models/", 8500, rewrite=None),
+    ]
+
+
 BUNDLES: dict[str, BundleFn] = {
     # Order matters: namespace and gateway first, operators before apps.
     "namespace": namespace_bundle,
@@ -263,6 +282,7 @@ BUNDLES: dict[str, BundleFn] = {
     "jupyter-web-app": jupyter_web_app_bundle,
     "tensorboards-web-app": tensorboards_web_app_bundle,
     "metrics-collector": metrics_collector_bundle,
+    "model-serving": model_serving_bundle,
 }
 
 # The deployment set the readiness test asserts — the analog of the
@@ -278,6 +298,7 @@ CORE_DEPLOYMENTS = [
     "jupyter-web-app-deployment",
     "tensorboards-web-app-deployment",
     "metrics-collector",
+    "model-server",
 ]
 
 

@@ -1,0 +1,17 @@
+"""Model serving — the platform's tf-serving analog, TPU-native.
+
+The reference deploys TensorFlow Serving as an external container and
+verifies it with a golden-prediction REST test
+(`testing/test_tf_serving.py:60-156`, POST
+`:8500/v1/models/mnist:predict`). This package provides the in-repo
+equivalent: a JAX model server speaking the same REST surface
+(`/v1/models/<name>` status + `:predict` verb), with TPU-first execution —
+requests are padded into a small set of static batch buckets so XLA
+compiles one program per bucket instead of one per request size, and the
+hot path is a single jitted apply on device.
+"""
+
+from kubeflow_tpu.serving.servable import Servable
+from kubeflow_tpu.serving.server import ModelRepository, ModelServerApp
+
+__all__ = ["ModelRepository", "ModelServerApp", "Servable"]

@@ -1,0 +1,79 @@
+"""Model-server binary.
+
+    python -m kubeflow_tpu.serving --model name=<ckpt_dir> ... [--port 8500]
+
+Each --model loads an orbax checkpoint written by the training loop and
+serves it at /v1/models/<name>. With no --model flags a demo model is
+served under the name "demo" so the REST surface can be probed standalone
+(the tf-serving sample served mnist the same way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu-model-server")
+    parser.add_argument("--host", default="0.0.0.0")
+    # TF Serving's REST port (`test_tf_serving.py:107` hits :8500).
+    parser.add_argument("--port", type=int, default=8500)
+    parser.add_argument(
+        "--model",
+        action="append",
+        default=[],
+        metavar="NAME=CKPT_DIR",
+        help="serve an orbax checkpoint as /v1/models/NAME (repeatable)",
+    )
+    parser.add_argument("--max-batch", type=int, default=64)
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models.resnet import resnet50, tiny_resnet
+    from kubeflow_tpu.serving import ModelRepository, ModelServerApp, Servable
+    from kubeflow_tpu.web.wsgi import serve
+
+    servables = []
+    for spec in args.model:
+        name, _, ckpt_dir = spec.partition("=")
+        if not name or not ckpt_dir:
+            parser.error(f"--model {spec!r} must be NAME=CKPT_DIR")
+        servables.append(
+            Servable.from_checkpoint(
+                name,
+                resnet50(),
+                ckpt_dir,
+                np.zeros((1, 224, 224, 3), np.float32),
+                max_batch=args.max_batch,
+                train=False,
+            )
+        )
+    if not servables:
+        module = tiny_resnet(num_classes=10)
+        variables = jax.jit(module.init)(
+            jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32)
+        )
+        servables.append(
+            Servable.from_module(
+                "demo", module, variables,
+                max_batch=args.max_batch,
+                warmup_example=np.zeros((32, 32, 3), np.float32),
+                train=False,
+            )
+        )
+
+    app = ModelServerApp(ModelRepository(servables))
+    server, thread = serve(app, host=args.host, port=args.port)
+    logging.info(
+        "model server on :%d serving %s",
+        server.server_port, [s.name for s in servables],
+    )
+    thread.join()
+
+
+if __name__ == "__main__":
+    main()

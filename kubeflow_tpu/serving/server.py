@@ -1,0 +1,140 @@
+"""Model server: TF-Serving-compatible REST surface over JAX servables.
+
+Parity contract (`testing/test_tf_serving.py:107-118`): clients POST
+``/v1/models/<name>:predict`` with ``{"instances": [...]}`` and get
+``{"predictions": [...]}`` back; the E2E test compares predictions to a
+golden JSON within tolerance. ``GET /v1/models/<name>`` reports version
+state the way TF Serving's model-status API does.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from kubeflow_tpu.serving.servable import Servable
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+from kubeflow_tpu.web import (
+    App,
+    HttpError,
+    Request,
+    Response,
+    json_response,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ModelRepository:
+    """Named servables, hot-swappable by version (load() replaces)."""
+
+    def __init__(self, servables: Iterable[Servable] = ()):
+        self._models: dict[str, Servable] = {}
+        for s in servables:
+            self.load(s)
+
+    def load(self, servable: Servable) -> None:
+        prev = self._models.get(servable.name)
+        self._models[servable.name] = servable
+        if prev is not None:
+            log.info(
+                "model %s: version %d -> %d",
+                servable.name, prev.version, servable.version,
+            )
+
+    def get(self, name: str) -> Servable:
+        try:
+            return self._models[name]
+        except KeyError:
+            raise HttpError(404, f"model {name!r} not found") from None
+
+    def names(self) -> list[str]:
+        return sorted(self._models)
+
+
+class ModelServerApp(App):
+    def __init__(
+        self,
+        repository: ModelRepository,
+        *,
+        metrics: MetricsRegistry | None = None,
+    ):
+        super().__init__("model-server")
+        self.repository = repository
+        metrics = metrics or MetricsRegistry()
+        self.request_count = metrics.counter(
+            "serving_requests_total", "predict requests", ("model", "outcome")
+        )
+        self._metrics_registry = metrics
+        # The :predict verb lives inside the final path segment (TF Serving
+        # convention), so one route captures `name` or `name:verb` and the
+        # handler splits it.
+        self.add_route("/v1/models/<name>", self.model_get)
+        self.add_route("/v1/models/<name>", self.model_post, ("POST",))
+        self.add_route("/v1/models", self.models_list)
+        self.add_route("/metrics", self.metrics_text)
+
+    @staticmethod
+    def _split_verb(raw: str) -> tuple[str, str | None]:
+        if ":" in raw:
+            name, verb = raw.split(":", 1)
+            return name, verb
+        return raw, None
+
+    def models_list(self, req: Request) -> Response:
+        return json_response({"models": self.repository.names()})
+
+    def model_get(self, req: Request) -> Response:
+        name, verb = self._split_verb(req.path_params["name"])
+        if verb is not None:
+            raise HttpError(405, f"verb {verb!r} requires POST")
+        model = self.repository.get(name)
+        return json_response(
+            {
+                "model_version_status": [
+                    {
+                        "version": str(model.version),
+                        "state": "AVAILABLE",
+                        "status": {"error_code": "OK", "error_message": ""},
+                    }
+                ]
+            }
+        )
+
+    def model_post(self, req: Request) -> Response:
+        name, verb = self._split_verb(req.path_params["name"])
+        if verb != "predict":
+            raise HttpError(400, f"unsupported verb {verb!r}")
+        model = self.repository.get(name)
+        body = req.json()
+        instances = body.get("instances")
+        if not isinstance(instances, list) or not instances:
+            self.request_count.inc(model=name, outcome="invalid")
+            raise HttpError(400, "body must have a non-empty 'instances' list")
+        try:
+            predictions = model.predict(instances)
+        except HttpError:
+            raise
+        except Exception as e:
+            import jax
+
+            if isinstance(e, jax.errors.JaxRuntimeError):
+                # Device/runtime fault (preemption, OOM) on well-formed
+                # input — a server error, not the client's; let the App
+                # catch-all surface it as 500 so retries/alerts fire.
+                self.request_count.inc(model=name, outcome="error")
+                raise
+            # Everything else is malformed input: ragged lists (ValueError
+            # from np.asarray), wrong rank/shape (flax ScopeParamShapeError
+            # or jax TypeError) — all bad requests.
+            self.request_count.inc(model=name, outcome="invalid")
+            log.info("predict on %s rejected: %s", name, e)
+            raise HttpError(400, f"bad instances: {e}") from None
+        self.request_count.inc(model=name, outcome="ok")
+        return json_response({"predictions": predictions.tolist()})
+
+    def metrics_text(self, req: Request) -> Response:
+        return Response(
+            body=self._metrics_registry.expose_text().encode(),
+            content_type="text/plain; version=0.0.4",
+        )

@@ -45,11 +45,18 @@ SIGTERM_FILE = "SIGTERM"
 TERMINAL_PHASES = ("Succeeded", "Failed")
 
 
+def parse_hostport(address: str) -> tuple[str, int]:
+    host, sep, port = address.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"coordinator address {address!r} must be host:port")
+    return host, int(port)
+
+
 def coordinator_reachable(address: str, timeout: float = 1.0) -> bool:
     """Is the jax.distributed coordinator accepting connections?"""
-    host, _, port = address.rpartition(":")
+    host, port = parse_hostport(address)
     try:
-        with socket.create_connection((host, int(port)), timeout=timeout):
+        with socket.create_connection((host, port), timeout=timeout):
             return True
     except OSError:
         return False
@@ -92,6 +99,7 @@ class SidecarController:
         if coordinator_probe is not None:
             self.coordinator_probe = coordinator_probe
         elif coordinator:
+            parse_hostport(coordinator)  # fail fast on a malformed flag
             self.coordinator_probe = lambda: coordinator_reachable(coordinator)
         else:
             self.coordinator_probe = lambda: True
@@ -141,6 +149,13 @@ class SidecarController:
             # Master object gone ⇒ treat as terminated (the reference
             # treats a vanished master pod as done, `controller.py:95-99`).
             return "Failed"
+        except Exception as e:
+            # Transient apiserver trouble (connection refused, 5xx during
+            # a restart) must not kill the watch — a dead sidecar never
+            # writes SIGTERM and the main container hangs forever. Treat
+            # as "phase unknown"; the wait_done deadline still bounds us.
+            log.warning("sidecar: job poll failed (%s); will retry", e)
+            return None
         return job.status.get("phase")
 
     def wait_done(self) -> str:

@@ -88,14 +88,26 @@ class ApiServerApp(App):
             raise HttpError(400, "kind mismatch between path and body")
         return json_response(self.api.create(obj).to_dict(), status=201)
 
+    def _body_matching_path(self, req: Request) -> Resource:
+        """The path is authoritative: a body naming a different object than
+        the REST path is a client bug, not a write to the named object."""
+        obj = Resource.from_dict(req.json())
+        if (
+            obj.kind != req.path_params["kind"]
+            or obj.metadata.name != req.path_params["name"]
+            or (obj.metadata.namespace or "") != (_seg_ns(req.path_params["ns"]) or "")
+        ):
+            raise HttpError(400, "kind/namespace/name mismatch between path and body")
+        return obj
+
     def update(self, req: Request) -> Response:
         return json_response(
-            self.api.update(Resource.from_dict(req.json())).to_dict()
+            self.api.update(self._body_matching_path(req)).to_dict()
         )
 
     def update_status(self, req: Request) -> Response:
         return json_response(
-            self.api.update_status(Resource.from_dict(req.json())).to_dict()
+            self.api.update_status(self._body_matching_path(req)).to_dict()
         )
 
     def delete(self, req: Request) -> Response:

@@ -1,0 +1,155 @@
+"""Model-server tests — the in-process analog of the reference's
+golden-prediction serving E2E (`testing/test_tf_serving.py:60-156`)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.resnet import tiny_resnet
+from kubeflow_tpu.serving import ModelRepository, ModelServerApp, Servable
+from kubeflow_tpu.web import TestClient
+
+
+@pytest.fixture(scope="module")
+def model():
+    module = tiny_resnet(num_classes=10)
+    variables = jax.jit(module.init)(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32)
+    )
+    return module, variables
+
+
+@pytest.fixture(scope="module")
+def client(model):
+    module, variables = model
+    servable = Servable.from_module(
+        "mnist", module, variables, max_batch=8, train=False
+    )
+    repo = ModelRepository([servable])
+    return TestClient(ModelServerApp(repo))
+
+
+def _instances(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(n, 32, 32, 3).astype(np.float32).tolist()
+
+
+def test_model_status(client):
+    resp = client.get("/v1/models/mnist")
+    assert resp.status == 200
+    status = resp.json()["model_version_status"][0]
+    assert status["state"] == "AVAILABLE"
+    assert status["status"]["error_code"] == "OK"
+
+
+def test_unknown_model_404(client):
+    assert client.get("/v1/models/nope").status == 404
+    assert client.post("/v1/models/nope:predict", {"instances": [[1]]}).status == 404
+
+
+def test_predict_golden(client, model):
+    """The reference compares REST predictions to a golden JSON with
+    tolerance 0.001 (`test_tf_serving.py:40-58,107-118`). Our golden is the
+    direct (unbatched, unpadded) module apply — the server's bucket padding
+    must not change the numbers."""
+    module, variables = model
+    instances = _instances(3)
+    resp = client.post("/v1/models/mnist:predict", {"instances": instances})
+    assert resp.status == 200, resp.body
+    got = np.asarray(resp.json()["predictions"])
+    want = np.asarray(
+        module.apply(variables, np.asarray(instances, np.float32), train=False)
+    )
+    assert got.shape == (3, 10)
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_predict_oversized_batch_chunks(client):
+    # 19 instances > max_batch=8: chunked 8+8+3, order preserved.
+    instances = _instances(19, seed=1)
+    resp = client.post("/v1/models/mnist:predict", {"instances": instances})
+    assert resp.status == 200
+    preds = np.asarray(resp.json()["predictions"])
+    assert preds.shape == (19, 10)
+    # Same instance -> same prediction regardless of position/chunk.
+    solo = client.post(
+        "/v1/models/mnist:predict", {"instances": instances[17:18]}
+    )
+    np.testing.assert_allclose(
+        preds[17], np.asarray(solo.json()["predictions"])[0], atol=1e-3
+    )
+
+
+def test_predict_validation(client):
+    assert client.post("/v1/models/mnist:predict", {}).status == 400
+    assert (
+        client.post("/v1/models/mnist:predict", {"instances": []}).status == 400
+    )
+    assert (
+        client.post("/v1/models/mnist:frobnicate", {"instances": [[1]]}).status
+        == 400
+    )
+    bad_shape = client.post(
+        "/v1/models/mnist:predict", {"instances": [[1.0, 2.0]]}
+    )
+    assert bad_shape.status == 400
+
+
+def test_models_list_and_metrics(client):
+    assert client.get("/v1/models").json() == {"models": ["mnist"]}
+    metrics = client.get("/metrics")
+    assert metrics.status == 200
+    assert b"serving_requests_total" in metrics.body
+
+
+def test_from_checkpoint_roundtrip(tmp_path, model):
+    """Servable restores params written by the training Checkpointer and
+    reports the checkpoint step as its version."""
+    from kubeflow_tpu.train.checkpoint import Checkpointer
+
+    module, variables = model
+    ckpt = Checkpointer(tmp_path / "ckpt", save_interval_steps=1)
+    ckpt.save(7, variables, force=True)
+    ckpt.wait()
+    ckpt.close()
+
+    servable = Servable.from_checkpoint(
+        "restored",
+        module,
+        tmp_path / "ckpt",
+        np.zeros((1, 32, 32, 3), np.float32),
+        max_batch=4,
+        train=False,
+    )
+    assert servable.version == 7
+    want = np.asarray(
+        module.apply(variables, np.zeros((2, 32, 32, 3), np.float32), train=False)
+    )
+    got = servable.predict(np.zeros((2, 32, 32, 3), np.float32))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_hot_swap_version(model):
+    module, variables = model
+    repo = ModelRepository(
+        [Servable.from_module("m", module, variables, version=1, train=False)]
+    )
+    client = TestClient(ModelServerApp(repo))
+    assert (
+        client.get("/v1/models/m").json()["model_version_status"][0]["version"]
+        == "1"
+    )
+    repo.load(Servable.from_module("m", module, variables, version=2, train=False))
+    assert (
+        client.get("/v1/models/m").json()["model_version_status"][0]["version"]
+        == "2"
+    )
+
+
+def test_predictions_are_json_serializable(client):
+    resp = client.post(
+        "/v1/models/mnist:predict", {"instances": _instances(1)}
+    )
+    json.dumps(resp.json())  # must not raise

@@ -114,6 +114,38 @@ def test_vanished_job_is_failed(tmp_path):
     assert ctl.has_signal(SIGTERM_FILE)
 
 
+def test_transient_poll_errors_do_not_kill_watch(tmp_path):
+    """An apiserver blip mid-watch must not crash the sidecar — a dead
+    sidecar never writes SIGTERM and the main container hangs forever."""
+    api = FakeApiServer()
+    make_job(api, phase="Running")
+    ctl, clock = controller(api, tmp_path)
+
+    real_get = api.get
+    calls = {"n": 0}
+
+    def flaky_get(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionRefusedError("apiserver restarting")
+        if calls["n"] >= 4:
+            job = real_get("TpuJob", "job1", "team")
+            job.status["phase"] = "Succeeded"
+            return job
+        return real_get(*a, **kw)
+
+    ctl.api = type("A", (), {"get": staticmethod(flaky_get)})()
+    assert ctl.wait_done() == "Succeeded"
+    assert ctl.has_signal(SIGTERM_FILE)
+
+
+def test_malformed_coordinator_fails_fast(tmp_path):
+    with pytest.raises(ValueError, match="host:port"):
+        SidecarController(
+            workdir=tmp_path, job_name="j", coordinator="myhost"
+        )
+
+
 def test_watch_timeout_forces_sigterm(tmp_path):
     api = FakeApiServer()
     make_job(api, phase="Running")  # never terminates
