@@ -1,0 +1,243 @@
+"""Study: hyperparameter-search CRD — the platform's katib analog.
+
+The reference consumes katib as an externally deployed component and
+exercises it through a StudyJob CR whose `status.condition` is polled to
+Running/Completed (`testing/katib_studyjob_test.py:77-216`,
+`kf_is_ready_test.py:47-73` asserts the katib deployments). This is the
+in-repo, TPU-native equivalent: a `Study` CR describes a parameter space,
+an objective, and a trial template; the controller materializes trials as
+`TpuJob`s (so every trial is a gang-scheduled slice job) and harvests each
+trial's `status.observation` — reported by the launcher at job end, the
+TPU-native replacement for katib's log-scraping metrics-collector sidecars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any
+
+KIND = "Study"
+
+# Trial templates reference parameters as ${trialParameters.<name>} — the
+# same substitution surface katib's trial templates use.
+_PARAM_PREFIX = "${trialParameters."
+
+
+@dataclasses.dataclass(frozen=True)
+class ParameterSpec:
+    """One search dimension."""
+
+    name: str
+    type: str = "double"  # double | int | categorical
+    min: float | None = None
+    max: float | None = None
+    values: tuple[str, ...] = ()  # categorical
+    log_scale: bool = False  # sample 10^U(log10 min, log10 max)
+    grid_points: int = 3  # grid resolution for continuous dims
+
+    def validate(self) -> None:
+        if self.type in ("double", "int"):
+            if self.min is None or self.max is None or self.min > self.max:
+                raise ValueError(
+                    f"parameter {self.name!r}: needs min <= max"
+                )
+            if self.log_scale and self.min <= 0:
+                raise ValueError(
+                    f"parameter {self.name!r}: log scale needs min > 0"
+                )
+        elif self.type == "categorical":
+            if not self.values:
+                raise ValueError(
+                    f"parameter {self.name!r}: categorical needs values"
+                )
+        else:
+            raise ValueError(
+                f"parameter {self.name!r}: unknown type {self.type!r}"
+            )
+
+    def grid(self) -> list[Any]:
+        self.validate()
+        if self.type == "categorical":
+            return list(self.values)
+        if self.type == "int":
+            lo, hi = int(self.min), int(self.max)
+            n = min(self.grid_points, hi - lo + 1)
+            if n <= 1:
+                return [lo]
+            return sorted({round(lo + i * (hi - lo) / (n - 1)) for i in range(n)})
+        import math
+
+        n = max(self.grid_points, 2)
+        if self.log_scale:
+            lo, hi = math.log10(self.min), math.log10(self.max)
+            return [10 ** (lo + i * (hi - lo) / (n - 1)) for i in range(n)]
+        return [self.min + i * (self.max - self.min) / (n - 1) for i in range(n)]
+
+    def sample(self, rng: random.Random) -> Any:
+        self.validate()
+        if self.type == "categorical":
+            return rng.choice(list(self.values))
+        if self.type == "int":
+            return rng.randint(int(self.min), int(self.max))
+        import math
+
+        if self.log_scale:
+            return 10 ** rng.uniform(math.log10(self.min), math.log10(self.max))
+        return rng.uniform(self.min, self.max)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"name": self.name, "type": self.type}
+        if self.type == "categorical":
+            d["values"] = list(self.values)
+        else:
+            d["min"] = self.min
+            d["max"] = self.max
+            if self.log_scale:
+                d["logScale"] = True
+            d["gridPoints"] = self.grid_points
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ParameterSpec":
+        return cls(
+            name=d["name"],
+            type=d.get("type", "double"),
+            min=d.get("min"),
+            max=d.get("max"),
+            values=tuple(d.get("values") or ()),
+            log_scale=bool(d.get("logScale", False)),
+            grid_points=int(d.get("gridPoints", 3)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class StudySpec:
+    parameters: tuple[ParameterSpec, ...]
+    objective_metric: str = "loss"
+    goal: str = "minimize"  # minimize | maximize
+    algorithm: str = "random"  # random | grid
+    seed: int = 0
+    max_trials: int = 10
+    parallelism: int = 2
+    max_failed_trials: int = 3
+    # TpuJob spec dict with ${trialParameters.<name>} placeholders.
+    trial_template: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.parameters:
+            raise ValueError("study needs at least one parameter")
+        seen = set()
+        for p in self.parameters:
+            if p.name in seen:
+                raise ValueError(f"duplicate parameter {p.name!r}")
+            seen.add(p.name)
+            p.validate()
+        if self.goal not in ("minimize", "maximize"):
+            raise ValueError(f"goal must be minimize|maximize, got {self.goal!r}")
+        if self.algorithm not in ("random", "grid"):
+            raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.max_trials < 1 or self.parallelism < 1:
+            raise ValueError("max_trials and parallelism must be >= 1")
+
+    # -- suggestion ------------------------------------------------------
+
+    def grid_size(self) -> int:
+        size = 1
+        for p in self.parameters:
+            size *= len(p.grid())
+        return size
+
+    def grid_assignments(self) -> list[dict[str, Any]]:
+        """Cartesian product in parameter order (deterministic)."""
+        return [self._grid_assignment(i) for i in range(self.grid_size())]
+
+    def _grid_assignment(self, index: int) -> dict[str, Any]:
+        """Index the Cartesian product directly (mixed-radix, last
+        parameter fastest) — O(#params) per call, no enumeration, so a
+        reconcile over a 10^5-point grid stays cheap."""
+        assignment = {}
+        for p in reversed(self.parameters):
+            values = p.grid()
+            index, digit = divmod(index, len(values))
+            assignment[p.name] = values[digit]
+        return {p.name: assignment[p.name] for p in self.parameters}
+
+    def assignment_for(self, trial_index: int) -> dict[str, Any] | None:
+        """The parameter assignment for trial N, or None when the space is
+        exhausted. Deterministic in (spec, trial_index) so a restarted
+        controller regenerates identical trials (crash-safe suggestion
+        without persisted sampler state)."""
+        self.validate()
+        if self.algorithm == "grid":
+            if trial_index >= self.grid_size():
+                return None
+            return self._grid_assignment(trial_index)
+        rng = random.Random(f"{self.seed}:{trial_index}")
+        return {p.name: p.sample(rng) for p in self.parameters}
+
+    def total_trials(self) -> int:
+        if self.algorithm == "grid":
+            return min(self.max_trials, self.grid_size())
+        return self.max_trials
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "parameters": [p.to_dict() for p in self.parameters],
+            "objective": {"metric": self.objective_metric, "goal": self.goal},
+            "algorithm": {"name": self.algorithm, "seed": self.seed},
+            "maxTrials": self.max_trials,
+            "parallelism": self.parallelism,
+            "maxFailedTrials": self.max_failed_trials,
+            "trialTemplate": dict(self.trial_template),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "StudySpec":
+        objective = d.get("objective") or {}
+        algorithm = d.get("algorithm") or {}
+        spec = cls(
+            parameters=tuple(
+                ParameterSpec.from_dict(p) for p in d.get("parameters") or ()
+            ),
+            objective_metric=objective.get("metric", "loss"),
+            goal=objective.get("goal", "minimize"),
+            algorithm=algorithm.get("name", "random"),
+            seed=int(algorithm.get("seed", 0)),
+            max_trials=int(d.get("maxTrials", 10)),
+            parallelism=int(d.get("parallelism", 2)),
+            max_failed_trials=int(d.get("maxFailedTrials", 3)),
+            trial_template=dict(d.get("trialTemplate") or {}),
+        )
+        spec.validate()
+        return spec
+
+
+def render_template(template: Any, assignment: dict[str, Any]) -> Any:
+    """Substitute ${trialParameters.<name>} through a nested spec dict.
+
+    A string that is exactly one placeholder keeps the parameter's native
+    type; placeholders embedded in longer strings are formatted in (floats
+    with repr so values round-trip)."""
+
+    def fmt(v: Any) -> str:
+        return repr(v) if isinstance(v, float) else str(v)
+
+    def subst(node: Any) -> Any:
+        if isinstance(node, dict):
+            return {k: subst(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [subst(v) for v in node]
+        if isinstance(node, str):
+            for name, value in assignment.items():
+                placeholder = f"{_PARAM_PREFIX}{name}}}"
+                if node == placeholder:
+                    return value
+                if placeholder in node:
+                    node = node.replace(placeholder, fmt(value))
+            if _PARAM_PREFIX in node:
+                raise ValueError(f"unresolved trial parameter in {node!r}")
+            return node
+        return node
+
+    return subst(template)

@@ -23,7 +23,9 @@ from kubeflow_tpu.controllers import poddefault
 from kubeflow_tpu.controllers.notebook import NotebookController
 from kubeflow_tpu.controllers.profile import ProfileController
 from kubeflow_tpu.controllers.runtime import ControllerManager
+from kubeflow_tpu.controllers.study import StudyController
 from kubeflow_tpu.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.controllers.tpujob import TpuJobController
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 from kubeflow_tpu.web.authn import HeaderAuthn
 from kubeflow_tpu.web.wsgi import serve
@@ -55,6 +57,8 @@ def main() -> None:
         ProfileController(api),
         NotebookController(api),
         TensorboardController(api),
+        TpuJobController(api),
+        StudyController(api),
     ):
         manager.add(ctl.controller)
     poddefault.register(api)

@@ -106,6 +106,10 @@ class TpuJobController:
                 slice_id=idx // procs_per_slice,
             ).to_env()
         )
+        # Job identity, for in-workload status reporting (the Study trial
+        # observation contract, launcher.report_observation).
+        env["TPUJOB_NAME"] = job.metadata.name
+        env["TPUJOB_NAMESPACE"] = job.metadata.namespace
         # libtpu slice-assembly contract.
         env["TPU_WORKER_ID"] = str(idx % procs_per_slice)
         env["TPU_WORKER_HOSTNAMES"] = ",".join(

@@ -140,6 +140,17 @@ def tpujob_operator_bundle(spec: PlatformSpec) -> list[Resource]:
     ]
 
 
+def study_controller_bundle(spec: PlatformSpec) -> list[Resource]:
+    """The katib analog (`kf_is_ready_test.py:47-73` asserts the katib
+    deployment set): HP-search Studies whose trials are TpuJobs."""
+    return [
+        _crd("Study", "studies"),
+        _deployment(
+            "study-controller", "kubeflow-tpu/study-controller:v1", port=8443
+        ),
+    ]
+
+
 def notebook_controller_bundle(spec: PlatformSpec) -> list[Resource]:
     return [
         _crd("Notebook", "notebooks"),
@@ -264,7 +275,8 @@ def model_serving_bundle(spec: PlatformSpec) -> list[Resource]:
             "model-server", "kubeflow-tpu/model-server:v1", port=8500
         ),
         _service("model-server", 8500),
-        _vs("model-server", "/v1/models/", 8500, rewrite=None),
+        # No trailing slash: the list endpoint is GET /v1/models itself.
+        _vs("model-server", "/v1/models", 8500, rewrite=None),
     ]
 
 
@@ -273,6 +285,7 @@ BUNDLES: dict[str, BundleFn] = {
     "namespace": namespace_bundle,
     "gateway": gateway_bundle,
     "tpujob-operator": tpujob_operator_bundle,
+    "study-controller": study_controller_bundle,
     "notebook-controller": notebook_controller_bundle,
     "profile-controller": profile_controller_bundle,
     "tensorboard-controller": tensorboard_controller_bundle,
@@ -289,6 +302,7 @@ BUNDLES: dict[str, BundleFn] = {
 # 15-deployment core list in `kf_is_ready_test.py:101-115`.
 CORE_DEPLOYMENTS = [
     "tpu-job-operator",
+    "study-controller",
     "notebook-controller-deployment",
     "profiles-deployment",
     "tensorboard-controller-deployment",

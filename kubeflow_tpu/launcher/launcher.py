@@ -42,6 +42,28 @@ def run_and_stream(cmd: list[str]) -> int:
     return proc.wait()
 
 
+def report_observation(
+    api,
+    job_name: str,
+    namespace: str,
+    metrics: dict[str, float],
+) -> None:
+    """Publish final metrics onto the TpuJob's `status.observation`.
+
+    This is the trial-metric contract the Study controller harvests
+    (`kubeflow_tpu.controllers.study`) — the TPU-native replacement for
+    katib's log-scraping metrics-collector sidecar: process 0 calls this
+    once at the end of training with e.g. ``{"loss": 0.12}``. `api` is
+    anything with the FakeApiServer get/update_status surface (in-cluster:
+    an HttpApiClient at the apiserver facade)."""
+    job = api.get("TpuJob", job_name, namespace)
+    observation = dict(job.status.get("observation") or {})
+    observation.update({k: float(v) for k, v in metrics.items()})
+    job.status["observation"] = observation
+    api.update_status(job)
+    log.info("reported observation %s for %s/%s", metrics, namespace, job_name)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="kubeflow-tpu-launcher")
     parser.add_argument(

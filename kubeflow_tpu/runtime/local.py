@@ -68,7 +68,9 @@ class LocalPodRunner:
         env = dict(os.environ)
         env.update(self.extra_env)
         for e in pod.spec["containers"][0].get("env", []):
-            env[e["name"]] = e["value"]
+            # Rendered trial templates may carry typed values; process env
+            # must be strings.
+            env[e["name"]] = str(e["value"])
         coord = env.get("TPUJOB_COORDINATOR")
         if coord:
             # One port per gang *incarnation*: a restarted gang must not
@@ -101,7 +103,11 @@ class LocalPodRunner:
 
     def _start(self, pod: Resource, key: tuple[str, str]) -> None:
         c = pod.spec["containers"][0]
-        cmd = list(c.get("command", [])) + list(c.get("args", []))
+        # argv must be strings; rendered trial templates may carry typed
+        # parameter values (e.g. a float lr) in args.
+        cmd = [
+            str(x) for x in list(c.get("command", [])) + list(c.get("args", []))
+        ]
         if not cmd:
             self._set_phase(pod, "Failed")
             return

@@ -1,0 +1,80 @@
+"""Katib-analog E2E, fully in-process (the reference needed a live GKE
+cluster for this — `testing/katib_studyjob_test.py`):
+
+Study CR → StudyController suggests trials → TpuJob operator gangs them →
+local runner execs real trial processes → each reports its objective over
+the HTTP apiserver facade → controller harvests observations, spawns the
+next wave, and lands on Succeeded with the true best trial.
+"""
+
+import os
+import sys
+import time
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.study import KIND, ParameterSpec, StudySpec
+from kubeflow_tpu.controllers.study import StudyController
+from kubeflow_tpu.controllers.tpujob import TpuJobController
+from kubeflow_tpu.runtime import LocalPodRunner
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+from kubeflow_tpu.web.wsgi import serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+WORKER = os.path.join(REPO, "tests", "e2e", "trial_worker.py")
+
+
+def test_study_end_to_end(tmp_path):
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    study_ctl = StudyController(api)
+    job_ctl = TpuJobController(api)
+    runner = LocalPodRunner(
+        api,
+        extra_env={
+            "KFTPU_REPO": REPO,
+            "KFTPU_APISERVER": f"http://127.0.0.1:{server.server_port}",
+        },
+        capture_dir=str(tmp_path / "logs"),
+    )
+
+    spec = StudySpec(
+        parameters=(
+            ParameterSpec("lr", "double", min=0.01, max=0.09, grid_points=3),
+        ),
+        objective_metric="loss",
+        goal="minimize",
+        algorithm="grid",
+        parallelism=2,
+        trial_template={
+            "replicas": 1,
+            "image": "local",
+            "command": [sys.executable, WORKER],
+            "args": ["--lr", "${trialParameters.lr}"],
+            "tpu": {"chipsPerWorker": 0},
+            "maxRestarts": 0,
+        },
+    )
+    api.create(new_resource(KIND, "sweep", "default", spec=spec.to_dict()))
+
+    deadline = time.time() + 150
+    try:
+        while time.time() < deadline:
+            study_ctl.controller.run_until_idle()
+            job_ctl.controller.run_until_idle()
+            runner.step()
+            phase = api.get(KIND, "sweep").status.get("phase")
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+    finally:
+        runner.shutdown()
+        server.shutdown()
+
+    study = api.get(KIND, "sweep")
+    assert study.status.get("phase") == "Succeeded", study.status
+    # grid over lr = {0.01, 0.05, 0.09}; loss=(lr-0.05)^2 minimized at 0.05.
+    best = study.status["bestTrial"]
+    assert abs(best["objective"]) < 1e-12, best
+    assert len(study.status["trials"]) == 3
+    assert study.status["conditions"][-1]["type"] == "Completed"

@@ -1,0 +1,36 @@
+"""Study-trial worker: a stand-in training process.
+
+Computes a deterministic objective from its --lr flag and reports it onto
+its TpuJob's status.observation through the HTTP apiserver facade — the
+exact contract a real trial uses (launcher.report_observation from
+process 0 at job end)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+from kubeflow_tpu.launcher.launcher import report_observation  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lr", type=float, required=True)
+    args = parser.parse_args()
+
+    loss = (args.lr - 0.05) ** 2  # minimum at lr=0.05
+
+    api = HttpApiClient(os.environ["KFTPU_APISERVER"])
+    report_observation(
+        api,
+        os.environ["TPUJOB_NAME"],
+        os.environ["TPUJOB_NAMESPACE"],
+        {"loss": loss},
+    )
+    print(f"trial done lr={args.lr} loss={loss}")
+
+
+if __name__ == "__main__":
+    main()
