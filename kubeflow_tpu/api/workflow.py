@@ -1,0 +1,144 @@
+"""Workflow: DAG-of-steps CRD — the platform's Argo-workflow analog.
+
+The reference's CI and its ml-pipeline component both run on Argo: jsonnet
+DAGs of container steps sharing an NFS volume, with an exit handler that
+tears down no matter what (`testing/workflows/components/
+kfctl_go_test.jsonnet:88-165,384-391`, `workflows.libsonnet:348-397`).
+This CRD captures that shape natively: steps with dependencies, per-step
+retries, a shared artifacts volume, and an `onExit` step that always runs
+once the DAG is terminal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+KIND = "Workflow"
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSpec:
+    """One DAG node: a container run to completion."""
+
+    name: str
+    command: tuple[str, ...] = ()
+    args: tuple[str, ...] = ()
+    image: str = "kubeflow-tpu/ci-runner:latest"
+    env: tuple[tuple[str, str], ...] = ()
+    dependencies: tuple[str, ...] = ()
+    retries: int = 0
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("step needs a name")
+        if not self.command:
+            raise ValueError(f"step {self.name!r} needs a command")
+        if self.retries < 0:
+            raise ValueError(f"step {self.name!r}: retries must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "command": list(self.command),
+            "args": list(self.args),
+            "image": self.image,
+            "env": [{"name": k, "value": v} for k, v in self.env],
+            "dependencies": list(self.dependencies),
+            "retries": self.retries,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "StepSpec":
+        return cls(
+            name=d.get("name", ""),
+            command=tuple(d.get("command") or ()),
+            args=tuple(d.get("args") or ()),
+            image=d.get("image", "kubeflow-tpu/ci-runner:latest"),
+            env=tuple(
+                (e["name"], e["value"]) for e in d.get("env") or ()
+            ),
+            dependencies=tuple(d.get("dependencies") or ()),
+            retries=int(d.get("retries", 0)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkflowSpec:
+    steps: tuple[StepSpec, ...]
+    # Runs exactly once when the DAG reaches a terminal state, success or
+    # failure — the Argo exit-handler (teardown) contract.
+    on_exit: StepSpec | None = None
+    # Host path every step sees at STEP_ARTIFACTS (the NFS share analog).
+    artifacts_dir: str = ""
+    parallelism: int = 8
+
+    def validate(self) -> None:
+        if not self.steps:
+            raise ValueError("workflow needs at least one step")
+        names = set()
+        for s in self.steps:
+            s.validate()
+            if s.name in names:
+                raise ValueError(f"duplicate step {s.name!r}")
+            names.add(s.name)
+        if self.on_exit is not None:
+            self.on_exit.validate()
+            if self.on_exit.name in names:
+                raise ValueError("onExit step name collides with a DAG step")
+            if self.on_exit.dependencies:
+                raise ValueError("onExit step cannot have dependencies")
+        for s in self.steps:
+            for dep in s.dependencies:
+                if dep not in names:
+                    raise ValueError(
+                        f"step {s.name!r} depends on unknown step {dep!r}"
+                    )
+        self._check_acyclic()
+        if self.parallelism < 1:
+            raise ValueError("parallelism must be >= 1")
+
+    def _check_acyclic(self) -> None:
+        deps = {s.name: set(s.dependencies) for s in self.steps}
+        done: set[str] = set()
+        while deps:
+            ready = [n for n, d in deps.items() if d <= done]
+            if not ready:
+                raise ValueError(
+                    f"dependency cycle among steps {sorted(deps)}"
+                )
+            for n in ready:
+                del deps[n]
+                done.add(n)
+
+    def step(self, name: str) -> StepSpec:
+        for s in self.steps:
+            if s.name == name:
+                return s
+        if self.on_exit is not None and self.on_exit.name == name:
+            return self.on_exit
+        raise KeyError(name)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "steps": [s.to_dict() for s in self.steps],
+            "parallelism": self.parallelism,
+        }
+        if self.on_exit is not None:
+            d["onExit"] = self.on_exit.to_dict()
+        if self.artifacts_dir:
+            d["artifactsDir"] = self.artifacts_dir
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "WorkflowSpec":
+        spec = cls(
+            steps=tuple(StepSpec.from_dict(s) for s in d.get("steps") or ()),
+            on_exit=(
+                StepSpec.from_dict(d["onExit"]) if d.get("onExit") else None
+            ),
+            artifacts_dir=d.get("artifactsDir", ""),
+            parallelism=int(d.get("parallelism", 8)),
+        )
+        spec.validate()
+        return spec

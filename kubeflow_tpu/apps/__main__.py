@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import threading
 import time
 
 from kubeflow_tpu.api.rbac import make_cluster_role_binding, seed_cluster_roles
@@ -26,6 +27,8 @@ from kubeflow_tpu.controllers.runtime import ControllerManager
 from kubeflow_tpu.controllers.study import StudyController
 from kubeflow_tpu.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controllers.tpujob import TpuJobController
+from kubeflow_tpu.controllers.workflow import WorkflowController
+from kubeflow_tpu.runtime import LocalPodRunner
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 from kubeflow_tpu.web.authn import HeaderAuthn
 from kubeflow_tpu.web.wsgi import serve
@@ -59,10 +62,23 @@ def main() -> None:
         TensorboardController(api),
         TpuJobController(api),
         StudyController(api),
+        WorkflowController(api),
     ):
         manager.add(ctl.controller)
     poddefault.register(api)
     manager.start()
+
+    # Pod runtime: without one, TpuJob/Study/Workflow pods would sit
+    # Pending forever. Locally, pods run as subprocesses.
+    runner = LocalPodRunner(api)
+    runner_stop = threading.Event()
+
+    def _run_pods():
+        while not runner_stop.is_set():
+            runner.step()
+            runner_stop.wait(0.2)
+
+    threading.Thread(target=_run_pods, name="pod-runner", daemon=True).start()
 
     authn = HeaderAuthn(anonymous=args.anonymous)
     apps = [
@@ -80,6 +96,8 @@ def main() -> None:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        runner_stop.set()
+        runner.shutdown()
         for server in servers:
             server.shutdown()
 

@@ -66,11 +66,12 @@ class StudyController:
     # -- trial materialization -------------------------------------------
 
     def _create_trial(
-        self, study: Resource, spec: study_api.StudySpec, index: int
+        self,
+        study: Resource,
+        spec: study_api.StudySpec,
+        index: int,
+        assignment: dict,
     ) -> None:
-        assignment = spec.assignment_for(index)
-        if assignment is None:
-            return
         job_spec = study_api.render_template(
             dict(spec.trial_template), assignment
         )
@@ -135,7 +136,10 @@ class StudyController:
                 # NaN (diverged trial) must never win — every NaN
                 # comparison is False, so once seated it could not be
                 # displaced either.
-                if value is not None and math.isfinite(value):
+                # isinstance first: observation is client-writable through
+                # the HTTP facade, so a non-numeric value must not crash
+                # the reconcile loop.
+                if isinstance(value, (int, float)) and math.isfinite(value):
                     better = (
                         best is None
                         or (spec.goal == "minimize" and value < best["objective"])
@@ -156,6 +160,16 @@ class StudyController:
                 f"{spec.max_failed_trials}",
                 type_="Warning",
             )
+            # Kill in-flight trials (katib semantics): a failed study must
+            # not keep occupying gang-scheduled slices.
+            for idx, trial in by_index.items():
+                if trial.status.get("phase") not in TRIAL_TERMINAL:
+                    try:
+                        api.delete(
+                            tpujob_api.KIND, trial.metadata.name, ns
+                        )
+                    except NotFound:
+                        pass
             return self._finish(
                 api, study, "Failed", trials=rows, best=best,
                 reason="maxFailedTrials exceeded",
@@ -173,7 +187,7 @@ class StudyController:
                 # study must still terminate below).
                 exhausted = True
                 break
-            self._create_trial(study, spec, next_index)
+            self._create_trial(study, spec, next_index, assignment)
             log.info(
                 "study %s/%s: trial %d -> %s", ns, name, next_index, assignment
             )

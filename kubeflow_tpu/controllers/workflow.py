@@ -1,0 +1,253 @@
+"""Workflow controller: drives a DAG of step Pods to completion.
+
+The Argo-engine analog (the reference runs its whole CI and its
+ml-pipeline component on Argo, `testing/README.md:22-35`): level-triggered
+like every other controller here — each reconcile reads the observed step
+pods and creates whatever steps have all dependencies satisfied, up to
+`spec.parallelism`. Failures retry up to the step's budget by deleting the
+failed pod (attempt count lives in status, so a recreated pod is a fresh
+attempt). When the DAG is terminal the `onExit` step runs exactly once,
+success or failure — teardown must never be skipped
+(`kfctl_go_test.jsonnet:384-391`).
+
+Step pods carry STEP_NAME / WORKFLOW_NAME / STEP_ARTIFACTS env (the
+shared-volume contract of `workflows.libsonnet:145`).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.api import workflow as wf_api
+from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.controllers.runtime import Controller, Key, Result
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+LABEL_WORKFLOW = "kubeflow-tpu.org/workflow"
+LABEL_STEP = "kubeflow-tpu.org/step"
+LABEL_ATTEMPT = "kubeflow-tpu.org/attempt"
+
+TERMINAL = ("Succeeded", "Failed")
+
+
+def step_pod_name(workflow: str, step: str, attempt: int) -> str:
+    return f"{workflow}-{step}-{attempt}"
+
+
+class WorkflowController:
+    def __init__(self, api: FakeApiServer, metrics: MetricsRegistry | None = None):
+        self.api = api
+        metrics = metrics or MetricsRegistry()
+        self.steps_total = metrics.counter(
+            "workflow_steps_total", "step pods created", ("workflow",)
+        )
+        self.workflows_running = metrics.gauge(
+            "workflow_running", "Workflows currently running"
+        )
+        self.controller = Controller(
+            api,
+            wf_api.KIND,
+            self.reconcile,
+            owns=("Pod",),
+            name="workflow-controller",
+            metrics=metrics,
+        )
+
+    # -- pod materialization ---------------------------------------------
+
+    def _create_step_pod(
+        self,
+        workflow: Resource,
+        spec: wf_api.WorkflowSpec,
+        step: wf_api.StepSpec,
+        attempt: int,
+    ) -> None:
+        env = dict(step.env)
+        env["WORKFLOW_NAME"] = workflow.metadata.name
+        env["STEP_NAME"] = step.name
+        if spec.artifacts_dir:
+            env["STEP_ARTIFACTS"] = spec.artifacts_dir
+        pod = new_resource(
+            "Pod",
+            step_pod_name(workflow.metadata.name, step.name, attempt),
+            workflow.metadata.namespace,
+            spec={
+                "containers": [
+                    {
+                        "name": "main",
+                        "image": step.image,
+                        "command": list(step.command),
+                        "args": list(step.args),
+                        "env": [
+                            {"name": k, "value": v}
+                            for k, v in sorted(env.items())
+                        ],
+                    }
+                ],
+                "restartPolicy": "Never",
+            },
+            labels={
+                LABEL_WORKFLOW: workflow.metadata.name,
+                LABEL_STEP: step.name,
+                LABEL_ATTEMPT: str(attempt),
+            },
+        )
+        pod.metadata.owner_references = [owner_ref(workflow)]
+        self.api.create(pod)
+        self.steps_total.inc(workflow=workflow.metadata.name)
+
+    # -- reconcile --------------------------------------------------------
+
+    def reconcile(self, api: FakeApiServer, key: Key) -> Result:
+        ns, name = key
+        try:
+            wf = api.get(wf_api.KIND, name, ns)
+        except NotFound:
+            return Result()
+        if wf.status.get("phase") in TERMINAL:
+            return Result()
+        try:
+            spec = wf_api.WorkflowSpec.from_dict(wf.spec)
+        except ValueError as e:
+            api.record_event(wf, "InvalidSpec", str(e), type_="Warning")
+            return self._set_status(api, wf, "Failed", reason=str(e))
+
+        pods = api.list("Pod", ns, label_selector={LABEL_WORKFLOW: name})
+        by_step: dict[str, list[Resource]] = {}
+        for p in pods:
+            by_step.setdefault(p.metadata.labels.get(LABEL_STEP, ""), []).append(p)
+
+        # Observed per-step state. A step is Succeeded if any attempt
+        # succeeded; Failed once attempts exceed its retry budget;
+        # Running while an attempt is in flight.
+        steps_status: dict[str, dict] = {}
+        active = 0
+        for step in spec.steps:
+            attempts = by_step.get(step.name, [])
+            phases = [p.status.get("phase", "Pending") for p in attempts]
+            state = "Pending"
+            if any(ph == "Succeeded" for ph in phases):
+                state = "Succeeded"
+            elif any(ph in ("Pending", "Running") for ph in phases):
+                state = "Running"
+                active += 1
+            elif attempts:
+                failures = sum(ph == "Failed" for ph in phases)
+                if failures > step.retries:
+                    state = "Failed"
+                else:
+                    state = "Retrying"  # next pass creates attempt N+1
+            steps_status[step.name] = {
+                "state": state,
+                "attempts": len(attempts),
+            }
+
+        # Schedule: dependencies satisfied, budget left, parallelism cap.
+        dag_failed = any(
+            s["state"] == "Failed" for s in steps_status.values()
+        )
+        for step in spec.steps:
+            if active >= spec.parallelism:
+                break
+            st = steps_status[step.name]
+            if st["state"] not in ("Pending", "Retrying"):
+                continue
+            if dag_failed:
+                # Fail-fast: no new steps once any step is terminally
+                # failed (Argo's default DAG behavior); running ones drain.
+                continue
+            if not all(
+                steps_status[d]["state"] == "Succeeded"
+                for d in step.dependencies
+            ):
+                continue
+            self._create_step_pod(wf, spec, step, st["attempts"])
+            st["state"] = "Running"
+            st["attempts"] += 1
+            active += 1
+
+        dag_done = all(
+            s["state"] == "Succeeded" for s in steps_status.values()
+        )
+        dag_terminal = dag_done or (dag_failed and active == 0)
+
+        # Exit handler: once, after the DAG is terminal.
+        exit_state = None
+        if spec.on_exit is not None and dag_terminal:
+            exit_attempts = by_step.get(spec.on_exit.name, [])
+            exit_phases = [
+                p.status.get("phase", "Pending") for p in exit_attempts
+            ]
+            if not exit_attempts:
+                self._create_step_pod(wf, spec, spec.on_exit, 0)
+                exit_state = "Running"
+            elif any(ph == "Succeeded" for ph in exit_phases):
+                exit_state = "Succeeded"
+            elif any(ph in ("Pending", "Running") for ph in exit_phases):
+                exit_state = "Running"
+            else:
+                failures = sum(ph == "Failed" for ph in exit_phases)
+                if failures > spec.on_exit.retries:
+                    exit_state = "Failed"
+                else:
+                    self._create_step_pod(
+                        wf, spec, spec.on_exit, len(exit_attempts)
+                    )
+                    exit_state = "Running"
+            steps_status[spec.on_exit.name] = {
+                "state": exit_state,
+                "attempts": len(by_step.get(spec.on_exit.name, [])),
+            }
+
+        if dag_terminal and (spec.on_exit is None or exit_state in TERMINAL):
+            phase = "Succeeded" if dag_done else "Failed"
+            # A failing teardown fails the workflow even if the DAG
+            # succeeded — leaked clusters must be loud.
+            if exit_state == "Failed":
+                phase = "Failed"
+            api.record_event(
+                wf,
+                "WorkflowSucceeded" if phase == "Succeeded" else "WorkflowFailed",
+                f"DAG {'succeeded' if dag_done else 'failed'}",
+                type_="Normal" if phase == "Succeeded" else "Warning",
+            )
+            return self._set_status(api, wf, phase, steps=steps_status)
+
+        return self._set_status(api, wf, "Running", steps=steps_status)
+
+    # -- status -----------------------------------------------------------
+
+    def _set_status(
+        self,
+        api: FakeApiServer,
+        wf: Resource,
+        phase: str,
+        *,
+        steps: dict | None = None,
+        reason: str | None = None,
+    ) -> Result:
+        fresh = api.get(wf_api.KIND, wf.metadata.name, wf.metadata.namespace)
+        new_status = dict(fresh.status)
+        if steps is not None:
+            new_status["steps"] = steps
+        if reason is not None:
+            new_status["reason"] = reason
+        if new_status.get("phase") != phase:
+            new_status["phase"] = phase
+            new_status["conditions"] = list(
+                new_status.get("conditions", [])
+            ) + [{"type": phase}]
+        if new_status != fresh.status:
+            fresh.status = new_status
+            api.update_status(fresh)
+        self.workflows_running.set(
+            sum(
+                1
+                for w in api.list(wf_api.KIND)
+                if w.status.get("phase") == "Running"
+            )
+        )
+        return Result()

@@ -73,8 +73,19 @@ def _vs(
     name: str, prefix: str, port: int, *, rewrite: str | None = "/"
 ) -> Resource:
     """rewrite=None keeps the matched prefix (for backends whose routes
-    include it, e.g. the model server's /v1/models/...)."""
-    http_route: dict = {"match": [{"uri": {"prefix": prefix}}]}
+    include it, e.g. the model server's /v1/models/...).
+
+    A prefix with no trailing slash gets the segment-safe pair of
+    matches (exact "/p" + prefix "/p/") — a bare string prefix would
+    also capture sibling paths like "/p-admin"."""
+    if prefix.endswith("/"):
+        match = [{"uri": {"prefix": prefix}}]
+    else:
+        match = [
+            {"uri": {"exact": prefix}},
+            {"uri": {"prefix": prefix + "/"}},
+        ]
+    http_route: dict = {"match": match}
     if rewrite is not None:
         http_route["rewrite"] = {"uri": rewrite}
     return new_resource(
@@ -147,6 +158,18 @@ def study_controller_bundle(spec: PlatformSpec) -> list[Resource]:
         _crd("Study", "studies"),
         _deployment(
             "study-controller", "kubeflow-tpu/study-controller:v1", port=8443
+        ),
+    ]
+
+
+def workflow_controller_bundle(spec: PlatformSpec) -> list[Resource]:
+    """The Argo / ml-pipeline analog (`kf_is_ready_test.py:101-115`
+    asserts ml-pipeline's deployments): DAG workflows of step pods."""
+    return [
+        _crd("Workflow", "workflows"),
+        _deployment(
+            "workflow-controller", "kubeflow-tpu/workflow-controller:v1",
+            port=8443,
         ),
     ]
 
@@ -286,6 +309,7 @@ BUNDLES: dict[str, BundleFn] = {
     "gateway": gateway_bundle,
     "tpujob-operator": tpujob_operator_bundle,
     "study-controller": study_controller_bundle,
+    "workflow-controller": workflow_controller_bundle,
     "notebook-controller": notebook_controller_bundle,
     "profile-controller": profile_controller_bundle,
     "tensorboard-controller": tensorboard_controller_bundle,
@@ -303,6 +327,7 @@ BUNDLES: dict[str, BundleFn] = {
 CORE_DEPLOYMENTS = [
     "tpu-job-operator",
     "study-controller",
+    "workflow-controller",
     "notebook-controller-deployment",
     "profiles-deployment",
     "tensorboard-controller-deployment",

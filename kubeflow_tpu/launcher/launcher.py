@@ -22,6 +22,7 @@ import importlib
 import logging
 import subprocess
 import sys
+import time
 
 from kubeflow_tpu.parallel import distributed as dist
 
@@ -56,11 +57,23 @@ def report_observation(
     once at the end of training with e.g. ``{"loss": 0.12}``. `api` is
     anything with the FakeApiServer get/update_status surface (in-cluster:
     an HttpApiClient at the apiserver facade)."""
-    job = api.get("TpuJob", job_name, namespace)
-    observation = dict(job.status.get("observation") or {})
-    observation.update({k: float(v) for k, v in metrics.items()})
-    job.status["observation"] = observation
-    api.update_status(job)
+    from kubeflow_tpu.testing.fake_apiserver import Conflict
+
+    # Read-modify-write races with the operator's own status updates;
+    # retry on Conflict — losing the observation would record a trained
+    # trial as Failed.
+    for attempt in range(10):
+        job = api.get("TpuJob", job_name, namespace)
+        observation = dict(job.status.get("observation") or {})
+        observation.update({k: float(v) for k, v in metrics.items()})
+        job.status["observation"] = observation
+        try:
+            api.update_status(job)
+            break
+        except Conflict:
+            if attempt == 9:
+                raise
+            time.sleep(0.05 * (attempt + 1))
     log.info("reported observation %s for %s/%s", metrics, namespace, job_name)
 
 

@@ -1,0 +1,108 @@
+"""CI workflow definitions — the jsonnet-workflow analog, in Python.
+
+The reference defines its Prow-triggered CI as Argo DAGs in jsonnet
+(`testing/workflows/components/unit_tests.jsonnet`,
+`kfctl_go_test.jsonnet:88-165`: checkout → build → deploy → pytest suites
+→ teardown-in-exit-handler, all sharing an NFS volume with junit copied
+out for Gubernator). These builders produce the same DAG shapes as
+`Workflow` CRs for our workflow controller; `python -m pytest` replaces
+the container images when run via the local pod runner.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from kubeflow_tpu.api.objects import Resource, new_resource
+from kubeflow_tpu.api.workflow import KIND, StepSpec, WorkflowSpec
+
+
+def _pytest_step(
+    name: str,
+    target: str,
+    *,
+    dependencies: tuple[str, ...] = (),
+    junit_dir: str = "",
+    retries: int = 0,
+) -> StepSpec:
+    args = ["-m", "pytest", target, "-q"]
+    if junit_dir:
+        args += [f"--junitxml={junit_dir}/junit_{name}.xml"]
+    return StepSpec(
+        name=name,
+        command=(sys.executable,),
+        args=tuple(args),
+        dependencies=dependencies,
+        retries=retries,
+    )
+
+
+def unit_tests_workflow(
+    name: str = "unit-tests",
+    namespace: str = "kubeflow-ci",
+    *,
+    artifacts_dir: str = "",
+) -> Resource:
+    """The `unit_tests.jsonnet` analog — the only workflow active in the
+    reference's `prow_config.yaml:8-12`: lint + unit suites in parallel,
+    junit into the shared artifacts dir."""
+    spec = WorkflowSpec(
+        steps=(
+            _pytest_step("test-core", "tests/", junit_dir=artifacts_dir),
+            StepSpec(
+                name="lint",
+                command=(sys.executable, "-m", "compileall", "-q"),
+                args=("kubeflow_tpu",),
+            ),
+        ),
+        artifacts_dir=artifacts_dir,
+    )
+    return new_resource(KIND, name, namespace, spec=spec.to_dict())
+
+
+def platform_e2e_workflow(
+    name: str = "platform-e2e",
+    namespace: str = "kubeflow-ci",
+    *,
+    artifacts_dir: str = "",
+    deploy_args: tuple[str, ...] = (),
+) -> Resource:
+    """The `kfctl_go_test.jsonnet` analog: deploy the platform, assert
+    readiness, run the conformance suites, tear down in the exit handler
+    no matter what (:384-391)."""
+    py = sys.executable
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(
+                name="deploy",
+                command=(py, "-m", "kubeflow_tpu.deploy", "apply"),
+                args=deploy_args,
+                retries=2,  # the reference retried Apply(K8S) x3
+            ),
+            _pytest_step(
+                "kf-is-ready",
+                "tests/test_deploy.py",
+                dependencies=("deploy",),
+                junit_dir=artifacts_dir,
+            ),
+            _pytest_step(
+                "serving-golden",
+                "tests/test_serving.py",
+                dependencies=("deploy",),
+                junit_dir=artifacts_dir,
+            ),
+            _pytest_step(
+                "studyjob",
+                "tests/test_study.py",
+                dependencies=("deploy",),
+                junit_dir=artifacts_dir,
+            ),
+        ),
+        on_exit=StepSpec(
+            name="teardown",
+            command=(py, "-m", "kubeflow_tpu.deploy", "delete"),
+            args=deploy_args,
+        ),
+        artifacts_dir=artifacts_dir,
+    )
+    return new_resource(KIND, name, namespace, spec=spec.to_dict())

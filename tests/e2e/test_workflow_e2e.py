@@ -1,0 +1,99 @@
+"""Workflow-engine E2E: a real diamond DAG of subprocesses sharing an
+artifacts dir, with the exit handler always running — the in-process
+analog of an Argo CI run (`kfctl_go_test.jsonnet` DAG + NFS volume +
+exit-handler teardown)."""
+
+import sys
+import time
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.workflow import KIND, StepSpec, WorkflowSpec
+from kubeflow_tpu.controllers.workflow import WorkflowController
+from kubeflow_tpu.runtime import LocalPodRunner
+from kubeflow_tpu.testing import FakeApiServer
+
+def _write_step(name, deps=()):
+    return StepSpec(
+        name=name,
+        command=(
+            sys.executable,
+            "-c",
+            "import os,time,pathlib;"
+            "d=pathlib.Path(os.environ['STEP_ARTIFACTS']);"
+            "d.mkdir(parents=True,exist_ok=True);"
+            "(d/(os.environ['STEP_NAME']+'.txt'))"
+            ".write_text(str(time.time_ns()))",
+        ),
+        dependencies=tuple(deps),
+    )
+
+
+def _drive(api, ctl, runner, name, deadline_s=120):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        ctl.controller.run_until_idle()
+        runner.step()
+        phase = api.get(KIND, name, "ci").status.get("phase")
+        if phase in ("Succeeded", "Failed"):
+            return phase
+        time.sleep(0.1)
+    raise TimeoutError("workflow did not finish")
+
+
+def test_diamond_dag_end_to_end(tmp_path):
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    runner = LocalPodRunner(api)
+    artifacts = tmp_path / "artifacts"
+
+    spec = WorkflowSpec(
+        steps=(
+            _write_step("a"),
+            _write_step("b", deps=["a"]),
+            _write_step("c", deps=["a"]),
+            _write_step("d", deps=["b", "c"]),
+        ),
+        on_exit=_write_step("teardown"),
+        artifacts_dir=str(artifacts),
+    )
+    api.create(new_resource(KIND, "diamond", "ci", spec=spec.to_dict()))
+    try:
+        phase = _drive(api, ctl, runner, "diamond")
+    finally:
+        runner.shutdown()
+
+    assert phase == "Succeeded"
+    stamps = {
+        p.stem: int(p.read_text()) for p in artifacts.glob("*.txt")
+    }
+    assert set(stamps) == {"a", "b", "c", "d", "teardown"}
+    assert stamps["a"] < stamps["b"] and stamps["a"] < stamps["c"]
+    assert stamps["d"] > stamps["b"] and stamps["d"] > stamps["c"]
+
+
+def test_failing_step_still_tears_down(tmp_path):
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    runner = LocalPodRunner(api)
+    artifacts = tmp_path / "artifacts"
+
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(
+                name="boom",
+                command=(sys.executable, "-c", "import sys; sys.exit(3)"),
+            ),
+            _write_step("never", deps=["boom"]),
+        ),
+        on_exit=_write_step("teardown"),
+        artifacts_dir=str(artifacts),
+    )
+    api.create(new_resource(KIND, "failing", "ci", spec=spec.to_dict()))
+    try:
+        phase = _drive(api, ctl, runner, "failing")
+    finally:
+        runner.shutdown()
+
+    assert phase == "Failed"
+    files = {p.stem for p in artifacts.glob("*.txt")}
+    assert "teardown" in files and "never" not in files

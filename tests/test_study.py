@@ -240,6 +240,41 @@ def test_grid_indexing_matches_enumeration():
     ]
 
 
+def test_failed_study_kills_active_trials():
+    """katib semantics: a study over its failure budget must not keep
+    occupying gang-scheduled slices with in-flight trials."""
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    make_study(api, algorithm="grid", parallelism=4, max_failed=0)
+    ctl.controller.run_until_idle()
+    trials = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    assert len(trials) == 4
+    finish_trial(api, trials[0].metadata.name, phase="Failed")
+    ctl.controller.run_until_idle()
+    study = api.get(KIND, "study1", "team")
+    assert study.status["phase"] == "Failed"
+    remaining = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    assert {t.metadata.name for t in remaining} == {trials[0].metadata.name}
+
+
+def test_non_numeric_observation_does_not_crash():
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    make_study(api, algorithm="grid", parallelism=4)
+    ctl.controller.run_until_idle()
+    trials = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    bad = api.get("TpuJob", trials[0].metadata.name, "team")
+    bad.status["observation"] = {"loss": "not-a-number"}
+    bad.status["phase"] = "Succeeded"
+    api.update_status(bad)
+    for t in trials[1:]:
+        finish_trial(api, t.metadata.name, loss=0.4)
+    ctl.controller.run_until_idle()
+    study = api.get(KIND, "study1", "team")
+    assert study.status["phase"] == "Succeeded"
+    assert study.status["bestTrial"]["objective"] == 0.4
+
+
 def test_invalid_spec_is_terminal():
     api = FakeApiServer()
     ctl = StudyController(api)

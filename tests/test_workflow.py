@@ -1,0 +1,227 @@
+"""Workflow engine tests (the Argo-DAG analog, SURVEY.md §4.2)."""
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.workflow import KIND, StepSpec, WorkflowSpec
+from kubeflow_tpu.controllers.workflow import (
+    LABEL_STEP,
+    LABEL_WORKFLOW,
+    WorkflowController,
+)
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.testing.workflows import (
+    platform_e2e_workflow,
+    unit_tests_workflow,
+)
+
+ECHO = ("/bin/echo", "ok")
+
+
+def step(name, deps=(), retries=0):
+    return StepSpec(name=name, command=ECHO, dependencies=tuple(deps), retries=retries)
+
+
+def make_workflow(api, spec, name="wf"):
+    return api.create(new_resource(KIND, name, "ci", spec=spec.to_dict()))
+
+
+def pods_for(api, step_name, name="wf"):
+    return [
+        p
+        for p in api.list("Pod", "ci", label_selector={LABEL_WORKFLOW: name})
+        if p.metadata.labels[LABEL_STEP] == step_name
+    ]
+
+
+def finish(api, pod, phase="Succeeded"):
+    fresh = api.get("Pod", pod.metadata.name, "ci")
+    fresh.status["phase"] = phase
+    api.update_status(fresh)
+
+
+# -- spec validation -------------------------------------------------------
+
+
+def test_spec_rejects_cycles_and_bad_deps():
+    with pytest.raises(ValueError, match="cycle"):
+        WorkflowSpec(
+            steps=(step("a", deps=["b"]), step("b", deps=["a"]))
+        ).validate()
+    with pytest.raises(ValueError, match="unknown step"):
+        WorkflowSpec(steps=(step("a", deps=["ghost"]),)).validate()
+    with pytest.raises(ValueError, match="duplicate"):
+        WorkflowSpec(steps=(step("a"), step("a"))).validate()
+    with pytest.raises(ValueError, match="dependencies"):
+        WorkflowSpec(
+            steps=(step("a"),), on_exit=step("exit", deps=["a"])
+        ).validate()
+
+
+def test_spec_roundtrip():
+    spec = WorkflowSpec(
+        steps=(step("a"), step("b", deps=["a"], retries=2)),
+        on_exit=step("teardown"),
+        artifacts_dir="/tmp/x",
+        parallelism=3,
+    )
+    assert WorkflowSpec.from_dict(spec.to_dict()) == spec
+
+
+# -- controller: DAG semantics ---------------------------------------------
+
+
+def test_dag_order_and_fanout():
+    """Diamond: a → (b, c) → d. b and c run together only after a."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(
+        api,
+        WorkflowSpec(
+            steps=(
+                step("a"),
+                step("b", deps=["a"]),
+                step("c", deps=["a"]),
+                step("d", deps=["b", "c"]),
+            )
+        ),
+    )
+    ctl.controller.run_until_idle()
+    assert len(pods_for(api, "a")) == 1
+    assert not pods_for(api, "b") and not pods_for(api, "d")
+
+    finish(api, pods_for(api, "a")[0])
+    ctl.controller.run_until_idle()
+    assert len(pods_for(api, "b")) == 1 and len(pods_for(api, "c")) == 1
+    assert not pods_for(api, "d")
+
+    finish(api, pods_for(api, "b")[0])
+    ctl.controller.run_until_idle()
+    assert not pods_for(api, "d")  # c still running
+
+    finish(api, pods_for(api, "c")[0])
+    ctl.controller.run_until_idle()
+    assert len(pods_for(api, "d")) == 1
+
+    finish(api, pods_for(api, "d")[0])
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Succeeded"
+    assert wf.status["steps"]["d"]["state"] == "Succeeded"
+
+
+def test_parallelism_cap():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(
+        api,
+        WorkflowSpec(
+            steps=tuple(step(f"s{i}") for i in range(5)), parallelism=2
+        ),
+    )
+    ctl.controller.run_until_idle()
+    running = [
+        p
+        for p in api.list("Pod", "ci", label_selector={LABEL_WORKFLOW: "wf"})
+    ]
+    assert len(running) == 2
+
+
+def test_retry_then_success():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(api, WorkflowSpec(steps=(step("flaky", retries=2),)))
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "flaky")[0], "Failed")
+    ctl.controller.run_until_idle()
+    attempts = pods_for(api, "flaky")
+    assert len(attempts) == 2  # retried
+    finish(api, [p for p in attempts if not p.status.get("phase")][0])
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Succeeded"
+
+
+def test_fail_fast_and_exit_handler_on_failure():
+    """A failed step stops new steps; running ones drain; teardown still
+    runs (`kfctl_go_test.jsonnet:384-391` exit-handler contract)."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(
+        api,
+        WorkflowSpec(
+            steps=(step("a"), step("b"), step("after-a", deps=["a"])),
+            on_exit=step("teardown"),
+            parallelism=1,
+        ),
+    )
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "a")[0], "Failed")  # no retries
+    ctl.controller.run_until_idle()
+    # Fail-fast: b (never started) and after-a are not created.
+    assert not pods_for(api, "after-a")
+    assert not pods_for(api, "b")
+    # But teardown is.
+    teardown = pods_for(api, "teardown")
+    assert len(teardown) == 1
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Running"
+
+    finish(api, teardown[0])
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Failed"
+    assert wf.status["steps"]["teardown"]["state"] == "Succeeded"
+
+
+def test_failed_teardown_fails_workflow():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(
+        api, WorkflowSpec(steps=(step("a"),), on_exit=step("teardown"))
+    )
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "a")[0])
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "teardown")[0], "Failed")
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Failed"
+
+
+def test_exit_handler_runs_once():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(
+        api, WorkflowSpec(steps=(step("a"),), on_exit=step("teardown"))
+    )
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "a")[0])
+    ctl.controller.run_until_idle()
+    ctl.controller.enqueue(("ci", "wf"))
+    ctl.controller.run_until_idle()
+    assert len(pods_for(api, "teardown")) == 1
+
+
+def test_invalid_spec_terminal():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    api.create(new_resource(KIND, "bad", "ci", spec={"steps": []}))
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "bad", "ci").status["phase"] == "Failed"
+
+
+# -- CI workflow definitions ----------------------------------------------
+
+
+def test_ci_workflow_definitions_validate():
+    for wf in (unit_tests_workflow(), platform_e2e_workflow()):
+        spec = WorkflowSpec.from_dict(wf.spec)  # validates
+        assert spec.steps
+
+
+def test_platform_e2e_shape():
+    spec = WorkflowSpec.from_dict(platform_e2e_workflow().spec)
+    names = [s.name for s in spec.steps]
+    assert names[0] == "deploy"
+    for s in spec.steps[1:]:
+        assert "deploy" in s.dependencies
+    assert spec.on_exit is not None and spec.on_exit.name == "teardown"
+    assert spec.step("deploy").retries == 2
