@@ -75,7 +75,12 @@ def main() -> None:
 
     def _run_pods():
         while not runner_stop.is_set():
-            runner.step()
+            try:
+                runner.step()
+            except Exception:
+                # One malformed Pod must not kill pod execution for the
+                # whole process.
+                logging.exception("pod runner step failed; continuing")
             runner_stop.wait(0.2)
 
     threading.Thread(target=_run_pods, name="pod-runner", daemon=True).start()

@@ -101,7 +101,9 @@ class StudyController:
             return Result()
         try:
             spec = study_api.StudySpec.from_dict(study.spec)
-        except ValueError as e:
+        except Exception as e:
+            # Client-writable spec: any parse failure is terminal, not a
+            # crash-loop.
             api.record_event(study, "InvalidSpec", str(e), type_="Warning")
             return self._finish(api, study, "Failed", reason=str(e))
 

@@ -217,7 +217,7 @@ class TpuJobController:
             return Result()
         try:
             spec = TpuJobSpec.from_dict(job.spec)
-        except ValueError as e:
+        except Exception as e:
             # Invalid spec is terminal, not transient — requeueing would
             # hot-loop in error backoff forever.
             api.record_event(job, "InvalidSpec", str(e), type_="Warning")

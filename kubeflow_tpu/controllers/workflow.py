@@ -37,6 +37,21 @@ def step_pod_name(workflow: str, step: str, attempt: int) -> str:
     return f"{workflow}-{step}-{attempt}"
 
 
+def next_attempt(attempts: list[Resource]) -> int:
+    """max(observed attempt label)+1, NOT len(observed): a deleted
+    attempt pod must not make us recreate a name that still exists."""
+    return (
+        max(
+            (
+                int(p.metadata.labels.get(LABEL_ATTEMPT, "0"))
+                for p in attempts
+            ),
+            default=-1,
+        )
+        + 1
+    )
+
+
 class WorkflowController:
     def __init__(self, api: FakeApiServer, metrics: MetricsRegistry | None = None):
         self.api = api
@@ -111,7 +126,10 @@ class WorkflowController:
             return Result()
         try:
             spec = wf_api.WorkflowSpec.from_dict(wf.spec)
-        except ValueError as e:
+        except Exception as e:
+            # Spec dicts are client-writable; any parse failure (KeyError,
+            # TypeError, ...) is a terminal InvalidSpec, not a reason to
+            # crash-loop in requeue backoff.
             api.record_event(wf, "InvalidSpec", str(e), type_="Warning")
             return self._set_status(api, wf, "Failed", reason=str(e))
 
@@ -164,7 +182,9 @@ class WorkflowController:
                 for d in step.dependencies
             ):
                 continue
-            self._create_step_pod(wf, spec, step, st["attempts"])
+            self._create_step_pod(
+                wf, spec, step, next_attempt(by_step.get(step.name, []))
+            )
             st["state"] = "Running"
             st["attempts"] += 1
             active += 1
@@ -194,7 +214,7 @@ class WorkflowController:
                     exit_state = "Failed"
                 else:
                     self._create_step_pod(
-                        wf, spec, spec.on_exit, len(exit_attempts)
+                        wf, spec, spec.on_exit, next_attempt(exit_attempts)
                     )
                     exit_state = "Running"
             steps_status[spec.on_exit.name] = {

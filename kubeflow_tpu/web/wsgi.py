@@ -121,7 +121,19 @@ class _Route:
     def __init__(self, pattern: str, methods: tuple[str, ...], handler):
         self.methods = methods
         self.handler = handler
-        regex = re.sub(r"<([a-zA-Z_][a-zA-Z0-9_]*)>", r"(?P<\1>[^/]+)", pattern)
+        # <name> matches one path segment; <name:path> matches the rest of
+        # the path, slashes included (catch-all routes). Single-pass sub so
+        # the emitted (?P<name>...) groups are never re-substituted.
+        def group(m: re.Match) -> str:
+            return (
+                f"(?P<{m.group(1)}>.*)"
+                if m.group(2)
+                else f"(?P<{m.group(1)}>[^/]+)"
+            )
+
+        regex = re.sub(
+            r"<([a-zA-Z_][a-zA-Z0-9_]*)(:path)?>", group, pattern
+        )
         self.regex = re.compile(f"^{regex}$")
 
 

@@ -1,0 +1,95 @@
+"""Small components: availability prober (#25), echo server (#19),
+static config server (#20)."""
+
+from kubeflow_tpu.apps.echo import EchoApp
+from kubeflow_tpu.apps.probe import AvailabilityProber, ProberApp
+from kubeflow_tpu.apps.staticserver import StaticConfigApp
+from kubeflow_tpu.web import TestClient
+from kubeflow_tpu.web.wsgi import serve
+
+
+# -- prober ----------------------------------------------------------------
+
+
+def test_prober_gauges_flip_with_target_health():
+    health = {"ok": True}
+    prober = AvailabilityProber(
+        "http://target/healthz", probe=lambda url: health["ok"]
+    )
+    assert prober.probe_once() is True
+    client = TestClient(ProberApp(prober))
+    text = client.get("/metrics").body.decode()
+    assert 'kubeflow_availability{url="http://target/healthz"} 1' in text
+
+    health["ok"] = False
+    assert prober.probe_once() is False
+    text = client.get("/metrics").body.decode()
+    assert 'kubeflow_availability{url="http://target/healthz"} 0' in text
+    assert "kubeflow_probe_failures_total" in text
+
+
+def test_prober_survives_raising_probe():
+    def bad_probe(url):
+        raise RuntimeError("dns exploded")
+
+    prober = AvailabilityProber("http://x", probe=bad_probe)
+    assert prober.probe_once() is False  # no exception escapes
+
+
+def test_prober_against_live_endpoint():
+    """The real flow (`kubeflow-readiness.py`): HTTP-probe a served app."""
+    target = EchoApp()
+    server, _ = serve(target, host="127.0.0.1", port=0)
+    try:
+        prober = AvailabilityProber(
+            f"http://127.0.0.1:{server.server_port}/healthz"
+        )
+        assert prober.probe_once() is True
+    finally:
+        server.shutdown()
+    assert prober.probe_once() is False  # server gone
+
+
+# -- echo ------------------------------------------------------------------
+
+
+def test_echo_reflects_request():
+    client = TestClient(
+        EchoApp(), headers={"x-goog-authenticated-user-email": "a@b.co"}
+    )
+    resp = client.post("/some/deep/path?x=1", {"k": "v"})
+    body = resp.json()
+    assert body["method"] == "POST"
+    assert body["path"] == "/some/deep/path"
+    assert body["query"] == {"x": "1"}
+    assert '"k"' in body["body"]
+    assert (
+        body["headers"]["x-goog-authenticated-user-email"] == "a@b.co"
+    )
+
+
+# -- static config server --------------------------------------------------
+
+
+def test_static_serves_files_with_content_type(tmp_path):
+    (tmp_path / "cfg").mkdir()
+    (tmp_path / "cfg" / "links.json").write_text('{"menuLinks": []}')
+    (tmp_path / "index.html").write_text("<html></html>")
+    client = TestClient(StaticConfigApp(tmp_path))
+
+    resp = client.get("/cfg/links.json")
+    assert resp.status == 200
+    assert resp.json() == {"menuLinks": []}
+    assert ("Content-Type", "application/json") in resp.headers
+
+    assert client.get("/").status == 200  # index.html default
+    assert client.get("/missing.yaml").status == 404
+
+
+def test_static_blocks_path_traversal(tmp_path):
+    (tmp_path / "serve").mkdir()
+    (tmp_path / "secret.txt").write_text("s3cr3t")
+    client = TestClient(StaticConfigApp(tmp_path / "serve"))
+    resp = client.get("/../secret.txt")
+    assert resp.status in (403, 404)
+    assert b"s3cr3t" not in resp.body

@@ -204,8 +204,41 @@ def test_invalid_spec_terminal():
     api = FakeApiServer()
     ctl = WorkflowController(api)
     api.create(new_resource(KIND, "bad", "ci", spec={"steps": []}))
+    # Parse failures beyond ValueError (client-writable spec) must also be
+    # terminal, not a crash loop.
+    api.create(
+        new_resource(
+            KIND, "bad2", "ci",
+            spec={"steps": [{"name": "a", "command": ["x"],
+                             "env": [{"name": "E"}]}]},
+        )
+    )
+    api.create(new_resource(KIND, "bad3", "ci", spec={"steps": ["nope"]}))
     ctl.controller.run_until_idle()
-    assert api.get(KIND, "bad", "ci").status["phase"] == "Failed"
+    for name in ("bad", "bad2", "bad3"):
+        assert api.get(KIND, name, "ci").status["phase"] == "Failed", name
+
+
+def test_retry_after_attempt_pod_deleted():
+    """A deleted attempt pod must not wedge retries on AlreadyExists."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(api, WorkflowSpec(steps=(step("flaky", retries=3),)))
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "flaky")[0], "Failed")
+    ctl.controller.run_until_idle()
+    attempts = pods_for(api, "flaky")
+    assert len(attempts) == 2
+    # Delete the failed attempt-0 pod; attempt-1 is still pending.
+    failed = [p for p in attempts if p.status.get("phase") == "Failed"][0]
+    api.delete("Pod", failed.metadata.name, "ci")
+    finish(api, [p for p in attempts if p is not failed][0], "Failed")
+    ctl.controller.run_until_idle()
+    names = {p.metadata.name for p in pods_for(api, "flaky")}
+    assert "wf-flaky-2" in names  # max+1, not len
+    finish(api, api.get("Pod", "wf-flaky-2", "ci"))
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Succeeded"
 
 
 # -- CI workflow definitions ----------------------------------------------
