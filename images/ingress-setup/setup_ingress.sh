@@ -1,0 +1,23 @@
+#!/bin/bash
+# Wait for the platform gateway to get an external address, then emit the
+# endpoint the availability prober should watch. Idempotent: safe for the
+# deploy tool to re-run on second apply.
+set -euo pipefail
+
+NAMESPACE="${NAMESPACE:-kubeflow}"
+GATEWAY_SVC="${GATEWAY_SVC:-kubeflow-gateway}"
+TIMEOUT="${TIMEOUT:-600}"
+
+deadline=$((SECONDS + TIMEOUT))
+while (( SECONDS < deadline )); do
+    ip=$(kubectl -n "${NAMESPACE}" get svc "${GATEWAY_SVC}" \
+        -o jsonpath='{.status.loadBalancer.ingress[0].ip}' 2>/dev/null || true)
+    if [[ -n "${ip}" ]]; then
+        echo "gateway ready: http://${ip}"
+        exit 0
+    fi
+    echo "waiting for ${NAMESPACE}/${GATEWAY_SVC} external ip..."
+    sleep 10
+done
+echo "timed out waiting for gateway ip" >&2
+exit 1

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Build one flavor of the jax-notebook image from its version config —
+# the build_image.sh analog: reads versions/<tag>/version-config.json and
+# turns each key into a --build-arg.
+#
+# Usage: ./build_image.sh <version-tag> [registry]
+#   e.g. ./build_image.sh 0.4-tpu kubeflow-tpu
+set -euo pipefail
+
+cd "$(dirname "$0")"
+
+TAG="${1:?usage: build_image.sh <version-tag> [registry]}"
+REGISTRY="${2:-kubeflow-tpu}"
+CONFIG="versions/${TAG}/version-config.json"
+
+[[ -f "$CONFIG" ]] || { echo "no such version config: $CONFIG" >&2; exit 1; }
+
+BUILD_ARGS=()
+while IFS="=" read -r key value; do
+    BUILD_ARGS+=(--build-arg "${key}=${value}")
+done < <(python3 -c '
+import json, sys
+for k, v in json.load(open(sys.argv[1])).items():
+    print(f"{k}={v}")
+' "$CONFIG")
+
+IMAGE="${REGISTRY}/jax-notebook:${TAG}"
+echo "building ${IMAGE} from ${CONFIG}"
+docker build "${BUILD_ARGS[@]}" -t "${IMAGE}" .

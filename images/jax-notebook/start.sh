@@ -1,0 +1,17 @@
+#!/bin/bash
+# Notebook entrypoint — the start.sh analog: serve JupyterLab under the
+# operator-injected NB_PREFIX so /notebook/<ns>/<name>/ path routing and
+# the culler's /api/status probe both work.
+set -euo pipefail
+
+NB_PREFIX="${NB_PREFIX:-/}"
+
+exec jupyter lab \
+    --ip=0.0.0.0 \
+    --port=8888 \
+    --no-browser \
+    --ServerApp.base_url="${NB_PREFIX}" \
+    --ServerApp.token='' \
+    --ServerApp.password='' \
+    --ServerApp.allow_origin='*' \
+    --ServerApp.authenticate_prometheus=False

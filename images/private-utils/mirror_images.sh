@@ -1,0 +1,13 @@
+#!/bin/bash
+# Mirror the platform's images into a private registry. Reads image refs
+# on stdin (one per line), retags under ${PRIVATE_REGISTRY}.
+set -euo pipefail
+
+: "${PRIVATE_REGISTRY:?set PRIVATE_REGISTRY, e.g. gcr.io/my-project/mirror}"
+
+while read -r image; do
+    [[ -z "${image}" || "${image}" == \#* ]] && continue
+    target="${PRIVATE_REGISTRY}/${image##*/}"
+    echo "mirroring ${image} -> ${target}"
+    gcloud container images add-tag --quiet "${image}" "${target}"
+done

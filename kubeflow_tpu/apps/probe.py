@@ -41,12 +41,18 @@ class AvailabilityProber:
         *,
         interval_seconds: float = 30.0,
         probe: Callable[[str], bool] | None = None,
+        # Identity headers for the probe (the reference IAP-authed its
+        # GET; on the mesh this is the trusted user-id header). Ignored
+        # when a custom `probe` is supplied.
+        headers: dict[str, str] | None = None,
         metrics: MetricsRegistry | None = None,
         clock: Callable[[], float] = time.perf_counter,
     ):
         self.url = url
         self.interval_seconds = interval_seconds
-        self._probe = probe or http_probe
+        self._probe = probe or (
+            lambda target: http_probe(target, headers=headers)
+        )
         self._clock = clock
         self.metrics = metrics or MetricsRegistry()
         self.availability = self.metrics.gauge(
@@ -111,9 +117,16 @@ def main() -> None:  # python -m kubeflow_tpu.apps.probe
     parser.add_argument("--url", required=True, help="endpoint to probe")
     parser.add_argument("--interval", type=float, default=30.0)
     parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument(
+        "--header", action="append", default=[], metavar="NAME=VALUE",
+        help="identity header to send with each probe (repeatable)",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
-    prober = AvailabilityProber(args.url, interval_seconds=args.interval)
+    headers = dict(h.split("=", 1) for h in args.header if "=" in h)
+    prober = AvailabilityProber(
+        args.url, interval_seconds=args.interval, headers=headers or None
+    )
     thread = prober.start()
     serve(ProberApp(prober), port=args.port)
     thread.join()

@@ -1,0 +1,15 @@
+"""CI utilities — the `py/kubeflow/kubeflow/ci` analog (SURVEY.md §2 #27).
+
+`application_util` mirrors the reference's kustomize-image setter and
+manifest-test regeneration (`application_util.py:12-97`): pin component
+image tags across the deploy bundles and keep golden manifest snapshots
+in `manifests/` that a test diffs against the generator — drift between
+code and checked-in manifests fails CI instead of shipping.
+"""
+
+from kubeflow_tpu.ci.application_util import (
+    regenerate_manifests,
+    set_bundle_images,
+)
+
+__all__ = ["regenerate_manifests", "set_bundle_images"]

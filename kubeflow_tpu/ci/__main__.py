@@ -1,0 +1,6 @@
+import sys
+
+from kubeflow_tpu.ci.application_util import main
+
+if __name__ == "__main__":
+    sys.exit(main())

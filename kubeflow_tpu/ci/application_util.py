@@ -1,0 +1,109 @@
+"""Manifest/image utilities for CI.
+
+Parity targets (`py/kubeflow/kubeflow/ci/application_util.py`):
+- `set_kustomize_image` (:12) — retag a component image in the deploy
+  overlays → `set_bundle_images` rewrites image refs across rendered
+  bundle resources;
+- `regenerate_manifest_tests` (:45-97) — regenerate checked-in manifests
+  from source and fail CI on drift → `regenerate_manifests` +
+  `manifest_drift`.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import yaml
+
+from kubeflow_tpu.deploy.bundles import BUNDLES
+from kubeflow_tpu.deploy.kfdef import PlatformSpec, default_spec
+
+MANIFEST_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "manifests"
+
+
+def set_bundle_images(
+    resources: list, image_map: dict[str, str]
+) -> list:
+    """Rewrite container image refs (`repo` or `repo:tag` keys in
+    `image_map` → new ref) across rendered resources, in place."""
+
+    def rewrite(ref: str) -> str:
+        if ref in image_map:
+            return image_map[ref]
+        repo = ref.partition(":")[0]
+        return image_map.get(repo, ref)
+
+    for res in resources:
+        template = res.spec.get("template", {})
+        for c in template.get("spec", {}).get("containers", []):
+            if "image" in c:
+                c["image"] = rewrite(c["image"])
+        for c in res.spec.get("containers", []):
+            if "image" in c:
+                c["image"] = rewrite(c["image"])
+    return resources
+
+
+def render_bundle_yaml(
+    name: str, spec: PlatformSpec | None = None
+) -> str:
+    spec = spec or default_spec()
+    docs = [r.to_dict() for r in BUNDLES[name](spec)]
+    return yaml.safe_dump_all(docs, sort_keys=True)
+
+
+def regenerate_manifests(
+    out_dir: pathlib.Path | None = None,
+) -> list[pathlib.Path]:
+    """Write one YAML file per bundle (the checked-in golden set)."""
+    out_dir = pathlib.Path(out_dir or MANIFEST_DIR)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for name in BUNDLES:
+        path = out_dir / f"{name}.yaml"
+        path.write_text(render_bundle_yaml(name))
+        written.append(path)
+    # Remove goldens for bundles that no longer exist.
+    for stale in out_dir.glob("*.yaml"):
+        if stale.stem not in BUNDLES:
+            stale.unlink()
+    return written
+
+
+def manifest_drift(dir_: pathlib.Path | None = None) -> list[str]:
+    """Bundle names whose checked-in golden differs from the generator
+    (or is missing). Empty list = clean."""
+    dir_ = pathlib.Path(dir_ or MANIFEST_DIR)
+    drifted = []
+    for name in BUNDLES:
+        path = dir_ / f"{name}.yaml"
+        if not path.exists() or path.read_text() != render_bundle_yaml(name):
+            drifted.append(name)
+    for stale in sorted(dir_.glob("*.yaml")):
+        if stale.stem not in BUNDLES:
+            drifted.append(stale.stem)
+    return drifted
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu-ci")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("regenerate", help="rewrite manifests/ from bundles")
+    sub.add_parser("check", help="exit 1 if manifests/ drifted")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "regenerate":
+        for path in regenerate_manifests():
+            print(f"wrote {path}")
+        return 0
+    drifted = manifest_drift()
+    if drifted:
+        print(
+            "manifest drift (run `python -m kubeflow_tpu.ci regenerate`): "
+            + ", ".join(drifted)
+        )
+        return 1
+    print("manifests clean")
+    return 0

@@ -4,9 +4,9 @@ The Argo-engine analog (the reference runs its whole CI and its
 ml-pipeline component on Argo, `testing/README.md:22-35`): level-triggered
 like every other controller here — each reconcile reads the observed step
 pods and creates whatever steps have all dependencies satisfied, up to
-`spec.parallelism`. Failures retry up to the step's budget by deleting the
-failed pod (attempt count lives in status, so a recreated pod is a fresh
-attempt). When the DAG is terminal the `onExit` step runs exactly once,
+`spec.parallelism`. Failures retry up to the step's budget by creating
+attempt N+1; failed attempt indices are persisted in status so a GC'd
+failed pod neither refunds the budget nor wedges numbering. When the DAG is terminal the `onExit` step runs exactly once,
 success or failure — teardown must never be skipped
 (`kfctl_go_test.jsonnet:384-391`).
 
@@ -139,28 +139,39 @@ class WorkflowController:
             by_step.setdefault(p.metadata.labels.get(LABEL_STEP, ""), []).append(p)
 
         # Observed per-step state. A step is Succeeded if any attempt
-        # succeeded; Failed once attempts exceed its retry budget;
-        # Running while an attempt is in flight.
+        # succeeded; Failed once failures exceed its retry budget; Running
+        # while an attempt is in flight. Failed attempt *indices* are
+        # persisted in status and unioned with observation — a failed pod
+        # that gets deleted (GC, eviction) must not refund the budget.
+        prev_steps = wf.status.get("steps", {})
         steps_status: dict[str, dict] = {}
         active = 0
         for step in spec.steps:
             attempts = by_step.get(step.name, [])
             phases = [p.status.get("phase", "Pending") for p in attempts]
+            failed_attempts = set(
+                prev_steps.get(step.name, {}).get("failedAttempts", [])
+            )
+            failed_attempts.update(
+                int(p.metadata.labels.get(LABEL_ATTEMPT, "0"))
+                for p in attempts
+                if p.status.get("phase") == "Failed"
+            )
             state = "Pending"
             if any(ph == "Succeeded" for ph in phases):
                 state = "Succeeded"
             elif any(ph in ("Pending", "Running") for ph in phases):
                 state = "Running"
                 active += 1
-            elif attempts:
-                failures = sum(ph == "Failed" for ph in phases)
-                if failures > step.retries:
+            elif attempts or failed_attempts:
+                if len(failed_attempts) > step.retries:
                     state = "Failed"
                 else:
                     state = "Retrying"  # next pass creates attempt N+1
             steps_status[step.name] = {
                 "state": state,
                 "attempts": len(attempts),
+                "failedAttempts": sorted(failed_attempts),
             }
 
         # Schedule: dependencies satisfied, budget left, parallelism cap.
@@ -182,9 +193,11 @@ class WorkflowController:
                 for d in step.dependencies
             ):
                 continue
-            self._create_step_pod(
-                wf, spec, step, next_attempt(by_step.get(step.name, []))
+            attempt = max(
+                next_attempt(by_step.get(step.name, [])),
+                max(st["failedAttempts"], default=-1) + 1,
             )
+            self._create_step_pod(wf, spec, step, attempt)
             st["state"] = "Running"
             st["attempts"] += 1
             active += 1
@@ -201,25 +214,36 @@ class WorkflowController:
             exit_phases = [
                 p.status.get("phase", "Pending") for p in exit_attempts
             ]
-            if not exit_attempts:
+            exit_failed = set(
+                prev_steps.get(spec.on_exit.name, {}).get("failedAttempts", [])
+            )
+            exit_failed.update(
+                int(p.metadata.labels.get(LABEL_ATTEMPT, "0"))
+                for p in exit_attempts
+                if p.status.get("phase") == "Failed"
+            )
+            if not exit_attempts and not exit_failed:
                 self._create_step_pod(wf, spec, spec.on_exit, 0)
                 exit_state = "Running"
             elif any(ph == "Succeeded" for ph in exit_phases):
                 exit_state = "Succeeded"
             elif any(ph in ("Pending", "Running") for ph in exit_phases):
                 exit_state = "Running"
+            elif len(exit_failed) > spec.on_exit.retries:
+                exit_state = "Failed"
             else:
-                failures = sum(ph == "Failed" for ph in exit_phases)
-                if failures > spec.on_exit.retries:
-                    exit_state = "Failed"
-                else:
-                    self._create_step_pod(
-                        wf, spec, spec.on_exit, next_attempt(exit_attempts)
-                    )
-                    exit_state = "Running"
+                self._create_step_pod(
+                    wf, spec, spec.on_exit,
+                    max(
+                        next_attempt(exit_attempts),
+                        max(exit_failed, default=-1) + 1,
+                    ),
+                )
+                exit_state = "Running"
             steps_status[spec.on_exit.name] = {
                 "state": exit_state,
                 "attempts": len(by_step.get(spec.on_exit.name, [])),
+                "failedAttempts": sorted(exit_failed),
             }
 
         if dag_terminal and (spec.on_exit is None or exit_state in TERMINAL):

@@ -1,0 +1,60 @@
+"""Registry sync — the `releasing/hubsync.py` analog: mirror released
+image tags from the build registry to the public one. The copy operation
+is injectable (gcloud/crane/skopeo in production; recorded calls in
+tests)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import subprocess
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+DEFAULT_IMAGES = (
+    "platform",
+    "jax-notebook",
+    "kaggle-notebook",
+    "datascience-notebook",
+)
+
+
+def default_copy(src: str, dst: str) -> None:
+    subprocess.run(["crane", "copy", src, dst], check=True)
+
+
+def sync(
+    version: str,
+    *,
+    source: str,
+    dest: str,
+    images: tuple[str, ...] = DEFAULT_IMAGES,
+    copy: Callable[[str, str], None] = default_copy,
+) -> list[tuple[str, str]]:
+    """Mirror every image:version from source to dest; returns the pairs
+    copied. Failures propagate — a half-synced release must be loud."""
+    copied = []
+    for name in images:
+        src = f"{source}/{name}:{version}"
+        dst = f"{dest}/{name}:{version}"
+        log.info("sync %s -> %s", src, dst)
+        copy(src, dst)
+        copied.append((src, dst))
+    return copied
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="hubsync")
+    parser.add_argument("--version", required=True)
+    parser.add_argument("--source", default="gcr.io/kubeflow-tpu-images")
+    parser.add_argument("--dest", default="docker.io/kubeflowtpu")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    pairs = sync(args.version, source=args.source, dest=args.dest)
+    print(f"synced {len(pairs)} images")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

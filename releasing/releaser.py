@@ -1,0 +1,107 @@
+"""Release workflows — the `releasing/releaser` analog.
+
+The reference releases components through ksonnet Argo workflows
+(`releasing/releaser/components/{centraldashboard,...}.jsonnet`): build
+each image, run its tests, then push/tag. Here the same DAG is a
+`Workflow` CR for the platform's workflow engine: build steps fan out per
+image, the test gate depends on all builds, and tagging only happens
+after the gate — with teardown of the build namespace in the exit
+handler.
+
+    python releasing/releaser.py --version v1.2.0   # print the CR
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from kubeflow_tpu.api.objects import Resource, new_resource  # noqa: E402
+from kubeflow_tpu.api.workflow import KIND, StepSpec, WorkflowSpec  # noqa: E402
+
+# Image build targets: (name, context dir, dockerfile).
+IMAGES: tuple[tuple[str, str, str], ...] = (
+    ("platform", ".", "images/platform/Dockerfile"),
+    ("jax-notebook", "images/jax-notebook", "images/jax-notebook/Dockerfile"),
+    # Dockerfile paths are cwd(repo-root)-relative: docker resolves -f
+    # against the cwd, not the build context.
+    (
+        "kaggle-notebook",
+        "images/contrib/kaggle-notebook",
+        "images/contrib/kaggle-notebook/Dockerfile",
+    ),
+    (
+        "datascience-notebook",
+        "images/contrib/datascience-notebook",
+        "images/contrib/datascience-notebook/Dockerfile",
+    ),
+)
+
+
+def release_workflow(
+    version: str,
+    *,
+    registry: str = "kubeflow-tpu",
+    namespace: str = "kubeflow-releasing",
+) -> Resource:
+    build_steps = tuple(
+        StepSpec(
+            name=f"build-{name}",
+            command=("docker", "build"),
+            args=("-t", f"{registry}/{name}:{version}", "-f", dockerfile, ctx),
+            retries=1,
+        )
+        for name, ctx, dockerfile in IMAGES
+    )
+    # Container-stable interpreter: this step runs in the ci-runner image,
+    # not on the machine that rendered the CR.
+    test_gate = StepSpec(
+        name="test",
+        command=("python", "-m", "pytest", "tests/", "-q"),
+        dependencies=tuple(s.name for s in build_steps),
+    )
+    push_steps = tuple(
+        StepSpec(
+            name=f"push-{name}",
+            command=("docker", "push"),
+            args=(f"{registry}/{name}:{version}",),
+            dependencies=(test_gate.name,),
+            retries=2,
+        )
+        for name, _, _ in IMAGES
+    )
+    tag = StepSpec(
+        name="tag-release",
+        command=("git", "tag", "-a", version, "-m", f"release {version}"),
+        dependencies=tuple(s.name for s in push_steps),
+    )
+    spec = WorkflowSpec(
+        steps=build_steps + (test_gate,) + push_steps + (tag,),
+        on_exit=StepSpec(
+            name="cleanup",
+            command=("docker", "system", "prune", "-f"),
+        ),
+    )
+    return new_resource(
+        KIND, f"release-{version}", namespace, spec=spec.to_dict()
+    )
+
+
+if __name__ == "__main__":
+    import argparse
+
+    import yaml
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--version", required=True)
+    parser.add_argument("--registry", default="kubeflow-tpu")
+    args = parser.parse_args()
+    print(
+        yaml.safe_dump(
+            release_workflow(args.version, registry=args.registry).to_dict(),
+            sort_keys=True,
+        ),
+        end="",
+    )

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Autoformat / static hygiene — the `scripts/autoformat_jsonnet.sh` +
+# `run_gofmt.sh` analog: byte-compile every python source (syntax gate),
+# normalize version-config JSON, and run the boilerplate checker.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q kubeflow_tpu tests releasing scripts
+
+# Canonicalize the notebook version matrix (sorted keys, 2-space indent).
+python - <<'EOF'
+import json, pathlib
+for p in pathlib.Path("images").rglob("version-config.json"):
+    cfg = json.loads(p.read_text())
+    p.write_text(json.dumps(cfg, indent=2, sort_keys=True) + "\n")
+    print(f"formatted {p}")
+EOF
+
+python scripts/check_boilerplate.py --root kubeflow_tpu
+echo "autoformat ok"

@@ -1,0 +1,85 @@
+"""Source-file boilerplate checker — the `build/check_boilerplate.sh`
+analog, as a portable script.
+
+Policy for this repo: every Python source must open with a module
+docstring (the codebase's documentation convention), and every shell
+script with a `#`-comment block after the shebang. `--license <file>`
+switches to the reference's mode: require the given header verbatim.
+
+    python scripts/check_boilerplate.py [--root DIR] [--license FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import sys
+
+SKIP_DIRS = {".git", "__pycache__", "build", ".pytest_cache", "node_modules"}
+SKIP_FILES = {"__init__.py", "__main__.py", "conftest.py"}
+
+
+def iter_sources(root: pathlib.Path):
+    for path in sorted(root.rglob("*")):
+        if any(part in SKIP_DIRS for part in path.parts):
+            continue
+        if path.suffix in (".py", ".sh") and path.is_file():
+            yield path
+
+
+def has_docstring(path: pathlib.Path) -> bool:
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return False
+    return ast.get_docstring(tree) is not None
+
+
+def has_comment_block(path: pathlib.Path) -> bool:
+    lines = path.read_text().splitlines()
+    for line in lines[:5]:
+        stripped = line.strip()
+        if stripped.startswith("#") and not stripped.startswith("#!"):
+            return True
+    return False
+
+
+def check(root: pathlib.Path, license_text: str | None = None) -> list[str]:
+    bad = []
+    for path in iter_sources(root):
+        if path.name in SKIP_FILES:
+            continue
+        if license_text is not None:
+            ok = license_text in path.read_text()
+        elif path.suffix == ".py":
+            ok = has_docstring(path)
+        else:
+            ok = has_comment_block(path)
+        if not ok:
+            bad.append(str(path.relative_to(root)))
+    return bad
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--root", default=".")
+    parser.add_argument(
+        "--license", help="require this header file's contents verbatim"
+    )
+    args = parser.parse_args(argv)
+    license_text = (
+        pathlib.Path(args.license).read_text() if args.license else None
+    )
+    bad = check(pathlib.Path(args.root).resolve(), license_text)
+    if bad:
+        print("files missing boilerplate:")
+        for f in bad:
+            print(f"  {f}")
+        return 1
+    print("boilerplate ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Platform teardown — the `scripts/gke/teardown.sh` analog: delete the
+# deployed platform (and its TPU node pools) from a PlatformSpec file.
+# Safe to re-run; delete is idempotent like second apply.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SPEC="${1:?usage: teardown.sh <platform-spec.yaml>}"
+python -m kubeflow_tpu.deploy delete -f "${SPEC}"

@@ -1,0 +1,145 @@
+"""CI utils (#27), releasing (#28), tools/scripts (#29)."""
+
+import pathlib
+import subprocess
+import sys
+
+import yaml
+
+from kubeflow_tpu.api.workflow import WorkflowSpec
+from kubeflow_tpu.ci.application_util import (
+    MANIFEST_DIR,
+    manifest_drift,
+    regenerate_manifests,
+    set_bundle_images,
+)
+from kubeflow_tpu.deploy.bundles import BUNDLES, bundle_resources
+from kubeflow_tpu.deploy.kfdef import default_spec
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from releasing.hubsync import sync  # noqa: E402
+from releasing.releaser import IMAGES, release_workflow  # noqa: E402
+
+
+# -- manifests (regenerate_manifest_tests analog) --------------------------
+
+
+def test_checked_in_manifests_match_generator():
+    """The drift gate the reference ran in CI: goldens must equal the
+    generator's output. Run `python -m kubeflow_tpu.ci regenerate` after
+    changing bundles."""
+    assert MANIFEST_DIR.exists(), "manifests/ goldens not generated"
+    assert manifest_drift() == []
+
+
+def test_regenerate_into_tmp(tmp_path):
+    written = regenerate_manifests(tmp_path)
+    assert {p.stem for p in written} == set(BUNDLES)
+    docs = list(yaml.safe_load_all((tmp_path / "tpujob-operator.yaml").read_text()))
+    assert any(d["kind"] == "CustomResourceDefinition" for d in docs)
+    # Stale golden cleanup
+    (tmp_path / "gone-bundle.yaml").write_text("x: 1\n")
+    regenerate_manifests(tmp_path)
+    assert not (tmp_path / "gone-bundle.yaml").exists()
+
+
+def test_set_bundle_images_retags():
+    resources = bundle_resources(default_spec(), ["centraldashboard"])
+    set_bundle_images(
+        resources, {"kubeflow-tpu/centraldashboard": "gcr.io/x/dash:v9"}
+    )
+    deployments = [r for r in resources if r.kind == "Deployment"]
+    images = [
+        c["image"]
+        for r in deployments
+        for c in r.spec["template"]["spec"]["containers"]
+    ]
+    assert "gcr.io/x/dash:v9" in images
+
+
+# -- releasing -------------------------------------------------------------
+
+
+def test_release_workflow_dag():
+    wf = release_workflow("v1.0.0")
+    spec = WorkflowSpec.from_dict(wf.spec)  # validates incl. cycles
+    names = {s.name for s in spec.steps}
+    for image, _, _ in IMAGES:
+        assert f"build-{image}" in names and f"push-{image}" in names
+    test_step = spec.step("test")
+    assert set(test_step.dependencies) == {
+        f"build-{n}" for n, _, _ in IMAGES
+    }
+    assert spec.step("tag-release").dependencies == tuple(
+        f"push-{n}" for n, _, _ in IMAGES
+    )
+    assert spec.on_exit is not None
+
+
+def test_hubsync_copies_all_images():
+    calls = []
+    pairs = sync(
+        "v2", source="gcr.io/src", dest="docker.io/dst",
+        copy=lambda s, d: calls.append((s, d)),
+    )
+    assert calls == pairs
+    assert ("gcr.io/src/platform:v2", "docker.io/dst/platform:v2") in pairs
+    assert len(pairs) == len(IMAGES)
+
+
+# -- scripts/tools ---------------------------------------------------------
+
+
+def test_boilerplate_checker(tmp_path):
+    sys.path.insert(0, str(REPO / "scripts"))
+    import check_boilerplate
+
+    good = tmp_path / "good.py"
+    good.write_text('"""Documented."""\nx = 1\n')
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1\n")
+    script = tmp_path / "s.sh"
+    script.write_text("#!/bin/bash\n# does things\ntrue\n")
+    assert check_boilerplate.check(tmp_path) == ["bad.py"]
+    # License mode: verbatim header required.
+    lic = "Copyright 2026"
+    good.write_text(f"# {lic}\nx = 1\n")
+    bad2 = check_boilerplate.check(tmp_path, license_text=lic)
+    assert "good.py" not in bad2 and "bad.py" in bad2
+
+
+def test_repo_passes_its_own_boilerplate_policy():
+    result = subprocess.run(
+        [sys.executable, "scripts/check_boilerplate.py", "--root", "kubeflow_tpu"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_gcb_template():
+    result = subprocess.run(
+        [sys.executable, "tools/gcb/template.py", "--commit", "abc123"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+    doc = yaml.safe_load(result.stdout)
+    assert len(doc["steps"]) == len(IMAGES)
+    assert all(img.endswith(":abc123") for img in doc["images"])
+
+
+def test_releaser_cli_emits_valid_workflow():
+    result = subprocess.run(
+        [sys.executable, "releasing/releaser.py", "--version", "v9.9.9"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+    doc = yaml.safe_load(result.stdout)
+    WorkflowSpec.from_dict(doc["spec"])  # validates

@@ -1,0 +1,64 @@
+"""Notebook image matrix sanity (the tensorflow-notebook-image analog
+#21-23): version configs parse, flavors are consistent, and the spawner
+menu only offers images the matrix (or contrib set) defines."""
+
+import json
+import pathlib
+import re
+
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+NOTEBOOK = REPO / "images" / "jax-notebook"
+SPAWNER = (
+    REPO / "kubeflow_tpu" / "apps" / "config" / "spawner_ui_config.yaml"
+)
+
+
+def test_version_matrix_parses_and_is_consistent():
+    versions = sorted((NOTEBOOK / "versions").iterdir())
+    assert len(versions) >= 4
+    for vdir in versions:
+        cfg = json.loads((vdir / "version-config.json").read_text())
+        assert "BASE_IMAGE" in cfg and "JAX_SPEC" in cfg, vdir.name
+        if vdir.name.endswith("-tpu"):
+            assert cfg["JAX_SPEC"].startswith("jax[tpu]"), vdir.name
+        else:
+            assert "[tpu]" not in cfg["JAX_SPEC"], vdir.name
+        # Tag prefix must match the pinned jax minor version.
+        tag_prefix = vdir.name.rsplit("-", 1)[0]
+        assert re.search(
+            rf"jax(\[tpu\])?=={re.escape(tag_prefix)}\.", cfg["JAX_SPEC"]
+        ), (vdir.name, cfg["JAX_SPEC"])
+
+
+def test_every_flavor_has_cpu_and_tpu():
+    names = {d.name for d in (NOTEBOOK / "versions").iterdir()}
+    prefixes = {n.rsplit("-", 1)[0] for n in names}
+    for p in prefixes:
+        assert f"{p}-cpu" in names and f"{p}-tpu" in names
+
+
+def test_spawner_menu_images_exist_in_matrix():
+    cfg = yaml.safe_load(SPAWNER.read_text())
+    options = cfg["spawnerFormDefaults"]["image"]["options"]
+    matrix_tags = {d.name for d in (NOTEBOOK / "versions").iterdir()}
+    contrib = {
+        f"kubeflow-tpu/{d.name}:latest"
+        for d in (REPO / "images" / "contrib").iterdir()
+    }
+    for image in options:
+        if image in contrib:
+            continue
+        repo_name, _, tag = image.partition(":")
+        assert repo_name == "kubeflow-tpu/jax-notebook", image
+        assert tag in matrix_tags, (image, sorted(matrix_tags))
+
+
+def test_dockerfile_contract():
+    text = (NOTEBOOK / "Dockerfile").read_text()
+    assert "ARG BASE_IMAGE" in text
+    assert "NB_USER=jovyan" in text
+    assert "8888" in text
+    start = (NOTEBOOK / "start.sh").read_text()
+    assert "NB_PREFIX" in start  # operator URL-prefix contract

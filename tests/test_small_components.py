@@ -47,6 +47,7 @@ def test_prober_against_live_endpoint():
         assert prober.probe_once() is True
     finally:
         server.shutdown()
+        server.server_close()  # unbind: probe fails fast, not on timeout
     assert prober.probe_once() is False  # server gone
 
 

@@ -200,6 +200,25 @@ def test_exit_handler_runs_once():
     assert len(pods_for(api, "teardown")) == 1
 
 
+def test_deleted_failed_pod_does_not_refund_retry_budget():
+    """Failed attempt indices persist in status: GC'ing a failed pod must
+    not grant extra retries."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(api, WorkflowSpec(steps=(step("flaky", retries=1),)))
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "flaky")[0], "Failed")  # attempt 0 fails
+    ctl.controller.run_until_idle()
+    api.delete("Pod", "wf-flaky-0", "ci")  # GC the failed pod
+    ctl.controller.run_until_idle()
+    finish(api, api.get("Pod", "wf-flaky-1", "ci"), "Failed")
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Failed"  # budget 1 spent: {0, 1} failed
+    assert wf.status["steps"]["flaky"]["failedAttempts"] == [0, 1]
+    assert len(pods_for(api, "flaky")) == 1  # no attempt 2
+
+
 def test_invalid_spec_terminal():
     api = FakeApiServer()
     ctl = WorkflowController(api)

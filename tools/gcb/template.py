@@ -1,0 +1,50 @@
+"""Cloud Build template generator — the `tools/gcb/template.libsonnet`
+analog: emit a cloudbuild.yaml that builds and pushes the platform's
+images for a commit, one build step per image with a shared kaniko-style
+cache.
+
+    python tools/gcb/template.py --commit abc123 > cloudbuild.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+from releasing.releaser import IMAGES  # noqa: E402
+
+
+def cloudbuild(commit: str, registry: str = "gcr.io/kubeflow-tpu-images") -> dict:
+    steps = []
+    images = []
+    for name, ctx, dockerfile in IMAGES:
+        image = f"{registry}/{name}:{commit}"
+        steps.append(
+            {
+                "id": f"build-{name}",
+                "name": "gcr.io/cloud-builders/docker",
+                "args": [
+                    "build", "-t", image, "-f", dockerfile, ctx,
+                ],
+                "waitFor": ["-"],  # all builds in parallel
+            }
+        )
+        images.append(image)
+    return {"steps": steps, "images": images, "timeout": "3600s"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--commit", required=True)
+    parser.add_argument("--registry", default="gcr.io/kubeflow-tpu-images")
+    args = parser.parse_args(argv)
+    print(yaml.safe_dump(cloudbuild(args.commit, args.registry), sort_keys=False), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
