@@ -5,7 +5,8 @@ set -euo pipefail
 
 : "${PRIVATE_REGISTRY:?set PRIVATE_REGISTRY, e.g. gcr.io/my-project/mirror}"
 
-while read -r image; do
+# `|| [[ -n ... ]]`: don't drop a final line with no trailing newline.
+while read -r image || [[ -n "${image}" ]]; do
     [[ -z "${image}" || "${image}" == \#* ]] && continue
     target="${PRIVATE_REGISTRY}/${image##*/}"
     echo "mirroring ${image} -> ${target}"

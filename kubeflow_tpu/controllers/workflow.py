@@ -158,7 +158,12 @@ class WorkflowController:
                 if p.status.get("phase") == "Failed"
             )
             state = "Pending"
-            if any(ph == "Succeeded" for ph in phases):
+            # Success persists in status too: a GC'd Succeeded pod must
+            # not make a completed step (and its side effects) re-run.
+            if (
+                any(ph == "Succeeded" for ph in phases)
+                or prev_steps.get(step.name, {}).get("state") == "Succeeded"
+            ):
                 state = "Succeeded"
             elif any(ph in ("Pending", "Running") for ph in phases):
                 state = "Running"
@@ -222,11 +227,15 @@ class WorkflowController:
                 for p in exit_attempts
                 if p.status.get("phase") == "Failed"
             )
-            if not exit_attempts and not exit_failed:
+            exit_prev = prev_steps.get(spec.on_exit.name, {}).get("state")
+            if (
+                any(ph == "Succeeded" for ph in exit_phases)
+                or exit_prev == "Succeeded"
+            ):
+                exit_state = "Succeeded"
+            elif not exit_attempts and not exit_failed:
                 self._create_step_pod(wf, spec, spec.on_exit, 0)
                 exit_state = "Running"
-            elif any(ph == "Succeeded" for ph in exit_phases):
-                exit_state = "Succeeded"
             elif any(ph in ("Pending", "Running") for ph in exit_phases):
                 exit_state = "Running"
             elif len(exit_failed) > spec.on_exit.retries:

@@ -7,17 +7,19 @@ from __future__ import annotations
 
 import argparse
 import logging
+import pathlib
 import subprocess
+import sys
 from typing import Callable
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from releasing.releaser import IMAGES  # noqa: E402
 
 log = logging.getLogger(__name__)
 
-DEFAULT_IMAGES = (
-    "platform",
-    "jax-notebook",
-    "kaggle-notebook",
-    "datascience-notebook",
-)
+# Derived from the release build list — the two stages must not drift.
+DEFAULT_IMAGES = tuple(name for name, _, _ in IMAGES)
 
 
 def default_copy(src: str, dst: str) -> None:

@@ -1,0 +1,164 @@
+"""E2E harness utilities (the `testing/` toolbox parity, SURVEY.md §4)."""
+
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from kubeflow_tpu.controllers.notebook import NotebookController
+from kubeflow_tpu.deploy.apply import apply_platform
+from kubeflow_tpu.deploy.kfdef import default_spec
+from kubeflow_tpu.deploy.provisioner import FakeCloud
+from kubeflow_tpu.deploy.server import DeployServer
+from kubeflow_tpu.testing.e2e_util import (
+    DeployProber,
+    NotebookLoadTest,
+    TestResult,
+    junit_xml,
+    kf_is_ready,
+    missing_deployments,
+    run_with_retry,
+    wait_for,
+    wait_for_deployments,
+)
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web import TestClient
+
+
+# -- retry / wait ----------------------------------------------------------
+
+
+def test_run_with_retry_eventually_succeeds():
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("flake")
+        return "ok"
+
+    assert (
+        run_with_retry(flaky, retries=3, delay_seconds=1.0, sleep=slept.append)
+        == "ok"
+    )
+    assert slept == [1.0, 2.0]  # exponential backoff
+
+
+def test_run_with_retry_exhausts():
+    def always_fails():
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        run_with_retry(always_fails, retries=2, sleep=lambda s: None)
+
+
+def test_wait_for_timeout():
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    def sleep(dt):
+        t["now"] += dt
+
+    with pytest.raises(TimeoutError, match="my condition"):
+        wait_for(
+            lambda: False, timeout_seconds=5, poll_seconds=1,
+            desc="my condition", clock=clock, sleep=sleep,
+        )
+
+
+# -- kf_is_ready -----------------------------------------------------------
+
+
+def test_kf_is_ready_after_full_apply():
+    api = FakeApiServer()
+    spec = default_spec("kf")
+    result = apply_platform(spec, api, FakeCloud(api))
+    assert result.succeeded
+    assert kf_is_ready(api) == []
+    wait_for_deployments(
+        api, ["centraldashboard"], timeout_seconds=1, sleep=lambda s: None
+    )
+
+
+def test_kf_is_ready_reports_what_is_missing():
+    api = FakeApiServer()
+    problems = kf_is_ready(api)
+    assert "deployment/tpu-job-operator" in problems
+    assert "crd/tpujobs" in problems
+    assert missing_deployments(api)  # nothing deployed
+
+
+# -- junit -----------------------------------------------------------------
+
+
+def test_junit_xml_well_formed():
+    xml = junit_xml(
+        "e2e",
+        [
+            TestResult("passes", 1.5),
+            TestResult("fails", 0.2, failure="assert 1 == 2 <oops>"),
+        ],
+    )
+    root = ET.fromstring(xml)
+    assert root.attrib["tests"] == "2"
+    assert root.attrib["failures"] == "1"
+    cases = root.findall("testcase")
+    assert cases[0].attrib["name"] == "passes"
+    assert cases[1].find("failure").text == "assert 1 == 2 <oops>"
+
+
+# -- notebook load test ----------------------------------------------------
+
+
+def test_notebook_loadtest_spawns_and_cleans_up():
+    api = FakeApiServer()
+    ctl = NotebookController(api)
+    lt = NotebookLoadTest(api)
+    lt.spawn(10)
+    ctl.controller.run_until_idle()
+    assert lt.ready_count() == 10
+    lt.cleanup()
+    assert api.list("Notebook", "loadtest") == []
+
+
+# -- deploy prober ---------------------------------------------------------
+
+
+def test_deploy_prober_end_to_end():
+    api = FakeApiServer()
+    server = DeployServer(api, FakeCloud(api))
+    client = TestClient(server)
+    # Real clock: the deploy worker is a real background thread, so fake
+    # time would burn the poll budget before it runs.
+    prober = DeployProber(
+        client, sleep=lambda dt: time.sleep(0.05), timeout_seconds=30
+    )
+    try:
+        ok = prober.probe_once(default_spec("probe").to_dict())
+        assert ok, "probe should deploy successfully"
+        text = prober.metrics.expose_text()
+        assert "deployment_service_status 1" in text
+        # Second probe of the same spec (idempotent second apply).
+        assert prober.probe_once(default_spec("probe").to_dict())
+    finally:
+        for worker in server._workers.values():
+            worker.stop()
+
+
+def test_deploy_prober_records_failure():
+    class BrokenClient:
+        def post(self, path, body=None):
+            raise ConnectionError("service down")
+
+        def get(self, path):
+            raise ConnectionError("service down")
+
+    prober = DeployProber(
+        BrokenClient(), clock=lambda: 0.0, sleep=lambda s: None
+    )
+    assert prober.probe_once(default_spec("x").to_dict()) is False
+    assert "deployment_service_status 0" in prober.metrics.expose_text()
+    assert "deployment_probe_failures_total 1" in prober.metrics.expose_text()

@@ -200,6 +200,27 @@ def test_exit_handler_runs_once():
     assert len(pods_for(api, "teardown")) == 1
 
 
+def test_gcd_succeeded_pod_does_not_rerun_step():
+    """Success persists in status: a GC'd Succeeded pod must not re-run
+    the step (duplicate side effects for push/tag steps)."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(
+        api, WorkflowSpec(steps=(step("a"), step("b", deps=["a"])))
+    )
+    ctl.controller.run_until_idle()
+    finish(api, pods_for(api, "a")[0])
+    ctl.controller.run_until_idle()  # b scheduled; a recorded Succeeded
+    api.delete("Pod", "wf-a-0", "ci")  # GC the succeeded pod
+    ctl.controller.run_until_idle()
+    assert pods_for(api, "a") == []  # NOT re-created
+    finish(api, pods_for(api, "b")[0])
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Succeeded"
+    assert wf.status["steps"]["a"]["state"] == "Succeeded"
+
+
 def test_deleted_failed_pod_does_not_refund_retry_budget():
     """Failed attempt indices persist in status: GC'ing a failed pod must
     not grant extra retries."""

@@ -9,11 +9,12 @@ cache.
 from __future__ import annotations
 
 import argparse
+import pathlib
 import sys
 
 import yaml
 
-sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 
 from releasing.releaser import IMAGES  # noqa: E402
 
