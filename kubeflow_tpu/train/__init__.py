@@ -10,3 +10,10 @@ from kubeflow_tpu.train.trainer import Trainer, TrainConfig, TrainState
 from kubeflow_tpu.train.data import SyntheticImages, SyntheticTokens
 from kubeflow_tpu.train.checkpoint import Checkpointer
 from kubeflow_tpu.train.loop import FitResult, TrainingDiverged, fit
+from kubeflow_tpu.train.profiling import (
+    MetricsLogger,
+    Profiler,
+    ProfileSchedule,
+    annotate,
+    annotated_scope,
+)

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 from kubeflow_tpu.train.checkpoint import Checkpointer
+from kubeflow_tpu.train.profiling import Profiler
 from kubeflow_tpu.train.trainer import Trainer, TrainState
 
 log = logging.getLogger(__name__)
@@ -46,6 +47,7 @@ def fit(
     checkpointer: Checkpointer | None = None,
     log_every: int = 50,
     on_metrics: Callable[[int, dict], None] | None = None,
+    profiler: "Profiler | None" = None,
 ) -> FitResult:
     """Train for `total_steps` global steps, resuming if possible."""
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -92,7 +94,11 @@ def fit(
                     f"data iterable exhausted at step {step} "
                     f"(needed {total_steps})"
                 ) from None
+            if profiler is not None:
+                profiler.before_step(step)
             state, metrics = step_fn(state, batch)
+            if profiler is not None:
+                profiler.after_step(step)
             examples += trainer.config.batch_size
             is_last = step + 1 == total_steps
             if checkpointer is not None and (
@@ -119,8 +125,11 @@ def fit(
                 )
                 t_last, examples = now, 0
     finally:
-        # Even on the exception path, make enqueued saves durable — the
-        # last good checkpoint is the recovery point.
+        # Even on the exception path: make enqueued saves durable (the
+        # last good checkpoint is the recovery point) and close a live
+        # trace (a diverging run should still leave a readable profile).
+        if profiler is not None:
+            profiler.close()
         if checkpointer is not None:
             checkpointer.wait()
 

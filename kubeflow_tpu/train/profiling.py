@@ -1,0 +1,146 @@
+"""Profiling: first-class jax.profiler trace capture for training jobs.
+
+The reference had no runtime instrumentation — profiling was a *served
+workload* (a Tensorboard CR pointed at a logdir, SURVEY.md §5 tracing
+row). The TPU-native version completes that loop: the training loop
+captures a windowed `jax.profiler` trace (XLA ops, TPU step time, HBM
+usage) into the job's logdir in the exact layout TensorBoard's profile
+plugin reads (`<logdir>/plugins/profile/<run>/`), and a `Tensorboard` CR
+with `logspath` at that directory serves it. Capture is windowed because
+tracing is expensive: profile steps [start, start+steps), not the whole
+run.
+
+Also here: `annotate` / `annotated_scope` — TraceAnnotation wrappers so
+named regions show up on the trace timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import logging
+import pathlib
+import time
+from typing import Any
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileSchedule:
+    """Trace `num_steps` steps, beginning `start_step` steps after this
+    process's first step. Relative (not absolute) on purpose: a resumed
+    run's first steps pay XLA recompilation, and the warmup skip must
+    apply there too."""
+
+    start_step: int = 10  # skip compile + warmup by default
+    num_steps: int = 3
+
+    def validate(self) -> None:
+        if self.start_step < 0 or self.num_steps < 1:
+            raise ValueError("start_step >= 0 and num_steps >= 1 required")
+
+
+class Profiler:
+    """Windowed trace capture driven by the training loop.
+
+    Call `before_step(step)` / `after_step(step)` around each step; the
+    profiler starts the trace at `schedule.start_step` and stops it after
+    `schedule.num_steps` steps. Stop is crash-safe: `close()` (call in a
+    finally) terminates a live trace so a diverging run still leaves a
+    readable profile on disk.
+    """
+
+    def __init__(
+        self,
+        logdir: str | pathlib.Path,
+        schedule: ProfileSchedule | None = None,
+    ):
+        self.logdir = pathlib.Path(logdir)
+        self.schedule = schedule or ProfileSchedule()
+        self.schedule.validate()
+        self._active = False
+        self._done = False
+        self._first_step: int | None = None
+
+    def before_step(self, step: int) -> None:
+        if self._first_step is None:
+            self._first_step = step
+        if (
+            not self._done
+            and not self._active
+            and step >= self._first_step + self.schedule.start_step
+        ):
+            self.logdir.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(self.logdir))
+            self._active = True
+            self._started_at = step
+            log.info("profiler: trace started at step %d", step)
+
+    def after_step(self, step: int) -> None:
+        if (
+            self._active
+            and step + 1 >= self._started_at + self.schedule.num_steps
+        ):
+            self._stop()
+
+    def _stop(self) -> None:
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        log.info("profiler: trace written under %s", self.logdir)
+
+    def close(self) -> None:
+        if self._active:
+            self._stop()
+
+    @property
+    def trace_written(self) -> bool:
+        return self._done
+
+
+def annotate(name: str):
+    """Decorator: mark a function as a named region on the trace."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(name):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+def annotated_scope(name: str):
+    """Context manager: named region on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class MetricsLogger:
+    """JSONL metrics sink living next to the profile traces, so one
+    `Tensorboard` CR's logspath covers both step metrics and the profile
+    plugin (the dashboard's activities view reads the same file)."""
+
+    def __init__(self, logdir: str | pathlib.Path, filename: str = "metrics.jsonl"):
+        self.path = pathlib.Path(logdir) / filename
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def __call__(self, step: int, record: dict[str, Any]) -> None:
+        with self.path.open("a") as f:
+            f.write(
+                json.dumps({"ts": time.time(), "step": step, **record}) + "\n"
+            )
+
+    def read(self) -> list[dict]:
+        if not self.path.exists():
+            return []
+        return [
+            json.loads(line)
+            for line in self.path.read_text().splitlines()
+            if line.strip()
+        ]
