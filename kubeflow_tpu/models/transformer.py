@@ -18,14 +18,17 @@ TPU for long sequences.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import dense_attention, ring_attention
+from kubeflow_tpu.ops.flash import flash_attention, flash_usable
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +42,10 @@ class TransformerConfig:
     rope_theta: float = 10_000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Attention kernel for the non-ring path: "auto" uses the Pallas flash
+    # kernel on TPU when the shapes divide into flash blocks, else the
+    # XLA-fused dense reference. "flash"/"dense" force one implementation.
+    attention_impl: str = "auto"
     # MoE: 0 experts = dense MLP. Top-1 (switch) routing with capacity.
     num_experts: int = 0
     capacity_factor: float = 1.25
@@ -90,6 +97,61 @@ def rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def _attend(q, k, v, mesh: Mesh | None, impl: str):
+    """Dispatch: ring when the sp axis is real, else flash/dense.
+
+    The flash kernel is a Pallas call, which does not auto-partition under
+    pjit — with a mesh it runs inside shard_map over the batch/tp axes
+    (embarrassingly parallel: each shard attends over its own batch rows and
+    heads; the sequence axis is unsharded on this path).
+    """
+    if impl not in ("auto", "flash", "dense"):
+        raise ValueError(
+            f"unknown attention_impl {impl!r}; expected 'auto', 'flash', "
+            "or 'dense'"
+        )
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return ring_attention(q, k, v, mesh, causal=True)
+    use_flash = impl == "flash" or (
+        impl == "auto"
+        and jax.default_backend() == "tpu"
+        and flash_usable(q.shape[1], k.shape[1])
+    )
+    if use_flash and mesh is not None:
+        # The shard_map wrapper needs batch % (dp·fsdp) == 0 and
+        # heads % tp == 0 — stricter than pjit auto-partitioning, so the
+        # auto path falls back to dense rather than erroring.
+        from kubeflow_tpu.parallel.sharding import batch_axes
+
+        bsz = 1
+        for a in batch_axes(mesh):
+            bsz *= mesh.shape[a]
+        tp = mesh.shape.get("tp", 1)
+        if q.shape[0] % bsz or q.shape[2] % tp:
+            if impl == "flash":
+                raise ValueError(
+                    f"attention_impl='flash' on a mesh requires batch "
+                    f"({q.shape[0]}) divisible by dp·fsdp ({bsz}) and heads "
+                    f"({q.shape[2]}) divisible by tp ({tp})"
+                )
+            use_flash = False
+    if not use_flash:
+        return dense_attention(q, k, v, causal=True)
+    if mesh is None:
+        return flash_attention(q, k, v, causal=True)
+    from kubeflow_tpu.parallel.sharding import batch_axes
+
+    heads = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    spec = P(batch_axes(mesh), None, heads, None)
+    return shard_map(
+        functools.partial(flash_attention, causal=True),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )(q, k, v)
+
+
 class Attention(nn.Module):
     config: TransformerConfig
     mesh: Mesh | None = None
@@ -103,10 +165,7 @@ class Attention(nn.Module):
         v = _dense((h, d), ("embed", "heads", "kv"), "wv", cfg.dtype)(x)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if self.mesh is not None:
-            out = ring_attention(q, k, v, self.mesh, causal=True)
-        else:
-            out = dense_attention(q, k, v, causal=True)
+        out = _attend(q, k, v, self.mesh, cfg.attention_impl)
         out = nn.DenseGeneral(
             cfg.d_model,
             axis=(-2, -1),

@@ -1,0 +1,392 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+The reference has no kernels at all — its device-level compute lives inside
+third-party containers (SURVEY.md §2.1). On TPU the hot op of the flagship
+transformer is attention, and the XLA-fused dense path materializes the
+[S, S] score matrix in HBM. This kernel is the classic blockwise
+(flash-attention) schedule tiled for the MXU instead:
+
+- grid (batch*heads, q_blocks, k_blocks), k innermost: TPU grid steps run
+  sequentially, so the running max / normalizer / output accumulator live in
+  VMEM scratch and carry across k-steps — HBM traffic is O(S·d), never O(S²).
+- Q/K/V blocks stream HBM→VMEM via the BlockSpec pipeline (double-buffered
+  by Pallas); the two matmuls per block hit the MXU in float32 accumulation.
+- causal blocks strictly above the diagonal are predicated off with
+  ``pl.when`` — they cost a grid step but no FLOPs.
+- the saved log-sum-exp rides in a lane-replicated [BH, S, 128] buffer —
+  Mosaic requires the last two block dims to be (8k, 128)-tileable, so a
+  [BH, S] vector output is not lowerable (same layout the upstream TPU
+  flash kernel uses).
+- backward is two more kernels with the same tiling: one accumulating dQ
+  (k innermost), one accumulating dK/dV (q innermost), both recomputing
+  P = exp(S - lse) from the lse rather than storing P, and recomputing
+  delta = rowsum(dO ∘ O) on-chip.
+
+Everything is wired through ``jax.custom_vjp`` so the op drops into any
+``jax.grad`` / ``pjit`` / ``shard_map`` context. On non-TPU backends the
+same kernels run under the Pallas interpreter (slow, test-only), which is
+how the CPU test suite validates them against the dense reference.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+_LANES = 128  # lse lane-replication width (Mosaic min tile lane count)
+
+
+def _auto_interpret(interpret: bool | None) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _causal_mask(s, i, j, bq, bk):
+    q_pos = i * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = j * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc,
+    *, scale: float, causal: bool, bq: int, bk: int,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    run = True
+    if causal:
+        # Skip blocks strictly above the diagonal.
+        run = j * bk <= i * bq + bq - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # Rows with every key masked so far keep m=-inf; exp(-inf - -inf)
+        # is nan, so both the correction and P need the guard.
+        safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        corr = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - safe_m))
+        p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - safe_m))
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        acc[:] = acc[:] * corr + lax.dot_general(
+            p,
+            v_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        m = m_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse = jnp.where(m == _NEG_INF, _NEG_INF, m + jnp.log(safe_l))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc, delta_scr,
+    *, scale: float, causal: bool, bq: int, bk: int,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+        delta = jnp.sum(
+            do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        )
+        delta_scr[:] = jnp.broadcast_to(delta, delta_scr.shape)
+
+    run = True
+    if causal:
+        run = j * bk <= i * bq + bq - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - lse))
+        do = do_ref[0].astype(jnp.float32)
+        dp = lax.dot_general(
+            do,
+            v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_scr[:, :1])
+        dq_acc[:] = dq_acc[:] + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, bq: int, bk: int,
+):
+    j = pl.program_id(1)  # k block (outer)
+    i = pl.program_id(2)  # q block (inner)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = j * bk <= i * bq + bq - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - lse))
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] = dv_acc[:] + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = lax.dot_general(
+            do,
+            v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = jnp.sum(
+            do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True
+        )
+        ds = p * (dp - delta)
+        dk_acc[:] = dk_acc[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        # dK = Σ dSᵀ·(scale·q); q was loaded pre-scaled, so the accumulator
+        # already carries the 1/sqrt(d) factor. dV is scale-free.
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pick_block(block: int, s: int) -> int:
+    block = min(block, s)
+    if s % block:
+        raise ValueError(
+            f"flash attention requires the sequence length ({s}) to be a "
+            f"multiple of the block size ({block}); pad the sequence or "
+            "use dense_attention"
+        )
+    return block
+
+
+def _qkv_specs(bq: int, bk: int, d: int):
+    return [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(block_q, sq)
+    bk = _pick_block(block_k, sk)
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, sq // bq, sk // bk),
+        in_specs=_qkv_specs(bq, bk, d),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * sq * sk * d // (2 if causal else 1),
+            bytes_accessed=bh * (sq + 2 * sk) * d * q.dtype.itemsize,
+            transcendentals=bh * sq * sk,
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(block_q, sq)
+    bk = _pick_block(block_k, sk)
+    scale = 1.0 / math.sqrt(d)
+
+    def _common_specs(order):
+        # order maps grid positions → (q_block_idx, k_block_idx)
+        return [
+            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, order(x, y)[0], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, order(x, y)[1], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, order(x, y)[1], 0)),
+            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, order(x, y)[0], 0)),
+            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, order(x, y)[0], 0)),
+            pl.BlockSpec(
+                (1, bq, _LANES), lambda b, x, y: (b, order(x, y)[0], 0)
+            ),
+        ]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        grid=(bh, sq // bq, sk // bk),
+        in_specs=_common_specs(lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        grid=(bh, sk // bk, sq // bq),
+        in_specs=_common_specs(lambda j, i: (i, j)),
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, do):
+    q, k, v, o, lse = residuals
+    return _flash_bwd_impl(
+        q, k, v, o, lse, do, causal, block_q, block_k, interpret
+    )
+
+
+_flash_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool | None = None,
+):
+    """Blockwise attention on the MXU. q, k, v: [B, S, H, D] → [B, S, H, D].
+
+    Numerically matches ``dense_attention`` (same online-softmax math) while
+    never materializing the [S, S] score matrix in HBM. ``interpret=None``
+    autodetects: compiled on TPU, Pallas interpreter elsewhere (tests).
+    """
+    b, sq, h, d = q.shape
+    interp = _auto_interpret(interpret)
+    # [B, S, H, D] → [B*H, S, D]: head-major layout keeps each grid step's
+    # blocks contiguous in HBM.
+    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    o = _flash_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q, block_k, interp
+    )
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def flash_usable(seq_q: int, seq_k: int, block_q: int = 256,
+                 block_k: int = 512) -> bool:
+    """True when the shapes divide into flash blocks (else use dense)."""
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    return seq_q % bq == 0 and seq_k % bk == 0

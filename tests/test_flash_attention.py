@@ -1,0 +1,95 @@
+"""Pallas flash attention vs the dense reference (interpreter mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops.attention import dense_attention
+from kubeflow_tpu.ops.flash import flash_attention, flash_usable
+
+
+def _qkv(key, b, s, h, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("s,block", [(128, 64), (256, 128), (96, 32)])
+def test_forward_matches_dense(causal, s, block):
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, s, 2, 32)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=block, block_k=block, interpret=True
+    )
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_uneven_blocks():
+    """block_q != block_k, including blocks that leave some rows fully
+    masked inside an executed causal block."""
+    q, k, v = _qkv(jax.random.PRNGKey(1), 1, 128, 1, 16)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=32, interpret=True
+    )
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    out = flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=64, interpret=True
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_dense(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(2), 1, 128, 2, 16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+        )
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_dense(q, k, v):
+        o = dense_attention(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            gf, gd, atol=5e-5, rtol=5e-5, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 128, 2, 32, jnp.bfloat16)
+    out = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_indivisible_seq_raises():
+    # Blocks clamp to the sequence length, so indivisibility only bites
+    # when seq > block and seq % block != 0.
+    q, k, v = _qkv(jax.random.PRNGKey(4), 1, 320, 1, 16)
+    assert not flash_usable(320, 320)
+    with pytest.raises(ValueError, match="multiple of the block size"):
+        flash_attention(q, k, v, interpret=True)
+
+
+def test_usable_predicate():
+    assert flash_usable(256, 256)
+    assert flash_usable(4096, 4096)
+    assert flash_usable(64, 64)  # block clamps to seq
+    assert flash_usable(100, 100)  # ditto — single full-seq block
+    assert not flash_usable(320, 256)

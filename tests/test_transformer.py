@@ -120,3 +120,23 @@ def test_moe_train_step(mesh8):
     # Expert weights exist with the expert dimension leading.
     moe_w = state.params["layer_0"]["moe"]["w_in"]
     assert moe_w.shape[0] == 4
+
+
+def test_flash_impl_matches_dense(mesh8):
+    """attention_impl="flash" (Pallas, interpreted on CPU) must produce the
+    same logits as the dense XLA path, including under a tp-sharded mesh."""
+    import dataclasses
+
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 64), 0, TINY.vocab_size)
+    dense_model = TransformerLM(TINY)
+    variables = dense_model.init(jax.random.PRNGKey(0), tokens)
+    ref = dense_model.apply(variables, tokens)
+
+    flash_cfg = dataclasses.replace(TINY, attention_impl="flash")
+    out = TransformerLM(flash_cfg).apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    out_sharded = TransformerLM(flash_cfg, mesh=mesh8).apply(variables, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_sharded), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
