@@ -7,6 +7,11 @@ object keys, a worker dedupes and reconciles, errors requeue with backoff,
 `requeue_after` drives periodic work (culling). Reconcilers are functions
 of *observed state only* — they read the API server fresh each pass, so a
 reconcile is idempotent and crash-safe.
+
+The queue itself is the native rate-limited workqueue
+(`native/src/workqueue.cc`, the compiled tier this platform keeps in C++
+where the reference kept it in Go); a pure-Python fallback with identical
+semantics covers environments without the native toolchain.
 """
 
 from __future__ import annotations
@@ -19,7 +24,6 @@ import time
 from typing import Callable, Iterable
 
 from kubeflow_tpu.api.objects import Resource
-from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 from kubeflow_tpu.utils.metrics import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -32,30 +36,163 @@ class Result:
     requeue_after: float | None = None
 
 
+class _PyWorkQueue:
+    """Python fallback with the native workqueue's exact interface and
+    semantics (keyed dedup, sooner-wins supersede, in-flight dirty set,
+    per-key exponential error backoff)."""
+
+    def __init__(self, base_backoff: float = 0.02, max_backoff: float = 30.0):
+        self._heap: list[tuple[float, int, str]] = []
+        self._queued: dict[str, float] = {}
+        self._inflight: set[str] = set()
+        self._dirty: set[str] = set()
+        self._failures: dict[str, int] = {}
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._base = base_backoff
+        self._max = max_backoff
+        self._down = False
+
+    def add(self, key: str, *, after: float = 0.0) -> None:
+        ready = time.monotonic() + max(0.0, after)
+        with self._cv:
+            if self._down:
+                return
+            if key in self._inflight:
+                self._dirty.add(key)
+                return
+            current = self._queued.get(key)
+            if current is not None and current <= ready:
+                return
+            self._queued[key] = ready
+            self._seq += 1
+            heapq.heappush(self._heap, (ready, self._seq, key))
+            self._cv.notify_all()
+
+    def _prune(self) -> None:
+        while self._heap:
+            ready, _, key = self._heap[0]
+            if self._queued.get(key) == ready:
+                return
+            heapq.heappop(self._heap)
+
+    def get(self, timeout: float = 0.0) -> str | None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._down:
+                    return None
+                self._prune()
+                now = time.monotonic()
+                if self._heap:
+                    ready, _, key = self._heap[0]
+                    if ready <= now:
+                        heapq.heappop(self._heap)
+                        del self._queued[key]
+                        self._inflight.add(key)
+                        return key
+                    until = min(ready, deadline)
+                    if until <= now:
+                        return None
+                    self._cv.wait(until - now)
+                else:
+                    if timeout == 0 or now >= deadline:
+                        return None
+                    self._cv.wait(deadline - now)
+
+    def done(self, key: str) -> None:
+        with self._cv:
+            self._inflight.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                if not self._down:
+                    ready = time.monotonic()
+                    current = self._queued.get(key)
+                    if current is None or current > ready:
+                        self._queued[key] = ready
+                        self._seq += 1
+                        heapq.heappush(self._heap, (ready, self._seq, key))
+                        self._cv.notify_all()
+
+    def requeue_error(self, key: str) -> float:
+        with self._cv:
+            n = self._failures[key] = self._failures.get(key, 0) + 1
+            backoff = min(self._max, self._base * 2 ** (n - 1))
+            if not self._down:
+                ready = time.monotonic() + backoff
+                current = self._queued.get(key)
+                if current is None or current > ready:
+                    self._queued[key] = ready
+                    self._seq += 1
+                    heapq.heappush(self._heap, (ready, self._seq, key))
+                    self._cv.notify_all()
+                self._dirty.discard(key)
+            return backoff
+
+    def forget(self, key: str) -> None:
+        with self._cv:
+            self._failures.pop(key, None)
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._queued)
+
+    def next_ready_in(self) -> float | None:
+        with self._cv:
+            self._prune()
+            if not self._heap:
+                return None
+            return max(0.0, self._heap[0][0] - time.monotonic())
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._down = True
+            self._cv.notify_all()
+
+
+def make_workqueue(
+    base_backoff: float = 0.02, max_backoff: float = 30.0
+):
+    """Native workqueue when the toolchain is available, else Python."""
+    try:
+        from kubeflow_tpu.native.core import WorkQueue
+
+        return WorkQueue(base_backoff=base_backoff, max_backoff=max_backoff)
+    except Exception:  # toolchain/build unavailable — keep semantics
+        log.warning("native workqueue unavailable; using Python fallback")
+        return _PyWorkQueue(base_backoff=base_backoff, max_backoff=max_backoff)
+
+
+def _encode(key: Key) -> str:
+    return f"{key[0]}/{key[1]}"
+
+
+def _decode(key: str) -> Key:
+    ns, _, name = key.partition("/")
+    return (ns, name)
+
+
 class Controller:
     """One reconciler bound to a primary kind and its owned kinds."""
 
     def __init__(
         self,
-        api: FakeApiServer,
+        api,
         kind: str,
-        reconcile: Callable[[FakeApiServer, Key], Result | None],
+        reconcile: Callable[[object, Key], Result | None],
         *,
         owns: Iterable[str] = (),
         name: str | None = None,
         metrics: MetricsRegistry | None = None,
         max_backoff: float = 30.0,
+        workqueue=None,
     ):
         self.api = api
         self.kind = kind
         self.name = name or f"{kind.lower()}-controller"
         self._reconcile = reconcile
         self._owns = tuple(owns)
-        self._queue: list[tuple[float, Key]] = []  # (ready_time, key) heap
-        self._queued: dict[Key, float] = {}  # key -> earliest ready time
-        self._failures: dict[Key, int] = {}
-        self._cv = threading.Condition()
-        self._max_backoff = max_backoff
+        self._queue = workqueue or make_workqueue(max_backoff=max_backoff)
         metrics = metrics or MetricsRegistry()
         self.reconcile_total = metrics.counter(
             "reconcile_total", "reconcile passes", ("controller", "outcome")
@@ -77,52 +214,34 @@ class Controller:
     def enqueue(self, key: Key, *, after: float = 0.0) -> None:
         """Enqueue; a sooner request supersedes a later pending one (a fresh
         watch event must not wait out an old error backoff)."""
-        ready = time.monotonic() + after
-        with self._cv:
-            current = self._queued.get(key)
-            if current is not None and current <= ready:
-                return
-            self._queued[key] = ready
-            heapq.heappush(self._queue, (ready, key))
-            self._cv.notify_all()
+        self._queue.add(_encode(key), after=after)
 
     # -- processing -------------------------------------------------------
 
-    def _pop_ready(self) -> Key | None:
-        with self._cv:
-            while self._queue:
-                ready, key = self._queue[0]
-                if self._queued.get(key) != ready:
-                    heapq.heappop(self._queue)  # superseded entry
-                    continue
-                if ready > time.monotonic():
-                    return None
-                heapq.heappop(self._queue)
-                del self._queued[key]
-                return key
-            return None
-
-    def process_one(self) -> bool:
+    def process_one(self, timeout: float = 0.0) -> bool:
         """Reconcile one ready key; False if nothing is ready."""
-        key = self._pop_ready()
-        if key is None:
+        key_s = self._queue.get(timeout)
+        if key_s is None:
             return False
+        key = _decode(key_s)
         try:
             result = self._reconcile(self.api, key) or Result()
         except Exception:
-            n = self._failures[key] = self._failures.get(key, 0) + 1
-            backoff = min(self._max_backoff, 0.01 * 2**n)
+            backoff = self._queue.requeue_error(key_s)
             log.exception(
-                "%s: reconcile %s failed (attempt %d), requeue in %.2fs",
-                self.name, key, n, backoff,
+                "%s: reconcile %s failed, requeue in %.2fs",
+                self.name, key, backoff,
             )
             self.reconcile_total.inc(controller=self.name, outcome="error")
-            self.enqueue(key, after=backoff)
+            self._queue.done(key_s)
             return True
-        self._failures.pop(key, None)
+        self._queue.forget(key_s)
         self.reconcile_total.inc(controller=self.name, outcome="success")
+        # done() before the delayed re-add: a dirty in-flight re-add must
+        # not swallow the requeue_after delay.
+        self._queue.done(key_s)
         if result.requeue_after is not None:
-            self.enqueue(key, after=result.requeue_after)
+            self._queue.add(key_s, after=result.requeue_after)
         return True
 
     def run_until_idle(self, *, max_passes: int = 1000) -> int:
@@ -139,16 +258,14 @@ class Controller:
         )
 
     def has_pending(self) -> bool:
-        with self._cv:
-            return bool(self._queued)
+        return len(self._queue) > 0
 
     # -- threaded mode ----------------------------------------------------
 
     def run(self, stop: threading.Event, poll: float = 0.05) -> None:
         while not stop.is_set():
-            if not self.process_one():
-                with self._cv:
-                    self._cv.wait(timeout=poll)
+            # Blocking get parks in native code (ctypes drops the GIL).
+            self.process_one(timeout=poll)
 
 
 class ControllerManager:

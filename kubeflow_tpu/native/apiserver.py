@@ -1,0 +1,190 @@
+"""The native API server: FakeApiServer's API over the C++ store.
+
+A drop-in replacement for `kubeflow_tpu.testing.fake_apiserver.FakeApiServer`
+whose storage semantics (resourceVersion concurrency, spec/status
+surfaces, finalizers, owner-ref cascade, namespace drain, label
+selectors) live in compiled code (`native/src/store.cc`) — the reference
+kept this tier native too (its controllers store through the Go
+apiserver; envtest in `profile-controller/controllers/suite_test.go:29`
+is the same idea for tests).
+
+Watch delivery stays synchronous and ordered: every mutating call drains
+the store's event journal and dispatches to subscribers before
+returning, so controller tests behave deterministically on either
+backend. Admission mutators run Python-side (the webhook is its own
+component), exactly as in FakeApiServer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid
+from kubeflow_tpu.native import core
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    WatchHandler,
+)
+
+
+def _to_resource(d: dict) -> Resource:
+    return Resource.from_dict(d)
+
+
+class NativeApiServer:
+    def __init__(self):
+        self._store = core.NativeStore()
+        self._cursor = 0
+        self._watchers: list[tuple[str | None, WatchHandler]] = []
+        self._admission: list[tuple[str | None, Callable[[Resource], Resource]]] = []
+        # Serializes mutate+dispatch so event order is deterministic even
+        # with concurrent controller threads (the C++ store is itself
+        # thread-safe; this lock is only about dispatch ordering).
+        self._dispatch_lock = threading.RLock()
+
+    # -- admission --------------------------------------------------------
+
+    def register_admission(
+        self, mutator: Callable[[Resource], Resource], kind: str | None = None
+    ) -> None:
+        with self._dispatch_lock:
+            self._admission.append((kind, mutator))
+
+    def _admit(self, obj: Resource) -> Resource:
+        for kind, mutator in list(self._admission):
+            if kind is None or kind == obj.kind:
+                obj = mutator(obj.deepcopy())
+        return obj
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, handler: WatchHandler, kind: str | None = None) -> None:
+        with self._dispatch_lock:
+            self._watchers.append((kind, handler))
+
+    def _drain_events(self) -> None:
+        events, cursor = self._store.events(self._cursor)
+        self._cursor = cursor
+        self._store.trim(cursor)
+        for ev in events:
+            obj = _to_resource(ev["object"])
+            for kind, handler in list(self._watchers):
+                if kind is None or kind == obj.kind:
+                    handler(ev["type"], obj.deepcopy())
+
+    def _translate(self, err: core.StoreError) -> Exception:
+        msg = str(err)
+        if err.code == core.STORE_NOT_FOUND:
+            return NotFound(msg)
+        if err.code == core.STORE_ALREADY_EXISTS:
+            return AlreadyExists(msg)
+        if err.code == core.STORE_CONFLICT:
+            return Conflict(msg)
+        return err
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj: Resource) -> Resource:
+        obj = self._admit(obj)
+        with self._dispatch_lock:
+            try:
+                stored = self._store.create(obj.to_dict())
+            except core.StoreError as e:
+                raise self._translate(e) from None
+            self._drain_events()
+            return _to_resource(stored)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        try:
+            return _to_resource(self._store.get(kind, namespace, name))
+        except core.StoreError as e:
+            raise self._translate(e) from None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[Resource]:
+        return [
+            _to_resource(d)
+            for d in self._store.list(kind, namespace, label_selector)
+        ]
+
+    def update(self, obj: Resource) -> Resource:
+        obj = self._admit(obj)
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: Resource) -> Resource:
+        return self._update(obj, status_only=True)
+
+    def _update(self, obj: Resource, *, status_only: bool) -> Resource:
+        with self._dispatch_lock:
+            try:
+                stored = self._store.update(
+                    obj.to_dict(), status_only=status_only
+                )
+            except core.StoreError as e:
+                raise self._translate(e) from None
+            self._drain_events()
+            return _to_resource(stored)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._dispatch_lock:
+            try:
+                self._store.delete(kind, namespace, name)
+            except core.StoreError as e:
+                raise self._translate(e) from None
+            self._drain_events()
+
+    # -- conveniences (same contracts as FakeApiServer) -------------------
+
+    def apply(self, obj: Resource) -> Resource:
+        try:
+            current = self.get(
+                obj.kind, obj.metadata.name, obj.metadata.namespace
+            )
+        except NotFound:
+            return self.create(obj)
+        obj = self._admit(obj)
+        if (
+            current.spec == obj.spec
+            and current.metadata.labels == obj.metadata.labels
+            and current.metadata.annotations == obj.metadata.annotations
+        ):
+            return current
+        merged = obj.deepcopy()
+        merged.metadata.resource_version = current.metadata.resource_version
+        merged.metadata.uid = current.metadata.uid
+        return self.update(merged)
+
+    def record_event(
+        self,
+        about: Resource,
+        reason: str,
+        message: str,
+        *,
+        type_: str = "Normal",
+    ) -> Resource:
+        name = f"{about.metadata.name}.{fresh_uid()[:8]}"
+        ev = Resource(
+            kind="Event",
+            metadata=ObjectMeta(
+                name=name, namespace=about.metadata.namespace
+            ),
+            spec={
+                "involvedObject": {
+                    "kind": about.kind,
+                    "name": about.metadata.name,
+                    "uid": about.metadata.uid,
+                },
+                "reason": reason,
+                "message": message,
+                "type": type_,
+            },
+            status={},
+        )
+        return self.create(ev)

@@ -1,0 +1,55 @@
+"""On-demand cmake+ninja build of the native tier (native/).
+
+Shared by all ctypes bindings: one cmake project produces every shared
+library (scheduler, control-plane core). No packaging step, no pybind11
+(not in the image) — the C ABI plus ctypes is the binding layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent.parent
+_NATIVE = _REPO / "native"
+_BUILD = _NATIVE / "build"
+_build_lock = threading.Lock()
+
+
+def ensure_built(lib_name: str) -> Path:
+    """Build (if stale) and return the path to native/build/<lib_name>."""
+    lib = _BUILD / lib_name
+    with _build_lock:
+        sources = list((_NATIVE / "src").glob("*.cc")) + [
+            _NATIVE / "CMakeLists.txt"
+        ]
+        src_newest = max(p.stat().st_mtime for p in sources)
+        if not lib.exists() or lib.stat().st_mtime < src_newest:
+            subprocess.run(
+                ["cmake", "-S", str(_NATIVE), "-B", str(_BUILD), "-G",
+                 "Ninja"],
+                check=True, capture_output=True,
+            )
+            subprocess.run(
+                ["cmake", "--build", str(_BUILD)],
+                check=True, capture_output=True,
+            )
+    return lib
+
+
+_libs: dict[str, ctypes.CDLL] = {}
+_libs_lock = threading.Lock()
+
+
+def load(lib_name: str, configure) -> ctypes.CDLL:
+    """Load a native library once per process; `configure(lib)` declares
+    the C ABI (argtypes/restypes) on first load."""
+    with _libs_lock:
+        cached = _libs.get(lib_name)
+        if cached is None:
+            cached = ctypes.CDLL(str(ensure_built(lib_name)))
+            configure(cached)
+            _libs[lib_name] = cached
+        return cached

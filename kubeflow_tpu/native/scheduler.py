@@ -8,86 +8,52 @@ the binding layer.
 from __future__ import annotations
 
 import ctypes
-import subprocess
-import threading
-from pathlib import Path
 
-_REPO = Path(__file__).resolve().parent.parent.parent
-_NATIVE = _REPO / "native"
-_LIB = _NATIVE / "build" / "libkftpu_sched.so"
-_build_lock = threading.Lock()
+from kubeflow_tpu.native.build import load
 
 
 class PlacementError(RuntimeError):
     pass
 
 
-def _ensure_built() -> Path:
-    with _build_lock:
-        src_newest = max(
-            p.stat().st_mtime for p in (_NATIVE / "src").glob("*.cc")
-        )
-        if not _LIB.exists() or _LIB.stat().st_mtime < src_newest:
-            subprocess.run(
-                ["cmake", "-S", str(_NATIVE), "-B", str(_NATIVE / "build"),
-                 "-G", "Ninja"],
-                check=True, capture_output=True,
-            )
-            subprocess.run(
-                ["cmake", "--build", str(_NATIVE / "build")],
-                check=True, capture_output=True,
-            )
-    return _LIB
-
-
-_lib = None
-_lib_lock = threading.Lock()
-
-
-def _load() -> ctypes.CDLL:
-    global _lib
-    with _lib_lock:
-        if _lib is None:
-            lib = ctypes.CDLL(str(_ensure_built()))
-            lib.kftpu_sched_new.restype = ctypes.c_void_p
-            lib.kftpu_sched_free.argtypes = [ctypes.c_void_p]
-            lib.kftpu_sched_add_node.restype = ctypes.c_int32
-            lib.kftpu_sched_add_node.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ]
-            lib.kftpu_sched_remove_node.restype = ctypes.c_int32
-            lib.kftpu_sched_remove_node.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p,
-            ]
-            lib.kftpu_sched_place_gang.restype = ctypes.c_int64
-            lib.kftpu_sched_place_gang.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
-                ctypes.c_int32,
-            ]
-            lib.kftpu_sched_release_gang.restype = ctypes.c_int32
-            lib.kftpu_sched_release_gang.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p,
-            ]
-            lib.kftpu_sched_reserve.restype = ctypes.c_int32
-            lib.kftpu_sched_reserve.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_int32,
-            ]
-            lib.kftpu_sched_free_chips.restype = ctypes.c_int64
-            lib.kftpu_sched_free_chips.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p,
-            ]
-            _lib = lib
-    return _lib
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.kftpu_sched_new.restype = ctypes.c_void_p
+    lib.kftpu_sched_free.argtypes = [ctypes.c_void_p]
+    lib.kftpu_sched_add_node.restype = ctypes.c_int32
+    lib.kftpu_sched_add_node.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.kftpu_sched_remove_node.restype = ctypes.c_int32
+    lib.kftpu_sched_remove_node.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+    ]
+    lib.kftpu_sched_place_gang.restype = ctypes.c_int64
+    lib.kftpu_sched_place_gang.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+        ctypes.c_int32,
+    ]
+    lib.kftpu_sched_release_gang.restype = ctypes.c_int32
+    lib.kftpu_sched_release_gang.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+    ]
+    lib.kftpu_sched_reserve.restype = ctypes.c_int32
+    lib.kftpu_sched_reserve.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int32,
+    ]
+    lib.kftpu_sched_free_chips.restype = ctypes.c_int64
+    lib.kftpu_sched_free_chips.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+    ]
 
 
 class GangScheduler:
     """Topology-aware, all-or-nothing gang placement (native-backed)."""
 
     def __init__(self):
-        self._lib = _load()
+        self._lib = load("libkftpu_sched.so", _configure)
         self._handle = self._lib.kftpu_sched_new()
 
     def __del__(self):

@@ -4,8 +4,15 @@ from kubeflow_tpu.api import new_resource, owner_ref
 from kubeflow_tpu.testing import AlreadyExists, Conflict, FakeApiServer, NotFound
 
 
-@pytest.fixture
-def api():
+@pytest.fixture(params=["python", "native"])
+def api(request):
+    """Every storage-semantics test runs against BOTH backends: the
+    in-process Python store and the compiled C++ store
+    (native/src/store.cc) behind the same API."""
+    if request.param == "native":
+        from kubeflow_tpu.native.apiserver import NativeApiServer
+
+        return NativeApiServer()
     return FakeApiServer()
 
 
