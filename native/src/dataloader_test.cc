@@ -1,0 +1,162 @@
+// Tests for the native record format + prefetching loader.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "dataloader.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,     \
+                   #cond);                                             \
+      std::exit(1);                                                    \
+    }                                                                  \
+  } while (0)
+
+static std::string WriteFile(const char* name, int first, int count,
+                             uint64_t record_bytes) {
+  std::string path = std::string("/tmp/kftpu_dl_") + name + ".rec";
+  void* w = kftpu_recwriter_open(path.c_str(), record_bytes);
+  CHECK(w != nullptr);
+  std::vector<char> rec(record_bytes);
+  for (int i = 0; i < count; ++i) {
+    int value = first + i;
+    std::memcpy(rec.data(), &value, sizeof(value));
+    CHECK(kftpu_recwriter_append(w, rec.data()) == 0);
+  }
+  CHECK(kftpu_recwriter_close(w) == count);
+  return path;
+}
+
+static void TestRoundtripAndStat() {
+  std::string p = WriteFile("rt", 100, 7, 32);
+  uint64_t rb = 0, rc = 0;
+  CHECK(kftpu_recfile_stat(p.c_str(), &rb, &rc) == 0);
+  CHECK(rb == 32 && rc == 7);
+  CHECK(kftpu_recfile_stat("/tmp/kftpu_dl_missing.rec", &rb, &rc) == -1);
+}
+
+static void TestSingleEpochCoversAll() {
+  std::string a = WriteFile("a", 0, 10, 16);
+  std::string b = WriteFile("b", 10, 6, 16);
+  std::string paths = a + ";" + b;
+  void* l = kftpu_loader_new(paths.c_str(), 4, 0, 1, /*shuffle=*/100,
+                             /*seed=*/7, /*threads=*/2, /*prefetch=*/2,
+                             /*drop_remainder=*/0, /*epochs=*/1);
+  CHECK(l != nullptr);
+  CHECK(kftpu_loader_record_bytes(l) == 16);
+  CHECK(kftpu_loader_shard_records(l) == 16);
+  std::set<int> seen;
+  std::vector<char> buf(4 * 16);
+  int64_t n;
+  while ((n = kftpu_loader_next(l, buf.data())) > 0) {
+    for (int64_t i = 0; i < n; ++i) {
+      int v;
+      std::memcpy(&v, buf.data() + i * 16, sizeof(v));
+      seen.insert(v);
+    }
+  }
+  CHECK(n == 0);  // clean end of data
+  CHECK(seen.size() == 16);  // every record exactly once (it's a set,
+                             // so also: all 16 distinct values appeared)
+  for (int i = 0; i < 16; ++i) CHECK(seen.count(i) == 1);
+  kftpu_loader_free(l);
+}
+
+static void TestShardingPartitions() {
+  std::string p = WriteFile("shard", 0, 20, 8);
+  std::set<int> all;
+  for (int shard = 0; shard < 2; ++shard) {
+    void* l = kftpu_loader_new(p.c_str(), 5, shard, 2, /*shuffle=*/0,
+                               0, 1, 1, /*drop_remainder=*/0, 1);
+    CHECK(l != nullptr);
+    CHECK(kftpu_loader_shard_records(l) == 10);
+    std::vector<char> buf(5 * 8);
+    int64_t n;
+    std::set<int> mine;
+    while ((n = kftpu_loader_next(l, buf.data())) > 0)
+      for (int64_t i = 0; i < n; ++i) {
+        int v;
+        std::memcpy(&v, buf.data() + i * 8, sizeof(v));
+        mine.insert(v);
+      }
+    for (int v : mine) {
+      CHECK(all.count(v) == 0);  // disjoint shards
+      all.insert(v);
+    }
+    kftpu_loader_free(l);
+  }
+  CHECK(all.size() == 20);
+}
+
+static void TestDropRemainderAndLooping() {
+  std::string p = WriteFile("loop", 0, 10, 8);
+  // drop_remainder: 10 records / batch 4 -> 2 batches per epoch.
+  void* l = kftpu_loader_new(p.c_str(), 4, 0, 1, 0, 0, 1, 1,
+                             /*drop_remainder=*/1, /*epochs=*/3);
+  CHECK(l != nullptr);
+  std::vector<char> buf(4 * 8);
+  int batches = 0;
+  while (kftpu_loader_next(l, buf.data()) == 4) batches++;
+  CHECK(batches == 6);
+  kftpu_loader_free(l);
+  // loop_epochs=0 streams forever; just check it comfortably exceeds an
+  // epoch.
+  l = kftpu_loader_new(p.c_str(), 4, 0, 1, 16, 3, 2, 2, 1, 0);
+  for (int i = 0; i < 25; ++i)
+    CHECK(kftpu_loader_next(l, buf.data()) == 4);
+  CHECK(kftpu_loader_batches(l) == 25);
+  kftpu_loader_free(l);
+}
+
+static void TestShuffleIsSeededAndPerEpoch() {
+  std::string p = WriteFile("shuf", 0, 64, 8);
+  auto epoch_order = [&](uint64_t seed) {
+    void* l = kftpu_loader_new(p.c_str(), 64, 0, 1, 64, seed, 1, 1, 0, 2);
+    std::vector<char> buf(64 * 8);
+    std::vector<int> e1(64), e2(64);
+    CHECK(kftpu_loader_next(l, buf.data()) == 64);
+    for (int i = 0; i < 64; ++i)
+      std::memcpy(&e1[i], buf.data() + i * 8, sizeof(int));
+    CHECK(kftpu_loader_next(l, buf.data()) == 64);
+    for (int i = 0; i < 64; ++i)
+      std::memcpy(&e2[i], buf.data() + i * 8, sizeof(int));
+    kftpu_loader_free(l);
+    return std::make_pair(e1, e2);
+  };
+  auto [a1, a2] = epoch_order(11);
+  auto [b1, b2] = epoch_order(11);
+  auto [c1, c2] = epoch_order(12);
+  CHECK(a1 == b1 && a2 == b2);  // deterministic given a seed
+  CHECK(a1 != a2);              // reshuffled across epochs
+  CHECK(a1 != c1);              // seed changes the order
+}
+
+static void TestBadInputs() {
+  CHECK(kftpu_loader_new("/tmp/kftpu_dl_missing.rec", 4, 0, 1, 0, 0, 1, 1,
+                         0, 1) == nullptr);
+  std::string p = WriteFile("bad", 0, 4, 8);
+  CHECK(kftpu_loader_new(p.c_str(), 0, 0, 1, 0, 0, 1, 1, 0, 1) == nullptr);
+  CHECK(kftpu_loader_new(p.c_str(), 4, 2, 2, 0, 0, 1, 1, 0, 1) == nullptr);
+  // Mixed record sizes refuse to combine.
+  std::string q = WriteFile("bad2", 0, 4, 16);
+  std::string both = p + ";" + q;
+  CHECK(kftpu_loader_new(both.c_str(), 4, 0, 1, 0, 0, 1, 1, 0, 1) ==
+        nullptr);
+}
+
+int main() {
+  TestRoundtripAndStat();
+  TestSingleEpochCoversAll();
+  TestShardingPartitions();
+  TestDropRemainderAndLooping();
+  TestShuffleIsSeededAndPerEpoch();
+  TestBadInputs();
+  std::printf("dataloader_test: all ok\n");
+  return 0;
+}
