@@ -17,6 +17,7 @@ Parity with `components/centraldashboard/app/` (SURVEY.md §2 #12, §3.5):
 
 from __future__ import annotations
 
+import pathlib
 import time
 from typing import Protocol
 
@@ -102,6 +103,7 @@ class DashboardApp(App):
         authn: HeaderAuthn | None = None,
     ):
         super().__init__("centraldashboard")
+        self.mount_static(pathlib.Path(__file__).parent / "static")
         self.api = api
         self.metrics_service = metrics_service or LocalMetricsService(api)
         self.links = links or DEFAULT_LINKS

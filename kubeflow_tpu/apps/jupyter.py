@@ -58,6 +58,9 @@ class JupyterApp(App):
         authn: HeaderAuthn | None = None,
     ):
         super().__init__("jupyter")
+        self.mount_static(
+            pathlib.Path(__file__).parent / "static", "jupyter.html"
+        )
         self.api = api
         self.config = load_spawner_config(config_path)
         self.before_request(authn or HeaderAuthn())

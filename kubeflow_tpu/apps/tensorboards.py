@@ -11,6 +11,8 @@ story (SURVEY.md §5, tracing row).
 
 from __future__ import annotations
 
+import pathlib
+
 from kubeflow_tpu.api.objects import new_resource
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 from kubeflow_tpu.web import (
@@ -27,6 +29,9 @@ from kubeflow_tpu.web import (
 class TensorboardsApp(App):
     def __init__(self, api: FakeApiServer, *, authn: HeaderAuthn | None = None):
         super().__init__("tensorboards")
+        self.mount_static(
+            pathlib.Path(__file__).parent / "static", "tensorboards.html"
+        )
         self.api = api
         self.before_request(authn or HeaderAuthn())
         self.add_route("/api/namespaces/<ns>/tensorboards", self.list_tbs)

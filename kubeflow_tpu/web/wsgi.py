@@ -14,6 +14,8 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import mimetypes
+import pathlib
 import re
 import threading
 import traceback
@@ -94,6 +96,13 @@ class Response:
     def status_line(self) -> str:
         return f"{self.status} {http.client.responses.get(self.status, 'Unknown')}"
 
+    @property
+    def content_type(self) -> str:
+        for key, value in self.headers:
+            if key.lower() == "content-type":
+                return value
+        return ""
+
     def json(self) -> dict:
         return json.loads(self.body)
 
@@ -144,7 +153,34 @@ class App:
         self.name = name
         self._routes: list[_Route] = []
         self._before: list[Callable[[Request], Response | None]] = []
+        self._static_root: pathlib.Path | None = None
+        self._static_index: str = "index.html"
         self.add_route("/healthz", self._healthz, methods=("GET",))
+
+    def mount_static(
+        self, root: str | pathlib.Path, index: str = "index.html"
+    ) -> None:
+        """Serve the app's SPA: GET / returns `index`, other unmatched GET
+        paths are looked up under `root` (the crud_backend pattern of one
+        backend serving both /api and its compiled frontend,
+        `crud_backend/serving.py`). API routes always win."""
+        self._static_root = pathlib.Path(root).resolve()
+        self._static_index = index
+
+    def _try_static(self, req: Request) -> Response | None:
+        if self._static_root is None or req.method != "GET":
+            return None
+        rel = req.path.lstrip("/") or self._static_index
+        target = (self._static_root / rel).resolve()
+        # resolve() collapses ../ — refuse anything escaping the root.
+        if not target.is_relative_to(self._static_root):
+            return None
+        if not target.is_file():
+            return None
+        ctype = (
+            mimetypes.guess_type(str(target))[0] or "application/octet-stream"
+        )
+        return Response(body=target.read_bytes(), content_type=ctype)
 
     def _healthz(self, req: Request) -> Response:
         # Probe endpoint (crud_backend registers the same; authn hooks
@@ -211,6 +247,9 @@ class App:
             return route.handler(req)
         if matched_path:
             raise HttpError(405, f"{req.method} not allowed on {req.path}")
+        static = self._try_static(req)
+        if static is not None:
+            return static
         raise HttpError(404, f"no route for {req.path}")
 
     # -- WSGI --------------------------------------------------------------

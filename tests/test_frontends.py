@@ -1,0 +1,89 @@
+"""Frontend serving: each web app ships its SPA (the reference's
+Polymer/Angular tier) from the same backend that serves /api — the
+crud_backend pattern of one container serving both."""
+
+import pathlib
+
+import pytest
+
+from kubeflow_tpu.apps.dashboard import DashboardApp
+from kubeflow_tpu.apps.jupyter import JupyterApp
+from kubeflow_tpu.apps.tensorboards import TensorboardsApp
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.web import App, Response, TestClient
+from kubeflow_tpu.web.authn import HeaderAuthn
+
+HDR = "x-goog-authenticated-user-email"
+HEADERS = {HDR: "accounts.google.com:alice@x.co"}
+
+STATIC = pathlib.Path("kubeflow_tpu/apps/static")
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+@pytest.mark.parametrize(
+    "app_cls,marker",
+    [
+        (DashboardApp, "Kubeflow TPU"),
+        (JupyterApp, "New Notebook"),
+        (TensorboardsApp, "New Tensorboard"),
+    ],
+)
+def test_index_served(api, app_cls, marker):
+    client = TestClient(app_cls(api), headers=HEADERS)
+    resp = client.get("/")
+    assert resp.status == 200
+    assert resp.content_type.startswith("text/html")
+    assert marker in resp.body.decode()
+
+
+def test_shared_assets_served(api):
+    client = TestClient(JupyterApp(api), headers=HEADERS)
+    assert "--accent" in client.get("/ui.css").body.decode()
+    js = client.get("/ui.js")
+    assert js.content_type.startswith(("text/javascript", "application/javascript"))
+    assert "export class Poller" in js.body.decode()
+
+
+def test_api_routes_win_over_static(api):
+    client = TestClient(JupyterApp(api), headers=HEADERS)
+    resp = client.get("/api/config")
+    assert resp.json()["config"]
+
+
+def test_traversal_refused(api):
+    client = TestClient(JupyterApp(api), headers=HEADERS)
+    resp = client.get("/../jupyter.py")
+    assert resp.status == 404
+
+
+def test_static_requires_identity():
+    """The SPA sits behind the same authn hook as /api (unauthenticated
+    clients cannot probe either surface)."""
+    app = JupyterApp(FakeApiServer(), authn=HeaderAuthn())
+    client = TestClient(app)  # no identity header
+    assert client.get("/").status == 401
+
+
+def test_frontends_reference_only_backend_routes():
+    """Every fetch() the SPAs make has a matching backend route — keeps
+    the pages and the APIs from drifting apart."""
+    routes = {
+        "jupyter.html": [
+            "/api/config",
+            "/api/namespaces/${ns}/notebooks",
+            "/api/storageclasses",
+            "/api/namespaces/${ns}/poddefaults",
+        ],
+        "tensorboards.html": [
+            "/api/namespaces/${ns}/tensorboards",
+            "/api/namespaces/${ns}/pvcs",
+        ],
+    }
+    for page, expected in routes.items():
+        text = (STATIC / page).read_text()
+        for path in expected:
+            assert path in text, f"{page} no longer calls {path}"
