@@ -21,6 +21,7 @@ from kubeflow_tpu.apps.jupyter import JupyterApp
 from kubeflow_tpu.apps.kfam import KfamApp
 from kubeflow_tpu.apps.tensorboards import TensorboardsApp
 from kubeflow_tpu.controllers import poddefault
+from kubeflow_tpu.controllers.nodehealth import NodeHealthController
 from kubeflow_tpu.controllers.notebook import NotebookController
 from kubeflow_tpu.controllers.profile import ProfileController
 from kubeflow_tpu.controllers.runtime import ControllerManager
@@ -61,6 +62,7 @@ def main() -> None:
         NotebookController(api),
         TensorboardController(api),
         TpuJobController(api),
+        NodeHealthController(api),
         StudyController(api),
         WorkflowController(api),
     ):

@@ -110,6 +110,65 @@ def build_mesh(
     return Mesh(dev_array, AXES)
 
 
+def build_hybrid_mesh(
+    ici: MeshSpec,
+    dcn: MeshSpec,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Multi-slice mesh: `ici` axes laid out inside each TPU slice, `dcn`
+    axes spanning slices over the data-center network.
+
+    This is the megascale layout (SURVEY.md §2.2 "DCN multi-slice"): the
+    DCN axes must carry only bandwidth-tolerant collectives — put dp or
+    pp there (gradient psum once per step, or pipeline bubbles), never
+    tp/sp whose per-layer collectives would serialize on DCN latency.
+    The per-axis mesh size is ici_axis * dcn_axis; shardings address the
+    combined axis by its usual name, so models are layout-agnostic.
+
+    Uses `mesh_utils.create_hybrid_device_mesh` on real TPU slices (it
+    reads each device's slice_index); virtual/CPU device sets fall back
+    to grouping consecutive devices into equal "slices".
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if any(s == -1 for s in dcn.sizes()):
+        raise ValueError("dcn axes must be explicit (no -1): slice count "
+                         "is physical, not inferred")
+    n_slices = math.prod(dcn.sizes())
+    if n_slices < 1 or len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices"
+        )
+    per_slice = len(devices) // n_slices
+    ici = ici.resolve(per_slice)
+    sizes = tuple(
+        i * d for i, d in zip(ici.sizes(), dcn.sizes())
+    )
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici.sizes(), dcn.sizes(), devices=devices
+        )
+    except (ValueError, NotImplementedError, AttributeError, KeyError):
+        # Virtual devices carry no slice topology: emulate slices as
+        # consecutive device groups. Build a [dcn..., ici...] array then
+        # interleave to [ici*dcn combined axes].
+        slices = [
+            np.asarray(devices[s * per_slice:(s + 1) * per_slice]).reshape(
+                ici.sizes()
+            )
+            for s in range(n_slices)
+        ]
+        outer = np.empty(tuple(dcn.sizes()) + tuple(ici.sizes()), dtype=object)
+        outer.reshape(n_slices, *ici.sizes())[...] = np.stack(slices)
+        # Move each dcn axis to sit just outside its ici partner, then
+        # collapse the pair into one combined axis.
+        k = len(AXES)
+        order: list[int] = []
+        for axis in range(k):
+            order += [axis, k + axis]
+        dev_array = outer.transpose(order).reshape(sizes)
+    return Mesh(dev_array, AXES)
+
+
 def local_mesh_spec(n_devices: int | None = None, tp: int = 1, sp: int = 1) -> MeshSpec:
     """Convenience: FSDP over everything not claimed by tp/sp."""
     n = n_devices if n_devices is not None else jax.device_count()

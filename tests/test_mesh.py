@@ -51,3 +51,48 @@ def test_mesh_runs_sharded_compute(mesh8):
     xs = jax.device_put(x, NamedSharding(mesh8, P(("dp", "fsdp"), None)))
     y = jax.jit(lambda a: (a * 2).sum())(xs)
     assert float(y) == float(x.sum() * 2)
+
+
+def test_hybrid_mesh_two_slices(devices):
+    """2 slices x 4 chips: dp spans DCN, fsdp/tp ride ICI in-slice."""
+    from kubeflow_tpu.parallel.mesh import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh(
+        MeshSpec(fsdp=2, tp=2), MeshSpec(dp=2), devices
+    )
+    assert mesh.axis_names == AXES
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["fsdp"] == 2 and mesh.shape["tp"] == 2
+    assert mesh.devices.size == 8
+    # The dp axis is the slice boundary: within one dp index, all devices
+    # come from the same consecutive-device "slice".
+    arr = mesh.devices.reshape(2, 4)  # dp, (fsdp*tp)
+    ids0 = {d.id for d in arr[0].flat}
+    ids1 = {d.id for d in arr[1].flat}
+    assert ids0 == {0, 1, 2, 3} and ids1 == {4, 5, 6, 7}
+
+
+def test_hybrid_mesh_runs_collectives(devices):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.parallel.mesh import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh(MeshSpec(fsdp=4), MeshSpec(dp=2), devices)
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"), None)))
+    y = jax.jit(lambda a: a.sum())(xs)
+    assert float(y) == float(x.sum())
+
+
+def test_hybrid_mesh_rejects_wildcard_dcn(devices):
+    from kubeflow_tpu.parallel.mesh import build_hybrid_mesh
+
+    with pytest.raises(ValueError, match="explicit"):
+        build_hybrid_mesh(MeshSpec(fsdp=4), MeshSpec(dp=-1), devices)
+
+
+def test_hybrid_mesh_bad_slice_division(devices):
+    from kubeflow_tpu.parallel.mesh import build_hybrid_mesh
+
+    with pytest.raises(ValueError, match="divisible"):
+        build_hybrid_mesh(MeshSpec(fsdp=2), MeshSpec(dp=3), devices)
