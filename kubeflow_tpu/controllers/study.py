@@ -13,15 +13,20 @@ Running then Completed):
 - a trial's objective value is read from the TpuJob's
   `status.observation` map (written by the launcher at job end — the
   TPU-native replacement for katib's metrics-collector sidecar);
-- suggestion is deterministic in (spec, trial index): a restarted
-  controller regenerates the same assignments instead of re-sampling
-  (crash-safe without persisted sampler state);
+- suggestion state lives entirely in the API objects: random/grid
+  assignments are deterministic in (spec, trial index), while the
+  history-aware algorithms (bayesian TPE, successive halving) re-derive
+  their state each reconcile from the trials' persisted parameter
+  annotations plus the `status.maxTrialIndex` high-water mark — a
+  restarted controller picks up exactly where it left off, and deleted
+  trial indices stay spent;
 - terminal: Succeeded with `status.bestTrial` once all trials finish,
   Failed when failed trials exceed `maxFailedTrials`.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 
@@ -36,12 +41,47 @@ log = logging.getLogger(__name__)
 
 LABEL_STUDY = "kubeflow-tpu.org/study"
 LABEL_TRIAL = "kubeflow-tpu.org/trial-index"
+# The raw parameter assignment, JSON — the durable sampler state that
+# history-aware algorithms (bayesian TPE, successive halving) read back
+# instead of persisting suggester state anywhere.
+ANNOTATION_PARAMS = "kubeflow-tpu.org/parameters"
 
 TRIAL_TERMINAL = ("Succeeded", "Failed")
 
 
 def trial_name(study: str, index: int) -> str:
     return f"{study}-trial-{index}"
+
+
+def _int_or(value, default: int) -> int:
+    """Status is client-writable through the HTTP facade — a bogus
+    maxTrialIndex must degrade to the positional fallback, not crash."""
+    if isinstance(value, bool) or not isinstance(value, int):
+        return default
+    return value
+
+
+def _numeric(value) -> float | None:
+    """Observation values are client-writable through the HTTP facade —
+    anything non-numeric (including bool) is treated as absent rather
+    than crashing or polluting the ranking."""
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    return value
+
+
+def _trial_assignment(trial: Resource) -> dict:
+    raw = trial.metadata.annotations.get(ANNOTATION_PARAMS)
+    # Client-writable: anything but a JSON-object string is treated as
+    # absent (including non-string values, which json.loads would raise
+    # TypeError on).
+    if not raw or not isinstance(raw, str):
+        return {}
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        return {}
+    return parsed if isinstance(parsed, dict) else {}
 
 
 class StudyController:
@@ -84,6 +124,7 @@ class StudyController:
                 LABEL_STUDY: study.metadata.name,
                 LABEL_TRIAL: str(index),
             },
+            annotations={ANNOTATION_PARAMS: json.dumps(assignment)},
         )
         job.metadata.owner_references = [owner_ref(study)]
         self.api.create(job)
@@ -177,27 +218,37 @@ class StudyController:
                 reason="maxFailedTrials exceeded",
             )
 
-        total_budget = spec.total_trials()
-        created = len(by_index)
-        next_index = max(by_index, default=-1) + 1
-        exhausted = False
-        while created < total_budget and active < spec.parallelism:
-            assignment = spec.assignment_for(next_index)
-            if assignment is None:
-                # Suggestion space spent (e.g. a grid trial was deleted
-                # after exhaustion — indices can't be re-suggested, so the
-                # study must still terminate below).
-                exhausted = True
-                break
-            self._create_trial(study, spec, next_index, assignment)
-            log.info(
-                "study %s/%s: trial %d -> %s", ns, name, next_index, assignment
+        records = [
+            study_api.TrialRecord(
+                index=idx,
+                state=t.status.get("phase", "Pending"),
+                assignment=_trial_assignment(t),
+                objective=_numeric(
+                    (t.status.get("observation") or {}).get(
+                        spec.objective_metric
+                    )
+                ),
             )
-            next_index += 1
-            created += 1
+            for idx, t in by_index.items()
+        ]
+        # High-water mark: indices at/below it are spent even if their
+        # trial was deleted (deleted trials are never re-run).
+        floor = max(
+            _int_or(study.status.get("maxTrialIndex"), -1),
+            max(by_index, default=-1),
+        )
+        new_trials, done = spec.suggest(
+            records, slots=spec.parallelism - active, floor=floor
+        )
+        for index, assignment in new_trials:
+            self._create_trial(study, spec, index, assignment)
+            log.info(
+                "study %s/%s: trial %d -> %s", ns, name, index, assignment
+            )
             active += 1
+            floor = max(floor, index)
 
-        if (created >= total_budget or exhausted) and active == 0:
+        if done and not new_trials and active == 0:
             return self._finish(
                 api, study, "Succeeded", trials=rows, best=best
             )
@@ -205,6 +256,7 @@ class StudyController:
             api, study, "Running",
             trials=rows, best=best,
             counts={"active": active, "succeeded": succeeded, "failed": failed},
+            max_index=floor,
         )
 
     # -- status ----------------------------------------------------------
@@ -219,6 +271,7 @@ class StudyController:
         best=None,
         counts=None,
         reason: str | None = None,
+        max_index: int | None = None,
     ) -> Result:
         fresh = api.get(
             study_api.KIND, study.metadata.name, study.metadata.namespace
@@ -230,6 +283,10 @@ class StudyController:
             new_status["bestTrial"] = best
         if counts is not None:
             new_status["trialStatuses"] = counts
+        if max_index is not None and max_index >= 0:
+            new_status["maxTrialIndex"] = max(
+                max_index, _int_or(new_status.get("maxTrialIndex"), -1)
+            )
         if reason is not None:
             new_status["reason"] = reason
         if new_status.get("phase") != phase:

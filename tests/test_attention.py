@@ -1,3 +1,4 @@
+"""Ring attention (sequence-parallel shard_map) vs dense reference."""
 import jax
 import jax.numpy as jnp
 import numpy as np

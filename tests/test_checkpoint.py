@@ -1,3 +1,4 @@
+"""Orbax checkpoint manager + resumable fit() with divergence guard."""
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,3 +1,4 @@
+"""Named-axis collective wrappers on a virtual multi-device CPU mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,3 +1,4 @@
+"""Process bootstrap env contract (the TF_CONFIG analog) parsing."""
 import pytest
 
 from kubeflow_tpu.parallel.distributed import (

@@ -1,3 +1,4 @@
+"""FakeApiServer storage semantics: versions, conflicts, owners, GC."""
 import pytest
 
 from kubeflow_tpu.api import new_resource, owner_ref

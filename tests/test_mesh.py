@@ -1,3 +1,4 @@
+"""MeshSpec/build_mesh axis inference and validation."""
 import jax
 import numpy as np
 import pytest

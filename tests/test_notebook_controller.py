@@ -1,3 +1,4 @@
+"""Notebook controller: reconcile to STS/Service/VirtualService, culling."""
 import pytest
 
 from kubeflow_tpu.api import new_resource

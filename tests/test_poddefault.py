@@ -1,3 +1,4 @@
+"""PodDefault admission: selector matching, conflict-safe injection."""
 import pytest
 
 from kubeflow_tpu.api import new_resource

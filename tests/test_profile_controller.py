@@ -1,3 +1,4 @@
+"""Profile controller: namespace/RBAC/quota reconcile, plugins, finalizer."""
 import pytest
 
 from kubeflow_tpu.api import new_resource

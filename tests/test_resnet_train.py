@@ -1,3 +1,4 @@
+"""ResNet trainer end-to-end on a sharded CPU mesh: loss goes down."""
 import jax
 import jax.numpy as jnp
 import numpy as np

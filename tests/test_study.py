@@ -5,8 +5,15 @@ StudyJob, poll status.conditions to Running/Completed)."""
 import pytest
 
 from kubeflow_tpu.api.objects import new_resource
-from kubeflow_tpu.api.study import KIND, ParameterSpec, StudySpec, render_template
+from kubeflow_tpu.api.study import (
+    KIND,
+    ParameterSpec,
+    StudySpec,
+    TrialRecord,
+    render_template,
+)
 from kubeflow_tpu.controllers.study import (
+    ANNOTATION_PARAMS,
     LABEL_STUDY,
     LABEL_TRIAL,
     StudyController,
@@ -106,6 +113,387 @@ def test_template_rendering_types_and_embedding():
 def test_unresolved_placeholder_raises():
     with pytest.raises(ValueError, match="unresolved"):
         render_template({"a": "${trialParameters.missing}"}, {"lr": 1})
+
+
+# -- bayesian (TPE) --------------------------------------------------------
+
+
+def _tpe_spec(**kw):
+    defaults = dict(
+        parameters=(ParameterSpec("x", "double", min=0.0, max=1.0),),
+        algorithm="bayesian",
+        startup_trials=4,
+        max_trials=50,
+        trial_template=TEMPLATE,
+    )
+    defaults.update(kw)
+    return StudySpec(**defaults)
+
+
+def _records(points):
+    return [
+        TrialRecord(index=i, state="Succeeded", assignment={"x": x},
+                    objective=obj)
+        for i, (x, obj) in enumerate(points)
+    ]
+
+
+def test_bayesian_startup_is_random_then_history_aware():
+    spec = _tpe_spec()
+    # Below startup_trials completed: falls back to the seeded random
+    # stream, identical to algorithm="random".
+    few = _records([(0.5, 1.0)])
+    rand = StudySpec(**{**spec.__dict__, "algorithm": "random"})
+    assert spec._sequential_assignment(7, few) == rand.assignment_for(7)
+    # With history, TPE engages and (given a clean signal) proposes near
+    # the good cluster: low x had low loss.
+    history = _records(
+        [(0.05 + 0.01 * i, 0.1) for i in range(5)]
+        + [(0.8 + 0.02 * i, 10.0) for i in range(5)]
+    )
+    xs = [spec._sequential_assignment(100 + i, history)["x"] for i in range(8)]
+    assert sum(x < 0.5 for x in xs) >= 6
+    assert all(0.0 <= x <= 1.0 for x in xs)
+
+
+def test_bayesian_deterministic_per_index():
+    spec = _tpe_spec()
+    history = _records([(0.1 * i, float(i)) for i in range(10)])
+    a = spec._sequential_assignment(42, history)
+    b = spec._sequential_assignment(42, history)
+    assert a == b
+
+
+def test_bayesian_maximize_flips_good_group():
+    spec = _tpe_spec(goal="maximize")
+    history = _records(
+        [(0.1, 0.0)] * 5 + [(0.9, 100.0)] * 5
+    )
+    xs = [spec._sequential_assignment(50 + i, history)["x"] for i in range(8)]
+    assert sum(x > 0.5 for x in xs) >= 6
+
+
+def test_tpe_categorical_prefers_good_values():
+    import random as _random
+
+    p = ParameterSpec("opt", "categorical", values=("sgd", "adam", "lamb"))
+    rng = _random.Random(3)
+    picks = [
+        p.tpe_sample(["adam"] * 6, ["sgd"] * 5 + ["lamb"] * 4, rng)
+        for _ in range(10)
+    ]
+    assert picks.count("adam") >= 8
+
+
+def test_tpe_log_scale_stays_in_range():
+    import random as _random
+
+    p = ParameterSpec("lr", "double", min=1e-5, max=1e-1, log_scale=True)
+    rng = _random.Random(0)
+    for _ in range(20):
+        v = p.tpe_sample([1e-4, 2e-4], [5e-2], rng)
+        assert 1e-5 <= v <= 1e-1
+
+
+def test_bayesian_spec_roundtrip_and_validation():
+    spec = _tpe_spec(gamma=0.3, startup_trials=7)
+    again = StudySpec.from_dict(spec.to_dict())
+    assert again.gamma == 0.3 and again.startup_trials == 7
+    with pytest.raises(ValueError, match="gamma"):
+        _tpe_spec(gamma=1.5).validate()
+
+
+# -- successive halving ----------------------------------------------------
+
+
+HALVING_TEMPLATE = {
+    "replicas": 1,
+    "image": "kubeflow-tpu/worker:test",
+    "args": ["--lr", "${trialParameters.lr}",
+             "--steps", "${trialParameters.budget}"],
+    "tpu": {"chipsPerWorker": 0},
+}
+
+
+def _halving_spec(**kw):
+    defaults = dict(
+        parameters=(ParameterSpec("lr", "double", min=0.0, max=1.0),),
+        algorithm="halving",
+        max_trials=9,
+        eta=3,
+        min_budget=1.0,
+        max_budget=9.0,
+        parallelism=9,
+        trial_template=HALVING_TEMPLATE,
+    )
+    defaults.update(kw)
+    return StudySpec(**defaults)
+
+
+def test_halving_rung_structure():
+    spec = _halving_spec()
+    assert spec.rungs() == [(0, 9, 1), (9, 3, 3), (12, 1, 9)]
+    assert spec.total_trials() == 13
+    # The top rung always runs at exactly max_budget (standard successive
+    # halving); earlier rungs at max_budget/eta^k.
+    capped = _halving_spec(max_budget=5.0)
+    assert [b for _, _, b in capped.rungs()] == [pytest.approx(5 / 3), 5]
+
+
+def test_halving_validation():
+    with pytest.raises(ValueError, match="eta"):
+        _halving_spec(eta=1).validate()
+    with pytest.raises(ValueError, match="collides"):
+        _halving_spec(
+            parameters=(ParameterSpec("budget", "double", min=0, max=1),)
+        ).validate()
+    with pytest.raises(ValueError, match="minBudget"):
+        _halving_spec(min_budget=0.0).validate()
+
+
+def test_halving_controller_promotes_best_configs():
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    spec = _halving_spec()
+    api.create(new_resource(KIND, "study1", "team", spec=spec.to_dict()))
+    ctl.controller.run_until_idle()
+
+    def trials():
+        return api.list(
+            "TpuJob", "team", label_selector={LABEL_STUDY: "study1"}
+        )
+
+    # Rung 0: nine random configs at budget 1, substituted into the args.
+    rung0 = trials()
+    assert len(rung0) == 9
+    assert all(t.spec["args"][3] == 1 for t in rung0)
+
+    # Finish rung 0 with loss == lr (read back from the annotation).
+    import json as _json
+
+    lr_of = {}
+    for t in rung0:
+        params = _json.loads(t.metadata.annotations[ANNOTATION_PARAMS])
+        lr_of[t.metadata.name] = params["lr"]
+        finish_trial(api, t.metadata.name, loss=params["lr"])
+    ctl.controller.run_until_idle()
+
+    # Rung 1: the three lowest-lr configs, rerun at budget 3.
+    rung1 = [t for t in trials() if t.metadata.name not in lr_of]
+    assert len(rung1) == 3
+    assert all(t.spec["args"][3] == 3 for t in rung1)
+    promoted = {
+        _json.loads(t.metadata.annotations[ANNOTATION_PARAMS])["lr"]
+        for t in rung1
+    }
+    assert promoted == set(sorted(lr_of.values())[:3])
+
+    for t in rung1:
+        params = _json.loads(t.metadata.annotations[ANNOTATION_PARAMS])
+        finish_trial(api, t.metadata.name, loss=params["lr"])
+    ctl.controller.run_until_idle()
+
+    # Rung 2: the single best config at the full budget.
+    rung2 = [
+        t for t in trials()
+        if int(t.metadata.labels[LABEL_TRIAL]) >= 12
+    ]
+    assert len(rung2) == 1
+    assert rung2[0].spec["args"][3] == 9
+    best_lr = min(lr_of.values())
+    assert _json.loads(
+        rung2[0].metadata.annotations[ANNOTATION_PARAMS]
+    )["lr"] == pytest.approx(best_lr)
+    finish_trial(api, rung2[0].metadata.name, loss=best_lr * 0.5)
+    ctl.controller.run_until_idle()
+
+    study = api.get(KIND, "study1", "team")
+    assert study.status["phase"] == "Succeeded"
+    assert study.status["bestTrial"]["objective"] == pytest.approx(
+        best_lr * 0.5
+    )
+
+
+def test_halving_deleted_trial_stays_spent():
+    """A deleted terminal trial must not be re-run or wedge the bracket:
+    its index stays spent and later rungs promote from what remains."""
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    spec = _halving_spec(max_trials=4, eta=2, min_budget=1.0, max_budget=2.0,
+                         parallelism=4)
+    api.create(new_resource(KIND, "s", "team", spec=spec.to_dict()))
+    ctl.controller.run_until_idle()
+    trials = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "s"})
+    assert len(trials) == 4
+    import json as _json
+
+    by_idx = {int(t.metadata.labels[LABEL_TRIAL]): t for t in trials}
+    # Finish 0, 2, 3; delete 1 (it was created, so its index is spent).
+    api.delete("TpuJob", by_idx[1].metadata.name, "team")
+    for idx in (0, 2, 3):
+        lr = _json.loads(
+            by_idx[idx].metadata.annotations[ANNOTATION_PARAMS]
+        )["lr"]
+        finish_trial(api, by_idx[idx].metadata.name, loss=lr)
+    ctl.controller.run_until_idle()
+    after = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "s"})
+    indices = {int(t.metadata.labels[LABEL_TRIAL]) for t in after}
+    assert 1 not in indices  # not re-created
+    promoted = indices - {0, 2, 3}
+    assert len(promoted) == 2 and all(i >= 4 for i in promoted)
+
+
+def test_deleted_highest_index_trial_not_rerun():
+    """Deleting the highest-index trial leaves nothing to witness the
+    deletion positionally; the controller-persisted maxTrialIndex
+    high-water mark keeps the index spent. A replacement trial gets a NEW
+    index — the deleted one is never re-run."""
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    make_study(api, algorithm="random", max_trials=3, parallelism=3)
+    ctl.controller.run_until_idle()
+    trials = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    by_idx = {int(t.metadata.labels[LABEL_TRIAL]): t for t in trials}
+    assert set(by_idx) == {0, 1, 2}
+    api.delete("TpuJob", by_idx[2].metadata.name, "team")
+    finish_trial(api, by_idx[0].metadata.name, loss=0.5)
+    finish_trial(api, by_idx[1].metadata.name, loss=0.4)
+    ctl.controller.run_until_idle()
+    after = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    indices = {int(t.metadata.labels[LABEL_TRIAL]) for t in after}
+    assert 2 not in indices          # spent, not re-created
+    assert 3 in indices              # replacement got a fresh index
+    # Halving flavor: rung 0 fully terminal, its last trial then deleted —
+    # the rung must settle via the high-water mark, not re-open.
+    spec = _halving_spec(max_trials=2, eta=2, min_budget=1.0, max_budget=2.0)
+    records = [
+        TrialRecord(index=0, state="Succeeded", assignment={"lr": 0.1},
+                    objective=0.1),
+    ]
+    new, done = spec.suggest(records, slots=4, floor=1)  # index 1 deleted
+    assert [idx for idx, _ in new] == [2]  # rung 1 opens; index 1 stays spent
+    assert [a["lr"] for _, a in new] == [0.1]
+
+
+def test_bayesian_malformed_annotation_does_not_crash():
+    """Trial annotations are client-writable through the HTTP facade; a
+    bogus parameter value must be ignored by TPE, not crash-loop the
+    reconcile."""
+    spec = _tpe_spec(
+        parameters=(
+            ParameterSpec("lr", "double", min=1e-4, max=1e-1, log_scale=True),
+            ParameterSpec("opt", "categorical", values=("sgd", "adam")),
+        ),
+        startup_trials=2,
+    )
+    history = _records([(0.0, 0.1)])  # x key absent for these params
+    poisoned = [
+        TrialRecord(index=i, state="Succeeded",
+                    assignment={"lr": bad, "opt": "nope"}, objective=0.1)
+        for i, bad in enumerate(["high", -1.0, float("nan"), 1e-3])
+    ]
+    a = spec._sequential_assignment(50, history + poisoned)
+    assert 1e-4 <= a["lr"] <= 1e-1
+    assert a["opt"] in ("sgd", "adam")
+
+
+def test_halving_narrow_rung_does_not_wedge():
+    """If fewer configs survive a rung than planned (trials Succeeded
+    without reporting the objective), later rungs must settle against the
+    rung's actual extent — not the planned width — or the study hangs in
+    Running forever."""
+    spec = _halving_spec(max_trials=9, eta=3, min_budget=1.0, max_budget=9.0)
+    # Rung 0: 9 trials, only two scored.
+    records = [
+        TrialRecord(index=i, state="Succeeded", assignment={"lr": 0.1 * i},
+                    objective=float(i) if i < 2 else None)
+        for i in range(9)
+    ]
+    new, done = spec.suggest(records, slots=9)
+    assert [idx for idx, _ in new] == [9, 10]  # narrow rung 1
+    records += [
+        TrialRecord(index=idx, state="Succeeded", assignment=a,
+                    objective=a["lr"])
+        for idx, a in new
+    ]
+    new, done = spec.suggest(records, slots=9)
+    assert [idx for idx, _ in new] == [12]  # rung 2 opens despite index 11 never existing
+    records += [
+        TrialRecord(index=idx, state="Succeeded", assignment=a,
+                    objective=a["lr"])
+        for idx, a in new
+    ]
+    new, done = spec.suggest(records, slots=9)
+    assert new == [] and done
+
+
+def test_halving_corrupt_promoted_annotation_not_promoted():
+    """A best-scoring trial whose stored assignment was wiped/corrupted
+    must be skipped at promotion — promoting {} would render an
+    unresolved-template crash-loop."""
+    spec = _halving_spec(max_trials=4, eta=2, min_budget=1.0, max_budget=2.0)
+    records = [
+        TrialRecord(index=0, state="Succeeded", assignment={}, objective=0.0),
+        TrialRecord(index=1, state="Succeeded", assignment={"lr": "high"},
+                    objective=0.1),
+        TrialRecord(index=2, state="Succeeded", assignment={"lr": 0.3},
+                    objective=0.2),
+        TrialRecord(index=3, state="Succeeded", assignment={"lr": 0.4},
+                    objective=0.3),
+    ]
+    new, done = spec.suggest(records, slots=4)
+    # Width-2 rung 1, but only the two usable assignments compete; the
+    # corrupt best-scorers are passed over.
+    assert [a["lr"] for _, a in new] == [0.3, 0.4]
+    assert not done
+
+
+def test_halving_parallelism_caps_rung_creation():
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    spec = _halving_spec(parallelism=4)
+    api.create(new_resource(KIND, "s", "team", spec=spec.to_dict()))
+    ctl.controller.run_until_idle()
+    trials = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "s"})
+    assert len(trials) == 4  # rung 0 fills as slots free up
+
+
+def test_bayesian_controller_end_to_end():
+    """Conformance-shaped run (katib_studyjob_test.py flow): poll to
+    Running, drive all trials, assert Completed with a sensible best."""
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    spec = StudySpec(
+        parameters=(ParameterSpec("lr", "double", min=0.0, max=1.0),),
+        algorithm="bayesian",
+        startup_trials=3,
+        max_trials=12,
+        parallelism=3,
+        trial_template=TEMPLATE
+        | {"env": [], "args": ["--lr", "${trialParameters.lr}"]},
+    )
+    api.create(new_resource(KIND, "bo", "team", spec=spec.to_dict()))
+    import json as _json
+
+    for _ in range(30):
+        ctl.controller.run_until_idle()
+        active = [
+            t
+            for t in api.list(
+                "TpuJob", "team", label_selector={LABEL_STUDY: "bo"}
+            )
+            if t.status.get("phase") not in ("Succeeded", "Failed")
+        ]
+        if not active:
+            break
+        for t in active:
+            lr = _json.loads(t.metadata.annotations[ANNOTATION_PARAMS])["lr"]
+            finish_trial(api, t.metadata.name, loss=(lr - 0.2) ** 2)
+    study = api.get(KIND, "bo", "team")
+    assert study.status["phase"] == "Succeeded"
+    assert len(study.status["trials"]) == 12
+    # TPE should have found something near the optimum at lr=0.2.
+    assert study.status["bestTrial"]["objective"] < 0.04
 
 
 # -- controller ------------------------------------------------------------

@@ -1,3 +1,4 @@
+"""Tensorboard controller: Deployment/Service from logspath variants."""
 import pytest
 
 from kubeflow_tpu.api import new_resource

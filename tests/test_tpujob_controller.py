@@ -1,3 +1,4 @@
+"""TpuJob operator: gang creation, placement, restarts, status."""
 import pytest
 
 from kubeflow_tpu.api import make_tpujob

@@ -1,3 +1,4 @@
+"""Transformer LM forward/backward, MoE aux loss, sharded step."""
 import jax
 import jax.numpy as jnp
 import numpy as np
