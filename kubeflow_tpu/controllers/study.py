@@ -157,9 +157,11 @@ class StudyController:
             if t.metadata.labels.get(LABEL_TRIAL, "").isdigit()
         }
 
-        # Harvest: every terminal trial contributes a status row; succeeded
-        # trials with an observation compete for best.
+        # Harvest: every terminal trial contributes a status row and a
+        # TrialRecord (the suggester's view); succeeded trials with an
+        # observation compete for best.
         rows = []
+        records = []
         best = None
         active = failed = succeeded = 0
         for idx in sorted(by_index):
@@ -174,6 +176,14 @@ class StudyController:
             value = observation.get(spec.objective_metric)
             if value is not None:
                 row["objective"] = value
+            records.append(
+                study_api.TrialRecord(
+                    index=idx,
+                    state=phase,
+                    assignment=_trial_assignment(trial),
+                    objective=_numeric(value),
+                )
+            )
             if phase == "Succeeded":
                 succeeded += 1
                 # NaN (diverged trial) must never win — every NaN
@@ -218,19 +228,6 @@ class StudyController:
                 reason="maxFailedTrials exceeded",
             )
 
-        records = [
-            study_api.TrialRecord(
-                index=idx,
-                state=t.status.get("phase", "Pending"),
-                assignment=_trial_assignment(t),
-                objective=_numeric(
-                    (t.status.get("observation") or {}).get(
-                        spec.objective_metric
-                    )
-                ),
-            )
-            for idx, t in by_index.items()
-        ]
         # High-water mark: indices at/below it are spent even if their
         # trial was deleted (deleted trials are never re-run).
         floor = max(

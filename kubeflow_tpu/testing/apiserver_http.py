@@ -6,11 +6,15 @@ HTTP (`openmpi-controller/controller/util.py` uses the kubernetes client;
 `FakeApiServer`; this module serves that store over REST so *separate
 processes* (sidecar CLI, e2e workers, probers) get the same boundary:
 
-    GET    /apis/<kind>                      ?namespace=&labelSelector=k=v
-    GET    /apis/<kind>/<ns>/<name>          ('_' namespace = cluster scope)
+    GET    /apis/<kind>                      ?namespace=&labelSelector=k=v&version=
+    GET    /apis/<kind>/<ns>/<name>          ('_' namespace = cluster scope; ?version=)
     POST   /apis/<kind>
     PUT    /apis/<kind>/<ns>/<name>[/status]
     DELETE /apis/<kind>/<ns>/<name>
+
+Multi-version kinds: POST/PUT bodies may carry any served apiVersion
+(storage normalizes to the hub version); GETs pass `?version=` to read at
+a specific served version.
 
 `HttpApiClient` mirrors the FakeApiServer method surface (get/list/create/
 update/update_status/delete) so controller-side code is client-agnostic.
@@ -28,6 +32,7 @@ from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
     Conflict,
     FakeApiServer,
+    Invalid,
     NotFound,
 )
 from kubeflow_tpu.web.wsgi import App, HttpError, Request, Response, json_response
@@ -72,7 +77,16 @@ class ApiServerApp(App):
             namespace=_seg_ns(namespace) if namespace is not None else None,
             label_selector=selector,
         )
+        items = [self._at_version(r, req) for r in items]
         return json_response({"items": [r.to_dict() for r in items]})
+
+    def _at_version(self, obj: Resource, req: Request) -> Resource:
+        version = req.query.get("version")
+        if not version:
+            return obj
+        # Invalid propagates: wsgi maps it to 422 and HttpApiClient maps
+        # 422 back to Invalid, so both clients surface the same error.
+        return self.api.convert_to(obj, version)
 
     def get(self, req: Request) -> Response:
         obj = self.api.get(
@@ -80,7 +94,7 @@ class ApiServerApp(App):
             req.path_params["name"],
             _seg_ns(req.path_params["ns"]),
         )
-        return json_response(obj.to_dict())
+        return json_response(self._at_version(obj, req).to_dict())
 
     def create(self, req: Request) -> Response:
         obj = Resource.from_dict(req.json())
@@ -146,11 +160,20 @@ class HttpApiClient:
                 if "already exists" in detail:
                     raise AlreadyExists(detail)
                 raise Conflict(detail)
+            if e.code == 422:
+                raise Invalid(detail)
             raise
 
-    def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+    def get(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "default",
+        version: str | None = None,
+    ) -> Resource:
+        query = f"?{urllib.parse.urlencode({'version': version})}" if version else ""
         return Resource.from_dict(
-            self._call("GET", f"/apis/{kind}/{_ns_seg(namespace)}/{name}")
+            self._call("GET", f"/apis/{kind}/{_ns_seg(namespace)}/{name}{query}")
         )
 
     def list(
@@ -158,8 +181,11 @@ class HttpApiClient:
         kind: str,
         namespace: str | None = None,
         label_selector: dict[str, str] | None = None,
+        version: str | None = None,
     ) -> list[Resource]:
         params = {}
+        if version:
+            params["version"] = version
         if namespace is not None:
             params["namespace"] = _ns_seg(namespace)
         if label_selector:

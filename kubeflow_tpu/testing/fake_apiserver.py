@@ -11,6 +11,10 @@ depends on (the reference leaned on envtest for exactly this,
 - finalizers: delete marks deletionTimestamp; removal happens when the
   last finalizer is cleared
 - owner references: cascading delete of dependents
+- multi-version kinds: writes at any served apiVersion are converted to
+  the kind's storage (hub) version before storing; readers may request a
+  served version (the reference's Notebook CRD carries three versions
+  plus conversion, `notebook-controller/api/*/notebook_types.go`)
 
 Thread-safe; watch delivery is synchronous (deterministic tests).
 """
@@ -20,6 +24,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable
 
+from kubeflow_tpu.api import versioning
 from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid, now
 
 WatchHandler = Callable[[str, Resource], None]  # (event_type, obj)
@@ -38,6 +43,10 @@ class AlreadyExists(ApiError):
 
 
 class Conflict(ApiError):
+    pass
+
+
+class Invalid(ApiError):
     pass
 
 
@@ -85,8 +94,24 @@ class FakeApiServer:
 
     # -- CRUD -------------------------------------------------------------
 
+    def _normalize_version(self, obj: Resource) -> Resource:
+        """Convert a write at any served version to storage form; an
+        unserved version of a registered kind is a client error."""
+        try:
+            return versioning.registry.normalize(obj)
+        except versioning.ConversionError as e:
+            raise Invalid(str(e)) from e
+
+    def convert_to(self, obj: Resource, version: str) -> Resource:
+        """Read-side conversion: a stored (hub-version) object rendered at
+        another served version."""
+        try:
+            return versioning.registry.convert(obj, version)
+        except versioning.ConversionError as e:
+            raise Invalid(str(e)) from e
+
     def create(self, obj: Resource) -> Resource:
-        obj = self._admit(obj)
+        obj = self._admit(self._normalize_version(obj))
         with self._lock:
             key = obj.key
             if key in self._objects:
@@ -171,7 +196,9 @@ class FakeApiServer:
         return out
 
     def update(self, obj: Resource) -> Resource:
-        return self._update(self._admit(obj), status_only=False)
+        return self._update(
+            self._admit(self._normalize_version(obj)), status_only=False
+        )
 
     def update_status(self, obj: Resource) -> Resource:
         return self._update(obj, status_only=True)
@@ -251,10 +278,10 @@ class FakeApiServer:
             current = self.get(obj.kind, obj.metadata.name, obj.metadata.namespace)
         except NotFound:
             return self.create(obj)
-        # Compare post-admission desired state against stored state —
-        # otherwise an apply() of pre-admission spec would strip injected
-        # fields on every pass and never no-op.
-        obj = self._admit(obj)
+        # Compare post-conversion, post-admission desired state against
+        # stored state — otherwise an apply() of a spoke-version or
+        # pre-admission spec would never no-op (or strip injected fields).
+        obj = self._admit(self._normalize_version(obj))
         if (
             current.spec == obj.spec
             and current.metadata.labels == obj.metadata.labels

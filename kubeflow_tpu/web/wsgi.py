@@ -222,6 +222,8 @@ class App:
             return error_response(409, str(e))
         except storage.Conflict as e:
             return error_response(409, str(e))
+        except storage.Invalid as e:
+            return error_response(422, str(e))
         except Exception as e:  # crud_backend's catch-all 500 handler
             log.error("%s: unhandled error: %s", self.name, e)
             log.debug("%s", traceback.format_exc())
