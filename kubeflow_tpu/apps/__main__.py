@@ -15,6 +15,7 @@ import logging
 import threading
 import time
 
+from kubeflow_tpu.api.objects import new_resource
 from kubeflow_tpu.api.rbac import make_cluster_role_binding, seed_cluster_roles
 from kubeflow_tpu.apps.dashboard import DashboardApp
 from kubeflow_tpu.apps.jupyter import JupyterApp
@@ -29,7 +30,7 @@ from kubeflow_tpu.controllers.study import StudyController
 from kubeflow_tpu.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controllers.tpujob import TpuJobController
 from kubeflow_tpu.controllers.workflow import WorkflowController
-from kubeflow_tpu.runtime import LocalPodRunner
+from kubeflow_tpu.runtime import LocalPodRunner, WorkloadMaterializer
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 from kubeflow_tpu.web.authn import HeaderAuthn
 from kubeflow_tpu.web.wsgi import serve
@@ -48,11 +49,41 @@ def main() -> None:
     parser.add_argument(
         "--admin", default=None, help="grant this user cluster-admin"
     )
+    parser.add_argument(
+        "--nodes",
+        type=int,
+        default=4,
+        help="demo TPU nodes to seed (0 disables); gives the dashboard "
+        "metrics table and the gang scheduler something to place on",
+    )
+    parser.add_argument(
+        "--node-pool",
+        default="v5e",
+        help="pool/topology string on the seeded nodes; TpuJobs asking a "
+        "topology place only onto nodes whose pool matches it, so keep "
+        "this in sync with the jobs you submit (quickstart uses v5e)",
+    )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     api = FakeApiServer()
     seed_cluster_roles(api)
+    for i in range(args.nodes):
+        # x spreads the nodes on the ICI ring so placement cost is
+        # non-degenerate (matches the scheduler-test fixtures).
+        node = new_resource(
+            "Node",
+            f"tpu-node-{i}",
+            "",
+            spec={"pool": args.node_pool, "chips": 4, "x": i, "y": 0},
+        )
+        node.status = {
+            "ready": True,
+            "cpuUtilization": 0.1,
+            "memoryUtilization": 0.2,
+            "tpuDutyCycle": 0.0,
+        }
+        api.create(node)
     if args.admin:
         api.create(make_cluster_role_binding("boot-admin", "kubeflow-admin", args.admin))
 
@@ -71,18 +102,25 @@ def main() -> None:
     manager.start()
 
     # Pod runtime: without one, TpuJob/Study/Workflow pods would sit
-    # Pending forever. Locally, pods run as subprocesses.
+    # Pending forever. Locally, pods run as subprocesses; server-shaped
+    # workloads (notebook StatefulSets, tensorboard Deployments) are
+    # materialized as already-Running pods so UIs reach "ready".
     runner = LocalPodRunner(api)
+    materializer = WorkloadMaterializer(api)
     runner_stop = threading.Event()
 
     def _run_pods():
         while not runner_stop.is_set():
+            # Separate recovery domains: a malformed Pod crashing one
+            # stepper must not starve the other.
             try:
                 runner.step()
             except Exception:
-                # One malformed Pod must not kill pod execution for the
-                # whole process.
                 logging.exception("pod runner step failed; continuing")
+            try:
+                materializer.step()
+            except Exception:
+                logging.exception("materializer step failed; continuing")
             runner_stop.wait(0.2)
 
     threading.Thread(target=_run_pods, name="pod-runner", daemon=True).start()

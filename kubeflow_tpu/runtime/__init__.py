@@ -1,1 +1,2 @@
 from kubeflow_tpu.runtime.local import LocalPodRunner
+from kubeflow_tpu.runtime.workloads import WorkloadMaterializer

@@ -1,0 +1,132 @@
+"""Workload materializer: StatefulSet/Deployment controller + kubelet
+stand-in for the local runtime.
+
+On a real cluster the built-in controllers and the kubelet turn a
+StatefulSet/Deployment into running pods and readiness status; the
+platform-in-a-box (`python -m kubeflow_tpu.apps`) has neither, so
+notebooks and tensorboards would sit "waiting" forever (the reference's
+equivalent gap is covered by a live GKE cluster in every E2E run —
+`testing/kf_is_ready_test.py`). This closes the loop locally:
+
+- each StatefulSet/Deployment gets `replicas` pods named `<name>-<i>`,
+  carrying the pod template's labels/spec and an ownerReference (so
+  cascade delete works), created directly in phase Running — the
+  LocalPodRunner only adopts pods with no phase, so materialized pods
+  are never exec'd as subprocesses;
+- scale-down (the notebook stop/cull path sets replicas 0) deletes the
+  excess pods;
+- `status.readyReplicas` / `status.replicas` are mirrored back onto the
+  workload, which is what the notebook/tensorboard controllers read to
+  report readiness.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+
+from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    Conflict,
+    FakeApiServer,
+    NotFound,
+)
+
+log = logging.getLogger(__name__)
+
+WORKLOAD_KINDS = ("StatefulSet", "Deployment")
+LABEL_WORKLOAD = "kubeflow-tpu.org/workload"
+# Disambiguates a StatefulSet and a Deployment sharing a name in one
+# namespace — without it they would adopt (and fight over) each other's
+# pods.
+LABEL_WORKLOAD_KIND = "kubeflow-tpu.org/workload-kind"
+
+
+class WorkloadMaterializer:
+    def __init__(self, api: FakeApiServer):
+        self.api = api
+
+    def step(self) -> None:
+        for kind in WORKLOAD_KINDS:
+            for workload in self.api.list(kind):
+                try:
+                    self._reconcile(workload)
+                except (Conflict, AlreadyExists, NotFound):
+                    pass  # raced with a controller; next step converges
+
+    def _pods_of(self, workload: Resource) -> dict[int, Resource]:
+        prefix = workload.metadata.name + "-"
+        out: dict[int, Resource] = {}
+        for pod in self.api.list("Pod", workload.metadata.namespace):
+            labels = pod.metadata.labels
+            if (
+                labels.get(LABEL_WORKLOAD) != workload.metadata.name
+                or labels.get(LABEL_WORKLOAD_KIND) != workload.kind
+            ):
+                continue
+            suffix = pod.metadata.name.removeprefix(prefix)
+            if suffix.isdigit():
+                out[int(suffix)] = pod
+        return out
+
+    def _reconcile(self, workload: Resource) -> None:
+        if workload.metadata.deletion_timestamp:
+            return
+        replicas = int(workload.spec.get("replicas", 1))
+        template = workload.spec.get("template") or {}
+        pods = self._pods_of(workload)
+
+        created = 0
+        for index in range(replicas):
+            if index in pods:
+                continue
+            labels = dict(
+                (template.get("metadata") or {}).get("labels") or {}
+            )
+            labels[LABEL_WORKLOAD] = workload.metadata.name
+            labels[LABEL_WORKLOAD_KIND] = workload.kind
+            pod = new_resource(
+                "Pod",
+                f"{workload.metadata.name}-{index}",
+                workload.metadata.namespace,
+                spec=copy.deepcopy(template.get("spec") or {}),
+                labels=labels,
+            )
+            pod.metadata.owner_references = [owner_ref(workload)]
+            # Born Running: these pods model long-running servers (jupyter,
+            # tensorboard); phase != None keeps LocalPodRunner from trying
+            # to exec the container image as a local subprocess.
+            pod.status["phase"] = "Running"
+            self.api.create(pod)
+            created += 1
+            log.info(
+                "materialized pod %s/%s", pod.metadata.namespace,
+                pod.metadata.name,
+            )
+
+        for index, pod in pods.items():
+            if index >= replicas:
+                try:
+                    self.api.delete(
+                        "Pod", pod.metadata.name, pod.metadata.namespace
+                    )
+                except NotFound:
+                    pass
+
+        # Count pods created this pass too, so a single step converges
+        # (no one-tick readyReplicas lag).
+        ready = created + sum(
+            1
+            for index, pod in pods.items()
+            if index < replicas and pod.status.get("phase") == "Running"
+        )
+        fresh = self.api.get(
+            workload.kind, workload.metadata.name, workload.metadata.namespace
+        )
+        desired_status = {"replicas": replicas, "readyReplicas": ready}
+        if {
+            k: fresh.status.get(k) for k in desired_status
+        } != desired_status:
+            fresh.status.update(desired_status)
+            self.api.update_status(fresh)
