@@ -55,8 +55,18 @@ class WorkloadMaterializer:
                 except (Conflict, AlreadyExists, NotFound):
                     pass  # raced with a controller; next step converges
 
+    @staticmethod
+    def _pod_prefix(workload: Resource) -> str:
+        """STS pods keep K8s's ordinal form `<name>-<i>`; Deployment pods
+        get a `-dp-` segment so a same-name STS and Deployment never
+        collide on pod names (on real K8s, Deployment pod names carry
+        replicaset hashes for the same reason)."""
+        if workload.kind == "Deployment":
+            return workload.metadata.name + "-dp-"
+        return workload.metadata.name + "-"
+
     def _pods_of(self, workload: Resource) -> dict[int, Resource]:
-        prefix = workload.metadata.name + "-"
+        prefix = self._pod_prefix(workload)
         out: dict[int, Resource] = {}
         for pod in self.api.list("Pod", workload.metadata.namespace):
             labels = pod.metadata.labels
@@ -88,7 +98,7 @@ class WorkloadMaterializer:
             labels[LABEL_WORKLOAD_KIND] = workload.kind
             pod = new_resource(
                 "Pod",
-                f"{workload.metadata.name}-{index}",
+                f"{self._pod_prefix(workload)}{index}",
                 workload.metadata.namespace,
                 spec=copy.deepcopy(template.get("spec") or {}),
                 labels=labels,

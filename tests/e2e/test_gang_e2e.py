@@ -57,3 +57,56 @@ def test_tpujob_gang_end_to_end(tmp_path):
     assert api.get(KIND, "e2e").status.get("phase") == "Succeeded", logs
     assert "psum ok" in logs.get("e2e-worker-0.log", ""), logs
     assert "psum ok" in logs.get("e2e-worker-1.log", ""), logs
+
+
+def test_distributed_training_end_to_end(tmp_path):
+    """TpuJob gang of 2 real processes trains a tiny ResNet over a dp
+    mesh (gloo collectives), and rank 0's reported observation flows back
+    onto the job — training results, not just liveness, cross the
+    process boundary."""
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+    from kubeflow_tpu.web.wsgi import serve
+
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    ctl = TpuJobController(api)
+    runner = LocalPodRunner(
+        api,
+        extra_env={
+            "KFTPU_REPO": REPO,
+            "KFTPU_APISERVER": f"http://127.0.0.1:{server.server_port}",
+        },
+        capture_dir=str(tmp_path / "logs"),
+    )
+    api.create(
+        make_tpujob(
+            "train",
+            replicas=2,
+            tpu_chips_per_worker=0,
+            command=(
+                sys.executable,
+                os.path.join(REPO, "tests", "e2e", "train_worker.py"),
+            ),
+        )
+    )
+    deadline = time.time() + 240
+    try:
+        while time.time() < deadline:
+            ctl.controller.run_until_idle()
+            runner.step()
+            phase = api.get(KIND, "train").status.get("phase")
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+    finally:
+        runner.shutdown()
+        server.shutdown()
+
+    logs = {
+        p.name: p.read_text() for p in (tmp_path / "logs").glob("*.log")
+    }
+    job = api.get(KIND, "train")
+    assert job.status.get("phase") == "Succeeded", logs
+    observation = job.status.get("observation") or {}
+    assert observation.get("loss") is not None, (job.status, logs)
+    assert observation["loss"] < observation["first_loss"], observation

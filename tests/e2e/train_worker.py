@@ -1,0 +1,85 @@
+"""Worker for the distributed-training E2E: joins the gang via the
+TPUJOB_* contract, then runs REAL sharded training steps (tiny ResNet,
+SGD) over a dp mesh spanning the gang's processes — the multi-process
+fixture the reference never had (SURVEY.md §4.3: distributed behavior was
+only ever tested against a live GKE cluster).
+
+Every process executes the same SPMD program; gradients psum over dp via
+gloo. Rank 0 reports the final loss as the job observation, so the
+controller-side test can assert on training results end-to-end.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# One local device per process: the gang, not XLA's virtual-device flag,
+# provides the parallelism here.
+os.environ["XLA_FLAGS"] = ""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+import jax.numpy as jnp  # noqa: E402
+
+from kubeflow_tpu.launcher.launcher import report_observation  # noqa: E402
+from kubeflow_tpu.models.resnet import tiny_resnet  # noqa: E402
+from kubeflow_tpu.parallel import (  # noqa: E402
+    MeshSpec,
+    build_mesh,
+    initialize_from_env,
+)
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+from kubeflow_tpu.train import SyntheticImages, TrainConfig, Trainer  # noqa: E402
+
+
+def main() -> int:
+    pe = initialize_from_env()
+    assert jax.process_count() == pe.num_processes
+    mesh = build_mesh(MeshSpec(dp=-1))
+
+    config = TrainConfig(
+        batch_size=4 * pe.num_processes,
+        learning_rate=0.05,
+        warmup_steps=1,
+        total_steps=6,
+        fsdp_params=False,
+    )
+    trainer = Trainer(
+        tiny_resnet(),
+        config,
+        mesh,
+        example_input_shape=(2, 32, 32, 3),
+    )
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    data = SyntheticImages(
+        mesh, config.batch_size, image_size=32, num_classes=10
+    )
+    step = trainer.make_train_step()
+    losses = []
+    for batch in data:
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+        if len(losses) >= config.total_steps:
+            break
+
+    assert all(jnp.isfinite(jnp.asarray(losses))), losses
+    assert losses[-1] < losses[0], losses  # it actually learned
+    print(f"rank {pe.process_id}: losses {losses[0]:.4f} -> {losses[-1]:.4f}",
+          flush=True)
+
+    if pe.process_id == 0 and os.environ.get("KFTPU_APISERVER"):
+        report_observation(
+            HttpApiClient(os.environ["KFTPU_APISERVER"]),
+            os.environ["TPUJOB_NAME"],
+            os.environ["TPUJOB_NAMESPACE"],
+            {"loss": losses[-1], "first_loss": losses[0]},
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

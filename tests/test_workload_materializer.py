@@ -78,12 +78,13 @@ def test_notebook_reaches_ready_through_materializer():
 
 
 def test_same_name_sts_and_deployment_do_not_fight():
-    """A StatefulSet and Deployment sharing a name in one namespace must
-    each own their own pods (kind label disambiguates) — otherwise a
-    stopped STS and a live Deployment would churn create/delete forever."""
+    """A StatefulSet and Deployment sharing a name in one namespace (a
+    Notebook 'demo' plus a Tensorboard 'demo' in one profile) must each
+    own distinctly-named pods and both report ready — neither churn nor a
+    swallowed AlreadyExists hot-loop."""
     api = FakeApiServer()
     m = WorkloadMaterializer(api)
-    make_sts(api, name="demo", replicas=0)
+    make_sts(api, name="demo", replicas=1)
     api.create(
         new_resource(
             "Deployment",
@@ -100,11 +101,16 @@ def test_same_name_sts_and_deployment_do_not_fight():
     )
     for _ in range(3):
         m.step()
-    pods = api.list("Pod", "team")
-    assert len(pods) == 1
-    assert pods[0].metadata.labels["kubeflow-tpu.org/workload-kind"] == "Deployment"
+    pods = {p.metadata.name for p in api.list("Pod", "team")}
+    assert pods == {"demo-0", "demo-dp-0"}
     assert api.get("Deployment", "demo", "team").status["readyReplicas"] == 1
-    assert api.get("StatefulSet", "demo", "team").status["readyReplicas"] == 0
+    assert api.get("StatefulSet", "demo", "team").status["readyReplicas"] == 1
+    # Stop the notebook: only the STS pod goes away.
+    sts = api.get("StatefulSet", "demo", "team")
+    sts.spec["replicas"] = 0
+    api.update(sts)
+    m.step()
+    assert {p.metadata.name for p in api.list("Pod", "team")} == {"demo-dp-0"}
 
 
 def test_deployment_supported():
