@@ -79,17 +79,36 @@ def test_bf16_inputs():
 
 
 def test_indivisible_seq_raises():
-    # Blocks clamp to the sequence length, so indivisibility only bites
-    # when seq > block and seq % block != 0.
-    q, k, v = _qkv(jax.random.PRNGKey(4), 1, 320, 1, 16)
-    assert not flash_usable(320, 320)
-    with pytest.raises(ValueError, match="multiple of the block size"):
+    # Blocks clamp to the sequence and degrade to an aligned divisor;
+    # only a sequence with NO 8-aligned divisor <= the block is unusable
+    # (1025 = 5^2 * 41: every divisor is odd).
+    q, k, v = _qkv(jax.random.PRNGKey(4), 1, 1025, 1, 16)
+    assert not flash_usable(1025, 1025)
+    with pytest.raises(ValueError, match="divides"):
         flash_attention(q, k, v, interpret=True)
 
 
 def test_usable_predicate():
     assert flash_usable(256, 256)
     assert flash_usable(4096, 4096)
-    assert flash_usable(64, 64)  # block clamps to seq
-    assert flash_usable(100, 100)  # ditto — single full-seq block
-    assert not flash_usable(320, 256)
+    assert flash_usable(64, 64)  # block clamps to seq (8-aligned)
+    assert flash_usable(320, 256)  # clamps to one 320-row block
+    assert flash_usable(1664, 1664)  # degrades to the 128-divisor
+    assert flash_usable(1344, 1344)  # degrades to the sublane divisor 672
+    # Mosaic needs 8-row sublane alignment: a sequence with no 8-aligned
+    # divisor must route to dense, never produce an unlowerable kernel.
+    assert not flash_usable(100, 100)
+    assert not flash_usable(321, 321)
+    assert not flash_usable(1025, 1025)
+
+
+def test_block_fallback_matches_dense():
+    """A sequence the default block doesn't divide (1664 = 13 * 128)
+    degrades to a dividing block and still matches dense numerics."""
+    q, k, v = _qkv(jax.random.PRNGKey(5), 1, 1664, 1, 16)
+    out = flash_attention(q, k, v, causal=True, block_q=1024, block_k=1024,
+                          interpret=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        out.astype(np.float32), ref.astype(np.float32), atol=2e-2, rtol=2e-2
+    )
