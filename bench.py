@@ -7,6 +7,14 @@ synthetic data (`README.md:19`). The reference published no numbers
 images/sec/chip. We use 2000 images/sec/chip as that per-chip proxy on
 v5e — `vs_baseline` is measured/2000, so 0.9 is the north-star line.
 
+Roofline (measured on 1 x v5e, bs=256/chip, bf16/NHWC): ~2500 img/s/chip
+= 60 TFLOP/s at ~767 GB/s of HBM traffic per XLA's cost analysis — i.e.
+~94% of the chip's ~819 GB/s HBM bandwidth but only ~30% MXU. ResNet-50
+training at 224px is HBM-BANDWIDTH-bound on this chip: batch 512/1024
+are slower (spill pressure), and an MXU-friendlier stem (space-to-depth)
+measures flat because the stem wasn't the bottleneck. Further gains need
+activation-traffic reduction, not more FLOPs.
+
 Prints exactly one JSON line:
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 """
