@@ -44,12 +44,16 @@ def set_bundle_images(
     return resources
 
 
+def _dump(resources) -> str:
+    return yaml.safe_dump_all(
+        [r.to_dict() for r in resources], sort_keys=True
+    )
+
+
 def render_bundle_yaml(
     name: str, spec: PlatformSpec | None = None
 ) -> str:
-    spec = spec or default_spec()
-    docs = [r.to_dict() for r in BUNDLES[name](spec)]
-    return yaml.safe_dump_all(docs, sort_keys=True)
+    return _dump(BUNDLES[name](spec or default_spec()))
 
 
 def regenerate_manifests(
@@ -85,6 +89,23 @@ def manifest_drift(dir_: pathlib.Path | None = None) -> list[str]:
     return drifted
 
 
+def render_overlaid_yaml(
+    name: str,
+    overlay_paths: list[str],
+    spec: PlatformSpec | None = None,
+) -> str:
+    """One bundle rendered through a chain of overlay files — the
+    `kustomize build <overlay-dir>` analog."""
+    from kubeflow_tpu.deploy.overlays import Overlay, apply_overlays
+
+    return _dump(
+        apply_overlays(
+            BUNDLES[name](spec or default_spec()),
+            [Overlay.load(p) for p in overlay_paths],
+        )
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -92,7 +113,19 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="cmd", required=True)
     sub.add_parser("regenerate", help="rewrite manifests/ from bundles")
     sub.add_parser("check", help="exit 1 if manifests/ drifted")
+    render = sub.add_parser(
+        "render", help="print one bundle's YAML, optionally overlaid"
+    )
+    render.add_argument("bundle", choices=sorted(BUNDLES))
+    render.add_argument(
+        "--overlay", action="append", default=[],
+        help="overlay YAML file (repeatable, applied in order)",
+    )
     args = parser.parse_args(argv)
+
+    if args.cmd == "render":
+        print(render_overlaid_yaml(args.bundle, args.overlay), end="")
+        return 0
 
     if args.cmd == "regenerate":
         for path in regenerate_manifests():

@@ -70,10 +70,16 @@ def _crd(kind: str, plural: str, *, cluster_scoped: bool = False) -> Resource:
 
 
 def _vs(
-    name: str, prefix: str, port: int, *, rewrite: str | None = "/"
+    name: str,
+    prefix: str,
+    port: int,
+    *,
+    rewrite: str | None = "/",
+    service: str | None = None,
 ) -> Resource:
     """rewrite=None keeps the matched prefix (for backends whose routes
-    include it, e.g. the model server's /v1/models/...).
+    include it, e.g. the model server's /v1/models/...). `service` names
+    the backing Service when it differs from the VirtualService's name.
 
     A prefix with no trailing slash gets the segment-safe pair of
     matches (exact "/p" + prefix "/p/") — a bare string prefix would
@@ -101,7 +107,7 @@ def _vs(
                     "route": [
                         {
                             "destination": {
-                                "host": f"{name}.{KUBEFLOW_NS}.svc",
+                                "host": f"{service or name}.{KUBEFLOW_NS}.svc",
                                 "port": {"number": port},
                             }
                         }
@@ -259,7 +265,8 @@ def jupyter_web_app_bundle(spec: PlatformSpec) -> list[Resource]:
             port=5000,
         ),
         _service("jupyter-web-app-service", 80, 5000),
-        _vs("jupyter-web-app", "/jupyter/", 80),
+        _vs("jupyter-web-app", "/jupyter/", 80,
+            service="jupyter-web-app-service"),
         new_resource(
             "ConfigMap",
             "jupyter-web-app-config",
@@ -277,7 +284,8 @@ def tensorboards_web_app_bundle(spec: PlatformSpec) -> list[Resource]:
             port=5000,
         ),
         _service("tensorboards-web-app-service", 80, 5000),
-        _vs("tensorboards-web-app", "/tensorboards/", 80),
+        _vs("tensorboards-web-app", "/tensorboards/", 80,
+            service="tensorboards-web-app-service"),
     ]
 
 
@@ -354,4 +362,10 @@ def bundle_resources(
     for name, fn in BUNDLES.items():
         if name in wanted:
             out.extend(fn(spec))
+    if spec.overlays:
+        from kubeflow_tpu.deploy.overlays import Overlay, apply_overlays
+
+        out = apply_overlays(
+            out, [Overlay.from_dict(o) for o in spec.overlays]
+        )
     return out

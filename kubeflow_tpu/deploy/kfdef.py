@@ -81,6 +81,10 @@ class PlatformSpec:
     node_pools: list[NodePool] = dataclasses.field(default_factory=list)
     applications: list[str] = dataclasses.field(default_factory=list)
     email: str | None = None  # platform admin (IAM seed)
+    # Kustomize-style overlays (deploy.overlays.Overlay dicts), applied in
+    # order to every bundle resource by the K8S phase — the reference's
+    # per-component config/overlays, carried on the KfDef itself.
+    overlays: list[dict] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -93,6 +97,7 @@ class PlatformSpec:
                 "email": self.email,
                 "nodePools": [p.to_dict() for p in self.node_pools],
                 "applications": list(self.applications),
+                "overlays": [dict(o) for o in self.overlays],
             },
         }
 
@@ -108,6 +113,7 @@ class PlatformSpec:
                 NodePool.from_dict(p) for p in spec.get("nodePools", [])
             ],
             applications=list(spec.get("applications", [])),
+            overlays=[dict(o) for o in spec.get("overlays", [])],
         )
 
     @classmethod

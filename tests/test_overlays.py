@@ -1,0 +1,238 @@
+"""Overlay engine — the kustomize-overlay analog over generated bundles
+(the reference's per-component `config/{default,overlays}` kustomize
+tree, applied by kfctl's K8S phase)."""
+
+import pathlib
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.ci.application_util import render_overlaid_yaml
+from kubeflow_tpu.deploy.bundles import bundle_resources
+from kubeflow_tpu.deploy.kfdef import default_spec
+from kubeflow_tpu.deploy.overlays import (
+    ImageRule,
+    Overlay,
+    Patch,
+    apply_overlay,
+    strategic_merge,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# -- strategic merge -------------------------------------------------------
+
+
+def test_merge_dicts_recursively():
+    out = strategic_merge(
+        {"a": {"x": 1, "y": 2}, "b": 3}, {"a": {"y": 9, "z": 8}}
+    )
+    assert out == {"a": {"x": 1, "y": 9, "z": 8}, "b": 3}
+
+
+def test_merge_null_deletes():
+    assert strategic_merge({"a": 1, "b": 2}, {"a": None}) == {"b": 2}
+
+
+def test_merge_named_lists_by_name():
+    base = [{"name": "c1", "image": "a"}, {"name": "c2", "image": "b"}]
+    patch = [{"name": "c2", "image": "B"}, {"name": "c3", "image": "c"}]
+    out = strategic_merge(base, patch)
+    assert out == [
+        {"name": "c1", "image": "a"},
+        {"name": "c2", "image": "B"},
+        {"name": "c3", "image": "c"},
+    ]
+
+
+def test_merge_plain_lists_replace():
+    assert strategic_merge({"l": [1, 2]}, {"l": [3]}) == {"l": [3]}
+
+
+# -- overlay application ---------------------------------------------------
+
+
+def _deploy(name="web", image="repo/app:v1"):
+    return new_resource(
+        "Deployment",
+        name,
+        "kubeflow",
+        spec={
+            "replicas": 1,
+            "template": {
+                "spec": {"containers": [{"name": name, "image": image}]}
+            },
+        },
+    )
+
+
+def test_prefix_namespace_labels_and_cluster_scope():
+    overlay = Overlay(
+        name_prefix="dev-", namespace="kubeflow-dev",
+        common_labels={"env": "dev"},
+    )
+    ns_scoped = _deploy()
+    cluster = new_resource("ClusterRole", "admin", "")
+    out = apply_overlay([ns_scoped, cluster], overlay)
+    assert out[0].metadata.name == "dev-web"
+    assert out[0].metadata.namespace == "kubeflow-dev"
+    assert out[0].metadata.labels["env"] == "dev"
+    assert out[1].metadata.namespace == ""  # cluster scope preserved
+    # Inputs untouched.
+    assert ns_scoped.metadata.name == "web"
+
+
+def test_image_rules_rewrite_everywhere():
+    overlay = Overlay(
+        images=(ImageRule("repo/app", new_tag="v2"),
+                ImageRule("repo/other", new_name="mirror/other")),
+    )
+    out = apply_overlay(
+        [_deploy(), _deploy("other", "repo/other:v1")], overlay
+    )
+    assert (
+        out[0].spec["template"]["spec"]["containers"][0]["image"]
+        == "repo/app:v2"
+    )
+    assert (
+        out[1].spec["template"]["spec"]["containers"][0]["image"]
+        == "mirror/other:v1"
+    )
+
+
+def test_patch_targets_original_name_before_prefix():
+    overlay = Overlay(
+        name_prefix="dev-",
+        patches=(Patch(target_kind="Deployment", target_name="web",
+                       patch={"spec": {"replicas": 5}}),),
+    )
+    out = apply_overlay([_deploy()], overlay)
+    assert out[0].metadata.name == "dev-web"
+    assert out[0].spec["replicas"] == 5
+
+
+def test_patch_glob_and_kind_filter():
+    overlay = Overlay(
+        patches=(Patch(target_kind="Deployment", target_name="*web*",
+                       patch={"spec": {"replicas": 3}}),),
+    )
+    deploy, svc = _deploy(), new_resource("Service", "web", "kubeflow",
+                                          spec={"ports": []})
+    out = apply_overlay([deploy, svc], overlay)
+    assert out[0].spec["replicas"] == 3
+    assert "replicas" not in out[1].spec
+
+
+def test_common_labels_reach_pod_template_and_selector():
+    overlay = Overlay(common_labels={"env": "dev"})
+    out = apply_overlay([_deploy()], overlay)
+    assert out[0].metadata.labels["env"] == "dev"
+    assert out[0].spec["template"]["metadata"]["labels"]["env"] == "dev"
+    assert out[0].spec["selector"]["matchLabels"]["env"] == "dev"
+
+
+def test_namespace_transformer_renames_namespace_resource():
+    overlay = Overlay(name_prefix="dev-", namespace="kubeflow-dev")
+    ns = new_resource("Namespace", "kubeflow", "")
+    out = apply_overlay([ns, _deploy()], overlay)
+    # The Namespace resource becomes the target namespace, unprefixed —
+    # so the namespace every workload moved into actually exists.
+    assert out[0].metadata.name == "kubeflow-dev"
+    assert out[1].metadata.namespace == "kubeflow-dev"
+
+
+def test_rename_fixes_virtualservice_references():
+    overlay = Overlay(name_prefix="dev-", namespace="kubeflow-dev")
+    svc = new_resource("Service", "dash", "kubeflow", spec={"ports": []})
+    vs = new_resource(
+        "VirtualService",
+        "dash",
+        "kubeflow",
+        spec={
+            "gateways": ["kubeflow/kubeflow-gateway"],
+            "http": [{"route": [{"destination": {
+                "host": "dash.kubeflow.svc.cluster.local"}}]}],
+        },
+    )
+    gw = new_resource("Gateway", "kubeflow-gateway", "kubeflow", spec={})
+    out = apply_overlay([svc, vs, gw], overlay)
+    vs2 = out[1]
+    assert vs2.spec["http"][0]["route"][0]["destination"]["host"] == (
+        "dev-dash.kubeflow-dev.svc.cluster.local"
+    )
+    assert vs2.spec["gateways"] == ["kubeflow-dev/dev-kubeflow-gateway"]
+
+
+def test_images_pin_patch_introduced_containers():
+    """kustomize transformer order: images run AFTER patches, so a
+    container a patch adds is still tag-pinned."""
+    overlay = Overlay(
+        images=(ImageRule("repo/app", new_tag="v2"),),
+        patches=(Patch(target_kind="Deployment", patch={"spec": {
+            "template": {"spec": {"containers": [
+                {"name": "sidecar", "image": "repo/app:latest"}]}}}}),),
+    )
+    out = apply_overlay([_deploy()], overlay)
+    images = {
+        c["name"]: c["image"]
+        for c in out[0].spec["template"]["spec"]["containers"]
+    }
+    assert images == {"web": "repo/app:v2", "sidecar": "repo/app:v2"}
+
+
+def test_image_rule_port_and_digest():
+    rule = ImageRule("localhost:5000/app", new_tag="v2")
+    assert rule.rewrite("localhost:5000/app:v1") == "localhost:5000/app:v2"
+    assert rule.rewrite("localhost:5000/other:v1") == "localhost:5000/other:v1"
+    digest = ImageRule("repo/app", new_tag="v3")
+    assert digest.rewrite("repo/app@sha256:abc") == "repo/app:v3"
+    keep = ImageRule("repo/app", new_name="mirror/app")
+    assert keep.rewrite("repo/app@sha256:abc") == "mirror/app@sha256:abc"
+
+
+def test_unknown_overlay_key_raises():
+    with pytest.raises(ValueError, match="unknown overlay keys"):
+        Overlay.from_dict({"commonLabel": {"env": "dev"}})
+
+
+# -- integration: PlatformSpec + shipped overlays --------------------------
+
+
+def test_platformspec_overlays_flow_through_bundles():
+    spec = default_spec()
+    spec.overlays = [
+        {"namePrefix": "dev-", "commonLabels": {"env": "dev"}}
+    ]
+    resources = bundle_resources(spec)
+    assert resources, "bundles rendered"
+    assert all(r.metadata.name.startswith("dev-") for r in resources)
+    assert all(r.metadata.labels.get("env") == "dev" for r in resources)
+    # Round-trips through YAML (the KfDef surface).
+    from kubeflow_tpu.deploy.kfdef import PlatformSpec
+
+    again = PlatformSpec.from_yaml(spec.to_yaml())
+    assert again.overlays == spec.overlays
+
+
+def test_shipped_dev_overlay_renders():
+    out = render_overlaid_yaml(
+        "centraldashboard", [str(REPO / "manifests/overlays/dev.yaml")]
+    )
+    assert "dev-centraldashboard" in out
+    assert "kubeflow-dev" in out
+    assert "LOG_LEVEL" in out
+
+
+def test_shipped_prod_overlay_renders():
+    out = render_overlaid_yaml(
+        "jupyter-web-app", [str(REPO / "manifests/overlays/prod.yaml")]
+    )
+    assert ":v1.0.0" in out
+
+
+def test_overlay_load_rejects_non_mapping(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("- just\n- a list\n")
+    with pytest.raises(ValueError, match="mapping"):
+        Overlay.load(bad)
