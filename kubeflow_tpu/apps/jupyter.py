@@ -13,7 +13,14 @@ Parity with `components/jupyter-web-app/backend/` and
   `kubeflow-resource-stopped` annotation (`patch.py`);
 - DELETE `.../notebooks/<name>`;
 - GET  `/api/namespaces/<ns>/pvcs`, `/api/namespaces/<ns>/poddefaults`,
-  `/api/storageclasses` — form data sources (`common/api.py:81-197`).
+  `/api/storageclasses` — form data sources (`common/api.py:81-197`);
+- GET/POST `/api/namespaces/<ns>/snapshots` (+ DELETE by name) and the
+  `Snapshot` workspace-volume type — the snapshot-restore flow the
+  reference shipped as the jupyter app's "rok" variant
+  (`jupyter-web-app/backend/kubeflow_jupyter/rok/`,
+  `crud-web-apps/jupyter/backend/apps/rok/routes/post.py`): snapshot a
+  notebook's workspace PVC, then spawn a new notebook whose workspace
+  restores from it (PVC `dataSource` → VolumeSnapshot).
 
 Every handler is SAR-guarded per (verb, resource, namespace) exactly like
 `common/auth.py:41-106`.
@@ -28,7 +35,11 @@ import yaml
 
 from kubeflow_tpu.api.objects import new_resource
 from kubeflow_tpu.controllers.notebook import STOP_ANNOTATION
-from kubeflow_tpu.testing.fake_apiserver import AlreadyExists, FakeApiServer
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    FakeApiServer,
+    NotFound,
+)
 from kubeflow_tpu.web import (
     App,
     HeaderAuthn,
@@ -84,6 +95,15 @@ class JupyterApp(App):
             "/api/namespaces/<ns>/poddefaults", self.list_poddefaults
         )
         self.add_route("/api/storageclasses", self.list_storageclasses)
+        self.add_route("/api/namespaces/<ns>/snapshots", self.list_snapshots)
+        self.add_route(
+            "/api/namespaces/<ns>/snapshots", self.post_snapshot, ("POST",)
+        )
+        self.add_route(
+            "/api/namespaces/<ns>/snapshots/<name>",
+            self.delete_snapshot,
+            ("DELETE",),
+        )
 
     # -- reads -------------------------------------------------------------
 
@@ -249,7 +269,8 @@ class JupyterApp(App):
             vol_name = str(vol.get("name", "")).replace("{name}", name)
             if not vol_name:
                 continue
-            if vol.get("type", "New") == "New":
+            vol_type = vol.get("type", "New")
+            if vol_type in ("New", "Snapshot"):
                 pvc = new_resource(
                     "PersistentVolumeClaim",
                     vol_name,
@@ -261,11 +282,46 @@ class JupyterApp(App):
                         },
                     },
                 )
+                if vol_type == "Snapshot":
+                    # Restore-from-snapshot (the rok flow): the PVC's
+                    # dataSource points at a ready VolumeSnapshot; size
+                    # defaults to the snapshot's restoreSize.
+                    snap_name = vol.get("snapshot")
+                    if not snap_name:
+                        raise HttpError(
+                            400, "Snapshot volume needs a 'snapshot' name"
+                        )
+                    try:
+                        snap = self.api.get("VolumeSnapshot", snap_name, ns)
+                    except NotFound:
+                        raise HttpError(
+                            400, f"snapshot {snap_name!r} not found"
+                        ) from None
+                    if not snap.status.get("readyToUse"):
+                        raise HttpError(
+                            400, f"snapshot {snap_name!r} is not ready"
+                        )
+                    pvc.spec["dataSource"] = {
+                        "kind": "VolumeSnapshot",
+                        "name": snap_name,
+                    }
+                    restore = snap.status.get("restoreSize")
+                    if restore and not vol.get("size"):
+                        pvc.spec["resources"]["requests"]["storage"] = restore
                 if body.get("storageClass"):
                     pvc.spec["storageClassName"] = body["storageClass"]
                 try:
                     self.api.create(pvc)
                 except AlreadyExists:
+                    if vol_type == "Snapshot":
+                        # Reusing an existing PVC would silently skip the
+                        # restore — the notebook would mount old data
+                        # while the form promised snapshot contents.
+                        raise HttpError(
+                            409,
+                            f"pvc {vol_name!r} already exists; a Snapshot "
+                            "volume needs a fresh claim name",
+                        ) from None
                     # Existing PVC with the same name: reuse it (the
                     # reference 409s inside a loop and carries on). Any
                     # other failure must surface, not leave the notebook
@@ -312,6 +368,59 @@ class JupyterApp(App):
             labels[str(conf)] = "true"
         if labels:
             spec["podLabels"] = labels
+
+    # -- snapshots (the rok-variant analog) --------------------------------
+
+    def list_snapshots(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "list", "volumesnapshots", ns)
+        snapshots = [
+            {
+                "name": s.metadata.name,
+                "source": s.spec.get("source"),
+                "ready": bool(s.status.get("readyToUse")),
+                "restoreSize": s.status.get("restoreSize"),
+                "created": s.metadata.creation_timestamp,
+            }
+            for s in self.api.list("VolumeSnapshot", ns)
+        ]
+        return success_response("snapshots", snapshots)
+
+    def post_snapshot(self, req: Request) -> Response:
+        ns = req.path_params["ns"]
+        ensure_authorized(self.api, req.user, "create", "volumesnapshots", ns)
+        body = req.json()
+        source = body.get("pvc")
+        if not source:
+            raise HttpError(400, "body needs {'pvc': <claim name>}")
+        try:
+            pvc = self.api.get("PersistentVolumeClaim", source, ns)
+        except NotFound:
+            raise HttpError(404, f"pvc {source!r} not found") from None
+        name = body.get("name") or f"{source}-{int(time.time())}"
+        snapshot = new_resource(
+            "VolumeSnapshot",
+            name,
+            ns,
+            spec={"source": source},
+        )
+        # Local stand-in for the CSI snapshotter: ready immediately, the
+        # restore size mirrors the source claim. On a real cluster the
+        # external-snapshotter fills status asynchronously.
+        snapshot.status = {
+            "readyToUse": True,
+            "restoreSize": pvc.spec.get("resources", {})
+            .get("requests", {})
+            .get("storage"),
+        }
+        self.api.create(snapshot)
+        return success_response("snapshot", snapshot.to_dict())
+
+    def delete_snapshot(self, req: Request) -> Response:
+        ns, name = req.path_params["ns"], req.path_params["name"]
+        ensure_authorized(self.api, req.user, "delete", "volumesnapshots", ns)
+        self.api.delete("VolumeSnapshot", name, ns)
+        return success_response()
 
     # -- mutate/delete -----------------------------------------------------
 

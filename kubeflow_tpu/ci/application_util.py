@@ -26,11 +26,14 @@ def set_bundle_images(
 ) -> list:
     """Rewrite container image refs (`repo` or `repo:tag` keys in
     `image_map` → new ref) across rendered resources, in place."""
+    from kubeflow_tpu.deploy.overlays import split_image
 
     def rewrite(ref: str) -> str:
         if ref in image_map:
             return image_map[ref]
-        repo = ref.partition(":")[0]
+        # Registry-port/digest-aware repo extraction (shared with the
+        # overlay engine's ImageRule).
+        repo = split_image(ref)[0]
         return image_map.get(repo, ref)
 
     for res in resources:

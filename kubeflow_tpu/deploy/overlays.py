@@ -81,7 +81,7 @@ class ImageRule:
     new_tag: str | None = None
 
     def rewrite(self, ref: str) -> str:
-        repo, sep, tail = _split_image(ref)
+        repo, sep, tail = split_image(ref)
         if repo != self.name:
             return ref
         repo = self.new_name or repo
@@ -117,16 +117,30 @@ class Overlay:
 
     KEYS = ("name", "namePrefix", "namespace", "commonLabels", "images",
             "patches")
+    IMAGE_KEYS = ("name", "newName", "newTag")
+    PATCH_KEYS = ("target", "patch")
+    TARGET_KEYS = ("kind", "name")
+
+    @staticmethod
+    def _check_keys(d: dict, valid: tuple[str, ...], where: str) -> None:
+        unknown = set(d) - set(valid)
+        if unknown:
+            # A typo'd key must fail loudly, not silently apply nothing —
+            # at every nesting level, not just the top.
+            raise ValueError(
+                f"unknown {where} keys {sorted(unknown)}; "
+                f"valid: {list(valid)}"
+            )
 
     @classmethod
     def from_dict(cls, d: dict) -> "Overlay":
-        unknown = set(d) - set(cls.KEYS)
-        if unknown:
-            # A typo'd key must fail loudly, not silently apply nothing.
-            raise ValueError(
-                f"unknown overlay keys {sorted(unknown)}; "
-                f"valid: {list(cls.KEYS)}"
-            )
+        cls._check_keys(d, cls.KEYS, "overlay")
+        for i in d.get("images") or ():
+            cls._check_keys(i, cls.IMAGE_KEYS, "image-rule")
+        for p in d.get("patches") or ():
+            cls._check_keys(p, cls.PATCH_KEYS, "patch")
+            cls._check_keys(p.get("target") or {}, cls.TARGET_KEYS,
+                            "patch target")
         return cls(
             name=d.get("name", "overlay"),
             name_prefix=d.get("namePrefix", ""),
@@ -170,7 +184,7 @@ def _tag_str(tag) -> str | None:
     return None if tag is None else str(tag)
 
 
-def _split_image(ref: str) -> tuple[str, str, str]:
+def split_image(ref: str) -> tuple[str, str, str]:
     """(repo, separator, tag-or-digest) — digest- and registry-port-aware
     (`localhost:5000/app:v1` splits at the LAST colon only if the tail has
     no '/'; `repo@sha256:...` splits at the '@')."""

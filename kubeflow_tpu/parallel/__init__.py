@@ -10,6 +10,7 @@ outsourced to TensorFlow's gRPC parameter-server runtime and OpenMPI/Horovod
 from kubeflow_tpu.parallel.mesh import (
     AXES,
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
     local_mesh_spec,
 )

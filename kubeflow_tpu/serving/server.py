@@ -10,6 +10,7 @@ state the way TF Serving's model-status API does.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Iterable
 
 from kubeflow_tpu.serving.servable import Servable
@@ -26,30 +27,67 @@ log = logging.getLogger(__name__)
 
 
 class ModelRepository:
-    """Named servables, hot-swappable by version (load() replaces)."""
+    """Named servables, several live versions per model.
+
+    TF-Serving semantics: loading a new version makes it the default
+    (latest) for unversioned requests while older versions stay
+    addressable at ``/versions/<v>`` until unloaded — the window a
+    client-side rollout needs."""
 
     def __init__(self, servables: Iterable[Servable] = ()):
-        self._models: dict[str, Servable] = {}
+        # Guards the version table: the WSGI server is threaded, and
+        # load()/unload() are the live-rollout path — a reader must never
+        # observe a half-applied mutation.
+        self._lock = threading.Lock()
+        self._models: dict[str, dict[int, Servable]] = {}
         for s in servables:
             self.load(s)
 
     def load(self, servable: Servable) -> None:
-        prev = self._models.get(servable.name)
-        self._models[servable.name] = servable
-        if prev is not None:
-            log.info(
-                "model %s: version %d -> %d",
-                servable.name, prev.version, servable.version,
-            )
+        with self._lock:
+            versions = self._models.setdefault(servable.name, {})
+            if versions:
+                log.info(
+                    "model %s: +version %d (latest was %d)",
+                    servable.name, servable.version, max(versions),
+                )
+            versions[servable.version] = servable
 
-    def get(self, name: str) -> Servable:
-        try:
-            return self._models[name]
-        except KeyError:
-            raise HttpError(404, f"model {name!r} not found") from None
+    def unload(self, name: str, version: int) -> None:
+        with self._lock:
+            versions = self._models.get(name) or {}
+            if version not in versions:
+                raise HttpError(
+                    404, f"model {name!r} version {version} not found"
+                )
+            del versions[version]
+            if not versions:
+                del self._models[name]
+
+    def get(self, name: str, version: int | None = None) -> Servable:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise HttpError(404, f"model {name!r} not found")
+            if version is None:
+                return versions[max(versions)]
+            try:
+                return versions[version]
+            except KeyError:
+                raise HttpError(
+                    404, f"model {name!r} version {version} not found"
+                ) from None
+
+    def versions(self, name: str) -> list[Servable]:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise HttpError(404, f"model {name!r} not found")
+            return [versions[v] for v in sorted(versions)]
 
     def names(self) -> list[str]:
-        return sorted(self._models)
+        with self._lock:
+            return sorted(self._models)
 
 
 class ModelServerApp(App):
@@ -71,6 +109,12 @@ class ModelServerApp(App):
         # handler splits it.
         self.add_route("/v1/models/<name>", self.model_get)
         self.add_route("/v1/models/<name>", self.model_post, ("POST",))
+        self.add_route(
+            "/v1/models/<name>/versions/<version>", self.model_get
+        )
+        self.add_route(
+            "/v1/models/<name>/versions/<version>", self.model_post, ("POST",)
+        )
         self.add_route("/v1/models", self.models_list)
         self.add_route("/metrics", self.metrics_text)
 
@@ -84,28 +128,52 @@ class ModelServerApp(App):
     def models_list(self, req: Request) -> Response:
         return json_response({"models": self.repository.names()})
 
+    @staticmethod
+    def _version_param(req: Request) -> tuple[int | None, str | None]:
+        """(version, verb) from a /versions/<v> segment, when present.
+        The :verb suffix rides the LAST path segment (TF-Serving URL
+        convention), which is the version on versioned routes."""
+        raw = req.path_params.get("version")
+        if raw is None:
+            return None, None
+        raw, verb = ModelServerApp._split_verb(raw)
+        try:
+            return int(raw), verb
+        except ValueError:
+            raise HttpError(400, f"version must be an integer, got {raw!r}")
+
     def model_get(self, req: Request) -> Response:
         name, verb = self._split_verb(req.path_params["name"])
-        if verb is not None:
-            raise HttpError(405, f"verb {verb!r} requires POST")
-        model = self.repository.get(name)
+        version, vverb = self._version_param(req)
+        if verb is not None or vverb is not None:
+            raise HttpError(405, "verbs require POST")
+        if version is not None:
+            statuses = [self.repository.get(name, version)]
+        else:
+            # Unversioned status reports every live version (TF-Serving's
+            # model-status API shape).
+            statuses = self.repository.versions(name)
         return json_response(
             {
                 "model_version_status": [
                     {
-                        "version": str(model.version),
+                        "version": str(m.version),
                         "state": "AVAILABLE",
                         "status": {"error_code": "OK", "error_message": ""},
                     }
+                    for m in statuses
                 ]
             }
         )
 
     def model_post(self, req: Request) -> Response:
         name, verb = self._split_verb(req.path_params["name"])
+        version, vverb = self._version_param(req)
+        if version is not None:
+            verb = vverb
         if verb != "predict":
             raise HttpError(400, f"unsupported verb {verb!r}")
-        model = self.repository.get(name)
+        model = self.repository.get(name, version)
         body = req.json()
         instances = body.get("instances")
         if not isinstance(instances, list) or not instances:

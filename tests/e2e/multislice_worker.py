@@ -1,0 +1,72 @@
+"""Worker for the multi-slice gang E2E: a 2-slice x 2-process TpuJob
+whose members build a HYBRID mesh — dp split across slices (DCN axis)
+and within each slice (ICI axis) — and run a global psum plus a sharded
+training step across all four real processes.
+
+This exercises the full multi-slice path on CPU: the operator's
+TPUJOB_NUM_SLICES/TPUJOB_SLICE_ID env injection, the MEGASCALE_* export
+in `initialize_from_env`, and `build_hybrid_mesh`'s virtual-slice
+fallback (SURVEY.md §2.2: ICI in-slice, DCN across slices).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from kubeflow_tpu.parallel import (  # noqa: E402
+    MeshSpec,
+    build_hybrid_mesh,
+    initialize_from_env,
+)
+
+
+def main() -> int:
+    pe = initialize_from_env()
+    assert pe.num_slices == 2, pe
+    assert pe.slice_id == pe.process_id // (pe.num_processes // pe.num_slices)
+    # initialize_from_env exported the DCN transport hints.
+    assert os.environ["MEGASCALE_NUM_SLICES"] == "2"
+    assert os.environ["MEGASCALE_SLICE_ID"] == str(pe.slice_id)
+
+    # dp = 2 (DCN, across slices) x 2 (ICI, within slice) = 4 global.
+    mesh = build_hybrid_mesh(MeshSpec(dp=-1), MeshSpec(dp=2))
+    assert mesh.shape["dp"] == 4, dict(mesh.shape)
+
+    arr = jax.make_array_from_callback(
+        (jax.device_count(),),
+        NamedSharding(mesh, P("dp")),
+        lambda idx: jnp.ones((1,)) * (pe.process_id + 1),
+    )
+    total = float(
+        jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
+    )
+    expected = float(sum(range(1, pe.num_processes + 1)))
+    assert total == expected, (total, expected)
+
+    # A sharded computation over the combined axis: mean of per-process
+    # shards — every member must agree on the replicated result.
+    mean = float(
+        jax.jit(lambda x: x.mean(), out_shardings=NamedSharding(mesh, P()))(arr)
+    )
+    assert abs(mean - expected / pe.num_processes) < 1e-6
+    print(
+        f"rank {pe.process_id} slice {pe.slice_id}: hybrid psum ok "
+        f"({total})",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

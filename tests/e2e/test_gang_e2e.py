@@ -110,3 +110,46 @@ def test_distributed_training_end_to_end(tmp_path):
     observation = job.status.get("observation") or {}
     assert observation.get("loss") is not None, (job.status, logs)
     assert observation["loss"] < observation["first_loss"], observation
+
+
+def test_multislice_gang_end_to_end(tmp_path):
+    """A 2-slice x 2-process TpuJob: the operator injects slice structure,
+    initialize_from_env exports the DCN transport hints, and all four real
+    processes agree on collectives over a hybrid ICI x DCN mesh."""
+    api = FakeApiServer()
+    ctl = TpuJobController(api)
+    runner = LocalPodRunner(
+        api,
+        extra_env={"KFTPU_REPO": REPO},
+        capture_dir=str(tmp_path / "logs"),
+    )
+    api.create(
+        make_tpujob(
+            "ms",
+            replicas=4,
+            num_slices=2,
+            tpu_chips_per_worker=0,
+            command=(
+                sys.executable,
+                os.path.join(REPO, "tests", "e2e", "multislice_worker.py"),
+            ),
+        )
+    )
+    deadline = time.time() + 240
+    try:
+        while time.time() < deadline:
+            ctl.controller.run_until_idle()
+            runner.step()
+            phase = api.get(KIND, "ms").status.get("phase")
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+    finally:
+        runner.shutdown()
+
+    logs = {
+        p.name: p.read_text() for p in (tmp_path / "logs").glob("*.log")
+    }
+    assert api.get(KIND, "ms").status.get("phase") == "Succeeded", logs
+    for rank in range(4):
+        assert "hybrid psum ok" in logs.get(f"ms-worker-{rank}.log", ""), logs

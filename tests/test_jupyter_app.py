@@ -220,3 +220,110 @@ def test_tensorboards_crud(world):
         api.get("Deployment", "tb1", "team")
 
     assert c.post("/api/namespaces/team/tensorboards", body={"name": "x"}).status == 400
+
+
+# -- snapshots: the rok-variant flow ---------------------------------------
+
+
+def _spawn(client, name, **extra):
+    body = {
+        "name": name,
+        "image": "kubeflow-tpu/jax-notebook:latest",
+        "cpu": "1",
+        "memory": "1Gi",
+        "tpu": "0",
+        "workspaceVolume": {
+            "type": "New", "name": "{name}-workspace", "size": "5Gi",
+            "mountPath": "/home/jovyan", "accessMode": "ReadWriteOnce",
+        },
+        "configurations": [],
+    }
+    body.update(extra)
+    return client.post("/api/namespaces/team/notebooks", body)
+
+
+def test_snapshot_and_restore_flow(world):
+    """The rok flow end-to-end: spawn → snapshot the workspace → spawn a
+    second notebook restoring from the snapshot (PVC dataSource)."""
+    api, nb_ctl, client = world
+    assert _spawn(client, "nb1").status == 200
+
+    resp = client.post(
+        "/api/namespaces/team/snapshots",
+        {"pvc": "nb1-workspace", "name": "snap1"},
+    )
+    assert resp.status == 200, resp.json()
+    snap = resp.json()["snapshot"]
+    assert snap["status"]["readyToUse"] is True
+    assert snap["status"]["restoreSize"] == "5Gi"
+
+    listed = client.get("/api/namespaces/team/snapshots").json()["snapshots"]
+    assert [s["name"] for s in listed] == ["snap1"]
+    assert listed[0]["ready"] and listed[0]["source"] == "nb1-workspace"
+
+    assert _spawn(
+        client, "nb2",
+        workspaceVolume={
+            "type": "Snapshot", "name": "{name}-workspace",
+            "snapshot": "snap1", "mountPath": "/home/jovyan",
+        },
+    ).status == 200
+    pvc = api.get("PersistentVolumeClaim", "nb2-workspace", "team")
+    assert pvc.spec["dataSource"] == {
+        "kind": "VolumeSnapshot", "name": "snap1"
+    }
+    # Size restored from the snapshot when the form didn't give one.
+    assert pvc.spec["resources"]["requests"]["storage"] == "5Gi"
+
+    assert client.delete("/api/namespaces/team/snapshots/snap1").status == 200
+    assert client.get("/api/namespaces/team/snapshots").json()["snapshots"] == []
+
+
+def test_snapshot_error_paths(world):
+    api, _, client = world
+    # Snapshot of a PVC that doesn't exist.
+    assert client.post(
+        "/api/namespaces/team/snapshots", {"pvc": "nope"}
+    ).status == 404
+    assert client.post(
+        "/api/namespaces/team/snapshots", {}
+    ).status == 400
+    # Restore from a missing snapshot.
+    assert _spawn(
+        client, "nb3",
+        workspaceVolume={"type": "Snapshot", "name": "{name}-workspace",
+                         "snapshot": "ghost"},
+    ).status == 400
+    # Restore from a not-ready snapshot.
+    _spawn(client, "nb4")
+    client.post("/api/namespaces/team/snapshots",
+                {"pvc": "nb4-workspace", "name": "cold"})
+    snap = api.get("VolumeSnapshot", "cold", "team")
+    snap.status["readyToUse"] = False
+    api.update_status(snap)
+    assert _spawn(
+        client, "nb5",
+        workspaceVolume={"type": "Snapshot", "name": "{name}-workspace",
+                         "snapshot": "cold"},
+    ).status == 400
+    # Snapshot volume without a snapshot name.
+    assert _spawn(
+        client, "nb6",
+        workspaceVolume={"type": "Snapshot", "name": "{name}-workspace"},
+    ).status == 400
+
+
+def test_snapshot_restore_onto_existing_pvc_is_409(world):
+    """Restoring onto a name whose PVC already exists must fail loudly —
+    silently reusing the old claim would skip the restore entirely."""
+    _, _, client = world
+    _spawn(client, "nb7")  # creates nb7-workspace
+    client.post("/api/namespaces/team/snapshots",
+                {"pvc": "nb7-workspace", "name": "s7"})
+    client.delete("/api/namespaces/team/notebooks/nb7")
+    resp = _spawn(
+        client, "nb7",
+        workspaceVolume={"type": "Snapshot", "name": "{name}-workspace",
+                         "snapshot": "s7"},
+    )
+    assert resp.status == 409, resp.json()

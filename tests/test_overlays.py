@@ -236,3 +236,13 @@ def test_overlay_load_rejects_non_mapping(tmp_path):
     bad.write_text("- just\n- a list\n")
     with pytest.raises(ValueError, match="mapping"):
         Overlay.load(bad)
+
+
+def test_nested_overlay_keys_validated():
+    with pytest.raises(ValueError, match="image-rule"):
+        Overlay.from_dict({"images": [{"name": "a", "tag": "v2"}]})
+    with pytest.raises(ValueError, match="patch target"):
+        Overlay.from_dict({"patches": [{"target": {"labelSelector": "x"},
+                                        "patch": {}}]})
+    with pytest.raises(ValueError, match="patch keys"):
+        Overlay.from_dict({"patches": [{"merge": {}}]})

@@ -142,10 +142,11 @@ def test_hot_swap_version(model):
         == "1"
     )
     repo.load(Servable.from_module("m", module, variables, version=2, train=False))
-    assert (
-        client.get("/v1/models/m").json()["model_version_status"][0]["version"]
-        == "2"
-    )
+    # Both versions stay live; unversioned requests serve the newest.
+    assert [
+        s["version"]
+        for s in client.get("/v1/models/m").json()["model_version_status"]
+    ] == ["1", "2"]
 
 
 def test_predictions_are_json_serializable(client):
@@ -153,3 +154,64 @@ def test_predictions_are_json_serializable(client):
         "/v1/models/mnist:predict", {"instances": _instances(1)}
     )
     json.dumps(resp.json())  # must not raise
+
+
+# -- model versions (TF-Serving /versions/<v> surface) ---------------------
+
+
+@pytest.fixture()
+def versioned_client(model):
+    module, variables = model
+    v1 = Servable.from_module("m", module, variables, version=1,
+                              max_batch=8, train=False)
+    # Version 2: same module, different params -> different predictions.
+    variables2 = jax.jit(module.init)(
+        jax.random.PRNGKey(7), np.zeros((1, 32, 32, 3), np.float32)
+    )
+    v2 = Servable.from_module("m", module, variables2, version=2,
+                              max_batch=8, train=False)
+    repo = ModelRepository([v1, v2])
+    return TestClient(ModelServerApp(repo)), repo
+
+
+def test_unversioned_status_lists_all_versions(versioned_client):
+    client, _ = versioned_client
+    resp = client.get("/v1/models/m")
+    versions = [s["version"] for s in resp.json()["model_version_status"]]
+    assert versions == ["1", "2"]
+
+
+def test_versioned_predict_and_latest_default(versioned_client):
+    client, _ = versioned_client
+    instances = _instances(2)
+    p1 = client.post("/v1/models/m/versions/1:predict",
+                     {"instances": instances}).json()["predictions"]
+    p2 = client.post("/v1/models/m/versions/2:predict",
+                     {"instances": instances}).json()["predictions"]
+    latest = client.post("/v1/models/m:predict",
+                         {"instances": instances}).json()["predictions"]
+    assert np.allclose(latest, p2)  # unversioned = newest
+    assert not np.allclose(p1, p2)  # versions genuinely differ
+
+
+def test_versioned_status_and_404s(versioned_client):
+    client, _ = versioned_client
+    resp = client.get("/v1/models/m/versions/2")
+    assert [s["version"] for s in resp.json()["model_version_status"]] == ["2"]
+    assert client.get("/v1/models/m/versions/9").status == 404
+    assert client.post("/v1/models/m/versions/9:predict",
+                       {"instances": _instances(1)}).status == 404
+    assert client.get("/v1/models/m/versions/two").status == 400
+
+
+def test_unload_rolls_back_to_previous(versioned_client):
+    client, repo = versioned_client
+    instances = _instances(2)
+    p1 = client.post("/v1/models/m/versions/1:predict",
+                     {"instances": instances}).json()["predictions"]
+    repo.unload("m", 2)
+    latest = client.post("/v1/models/m:predict",
+                         {"instances": instances}).json()["predictions"]
+    assert np.allclose(latest, p1)  # rollback: latest is v1 again
+    repo.unload("m", 1)
+    assert client.get("/v1/models/m").status == 404
