@@ -25,9 +25,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import dense_attention, ring_attention
+from kubeflow_tpu.parallel.sharding import batch_axes
 from kubeflow_tpu.ops.flash import flash_attention, flash_usable
 
 
@@ -121,7 +122,6 @@ def _attend(q, k, v, mesh: Mesh | None, impl: str):
         # The shard_map wrapper needs batch % (dp·fsdp) == 0 and
         # heads % tp == 0 — stricter than pjit auto-partitioning, so the
         # auto path falls back to dense rather than erroring.
-        from kubeflow_tpu.parallel.sharding import batch_axes
 
         bsz = 1
         for a in batch_axes(mesh):
@@ -139,7 +139,6 @@ def _attend(q, k, v, mesh: Mesh | None, impl: str):
         return dense_attention(q, k, v, causal=True)
     if mesh is None:
         return flash_attention(q, k, v, causal=True)
-    from kubeflow_tpu.parallel.sharding import batch_axes
 
     heads = "tp" if mesh.shape.get("tp", 1) > 1 else None
     spec = P(batch_axes(mesh), None, heads, None)
@@ -288,6 +287,124 @@ class Block(nn.Module):
             mlp = SwiGLU(cfg, name="mlp")
         x = x + mlp(RMSNorm(cfg.dtype, name="ln_mlp")(x))
         return x
+
+
+class PipelinedTransformerLM(nn.Module):
+    """TransformerLM with layers split into `n_stages` pipeline stages
+    over the `pp` mesh axis (GPipe schedule, `num_microbatches` deep).
+
+    The schedule is expressed with stacked-stage params (`nn.vmap` with a
+    "stage" partition axis → the `pp` sharding rule) and a roll of the
+    stage-stacked activation buffer each tick — on a pp-sharded mesh XLA
+    lowers the roll to collective-permutes between neighbor stages, the
+    same wire pattern `parallel.pipeline.spmd_pipeline` spells manually.
+    The reference has no pipeline parallelism anywhere (SURVEY.md §2.2).
+
+    Weights match `TransformerLM` exactly (same Block), so a checkpoint
+    reshapes between the flat and stacked layouts by a transpose of the
+    layer axis. MoE stages are not supported (the aux-loss channel would
+    accumulate bubble garbage)."""
+
+    config: TransformerConfig
+    n_stages: int
+    num_microbatches: int
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        cfg = self.config
+        if cfg.num_experts > 0:
+            raise ValueError("pipelined transformer does not support MoE")
+        if cfg.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers ({cfg.n_layers}) must divide into "
+                f"{self.n_stages} stages"
+            )
+        if tokens.shape[0] % self.num_microbatches:
+            raise ValueError(
+                f"batch ({tokens.shape[0]}) must divide into "
+                f"{self.num_microbatches} microbatches"
+            )
+        if self.mesh is not None:
+            pp = dict(self.mesh.shape).get("pp")
+            if pp is None or self.n_stages % pp:
+                raise ValueError(
+                    f"mesh needs a 'pp' axis whose size divides n_stages="
+                    f"{self.n_stages}; mesh axes: {dict(self.mesh.shape)}"
+                )
+        layers_per_stage = cfg.n_layers // self.n_stages
+
+        embed = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        x = embed.astype(cfg.dtype)[tokens]
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+        )
+
+        outer_mesh = self.mesh
+
+        class Stage(nn.Module):
+            """`layers_per_stage` sequential Blocks = one pipeline stage."""
+
+            @nn.compact
+            def __call__(self, x, positions):
+                block_cls = (
+                    nn.remat(Block, static_argnums=()) if cfg.remat else Block
+                )
+                for i in range(layers_per_stage):
+                    x = block_cls(cfg, outer_mesh, name=f"layer_{i}")(
+                        x, positions
+                    )
+                return x
+
+        stages = nn.vmap(
+            Stage,
+            in_axes=(0, None),
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            axis_size=self.n_stages,
+            metadata_params={nn.meta.PARTITION_NAME: "stage"},
+        )(name="stages")
+
+        n_mb, n_stages = self.num_microbatches, self.n_stages
+        mb_size = tokens.shape[0] // n_mb
+        x_mb = x.reshape((n_mb, mb_size) + x.shape[1:])
+        pos_mb = positions[:mb_size]
+
+        def constrain(states):
+            if outer_mesh is None:
+                return states
+            return jax.lax.with_sharding_constraint(
+                states,
+                NamedSharding(
+                    outer_mesh, P("pp", tuple(batch_axes(outer_mesh)))
+                ),
+            )
+
+        states = constrain(
+            jnp.zeros((n_stages, mb_size) + x.shape[1:], x.dtype)
+        )
+        outputs = jnp.zeros_like(x_mb)
+        for t in range(n_mb + n_stages - 1):  # GPipe: M + S - 1 ticks
+            if t < n_mb:
+                states = states.at[0].set(x_mb[t])
+            states = constrain(stages(states, pos_mb))
+            if t >= n_stages - 1:
+                outputs = outputs.at[t - (n_stages - 1)].set(states[-1])
+            # Neighbor handoff: stage i's output becomes stage i+1's input.
+            states = constrain(jnp.roll(states, 1, axis=0))
+
+        x = outputs.reshape(x.shape)
+        x = RMSNorm(cfg.dtype, name="ln_final")(x)
+        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32), embed)
+        return logits
 
 
 class TransformerLM(nn.Module):

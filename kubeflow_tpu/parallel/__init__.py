@@ -27,3 +27,4 @@ from kubeflow_tpu.parallel.distributed import (
     ProcessEnv,
     initialize_from_env,
 )
+from kubeflow_tpu.parallel.pipeline import bubble_fraction, spmd_pipeline

@@ -1,0 +1,140 @@
+"""SPMD pipeline parallelism: microbatched GPipe schedule over the `pp`
+mesh axis.
+
+The reference has no pipeline parallelism at all (SURVEY.md §2.2 — absent);
+here it is a first-class mesh axis with an actual schedule, built the TPU
+way: every pp rank runs the SAME traced program (`shard_map`), stages hand
+activations to their successor with `lax.ppermute` over ICI, and the
+steady-state keeps all stages busy while the `S - 1` warmup/drain ticks
+are the classic pipeline bubble.
+
+Shape contract:
+
+- `stage_params`: a pytree whose leaves are stacked per stage on the
+  leading axis (`[S, ...]`, sharded `P("pp", ...)` — logical axis name
+  "stage"). Each rank slices out its own stage's parameters.
+- `x`: the global batch `[B, ...]`, sharded over the batch axes (dp/fsdp)
+  and replicated over pp. It is split into `num_microbatches` equal
+  microbatches along axis 0.
+- `stage_fn(params_slice, microbatch) -> microbatch` — pure, same output
+  shape (the usual residual-block contract).
+
+Total ticks = num_microbatches + S - 1; bubble fraction = (S-1)/ticks, so
+more microbatches amortize the bubble (How-to-Scale-Your-Model's pipeline
+recipe). Gradients flow through `ppermute` (it has a transpose rule), so
+the same function trains under `jax.grad`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from kubeflow_tpu.parallel.sharding import batch_axes
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run `x` through S pipeline stages; returns the final activations
+    with the same sharding as `x`."""
+    n_stages = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaves must be stacked [S={n_stages}, ...]; "
+                f"got leading dim {leaf.shape[0]}"
+            )
+    batch = tuple(batch_axes(mesh))
+    batch_shards = 1
+    for a in batch:
+        batch_shards *= mesh.shape[a]
+    local_batch, rem = divmod(x.shape[0], batch_shards)
+    if rem:
+        raise ValueError(
+            f"batch {x.shape[0]} does not shard evenly over "
+            f"{batch_shards} batch-axis devices"
+        )
+    if local_batch % num_microbatches:
+        raise ValueError(
+            f"per-shard batch {local_batch} must divide into "
+            f"{num_microbatches} microbatches"
+        )
+    if n_stages == 1:
+        # Degenerate pipeline: just apply the single stage.
+        params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return stage_fn(params0, x)
+    param_spec = jax.tree_util.tree_map(
+        lambda _: P(axis), stage_params
+    )
+    x_spec = P(batch)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_spec, x_spec),
+        out_specs=x_spec,
+        check_rep=False,
+    )
+    def run(params, local_x):
+        # params leaves: [S/pp_size, ...] = [1, ...] per rank -> squeeze.
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        mb = jnp.reshape(
+            local_x,
+            (num_microbatches, local_x.shape[0] // num_microbatches)
+            + local_x.shape[1:],
+        )
+        state = jnp.zeros_like(mb[0])
+        outputs = jnp.zeros_like(mb)
+        ticks = num_microbatches + n_stages - 1
+
+        def tick(t, carry):
+            state, outputs = carry
+            # Stage 0 injects microbatch t (clamped; masked past the end).
+            inject = mb[jnp.minimum(t, num_microbatches - 1)]
+            state = jnp.where(stage == 0, inject, state)
+            state = stage_fn(my_params, state)
+            # The last stage emits microbatch t - (S-1) once warm.
+            out_idx = jnp.clip(t - (n_stages - 1), 0, num_microbatches - 1)
+            emit = jnp.logical_and(
+                stage == n_stages - 1, t >= n_stages - 1
+            )
+            outputs = outputs.at[out_idx].set(
+                jnp.where(emit, state, outputs[out_idx])
+            )
+            # Hand off to the successor stage (ring: last -> 0, ignored
+            # because stage 0 overwrites with its next injection).
+            state = lax.ppermute(
+                state,
+                axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            return state, outputs
+
+        _, outputs = lax.fori_loop(
+            0, ticks, tick, (state, outputs)
+        )
+        # Only the last stage holds real outputs; psum over pp replicates
+        # them to every rank (all other ranks contribute zeros).
+        outputs = lax.psum(outputs, axis)
+        return jnp.reshape(outputs, local_x.shape)
+
+    return run(stage_params, x)
+
+
+def bubble_fraction(n_stages: int, num_microbatches: int) -> float:
+    """The fraction of ticks each stage idles — (S-1)/(M+S-1)."""
+    return (n_stages - 1) / (num_microbatches + n_stages - 1)

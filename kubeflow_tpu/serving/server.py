@@ -170,6 +170,13 @@ class ModelServerApp(App):
         name, verb = self._split_verb(req.path_params["name"])
         version, vverb = self._version_param(req)
         if version is not None:
+            if verb is not None:
+                # /v1/models/m:predict/versions/1 — the verb belongs on
+                # the LAST segment; reject rather than silently ignore.
+                raise HttpError(
+                    400, "on versioned routes the :verb goes after the "
+                    "version, e.g. /versions/1:predict",
+                )
             verb = vverb
         if verb != "predict":
             raise HttpError(400, f"unsupported verb {verb!r}")
