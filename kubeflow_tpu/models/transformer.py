@@ -348,6 +348,21 @@ class PipelinedTransformerLM(nn.Module):
         )
 
         outer_mesh = self.mesh
+        n_mb, n_stages = self.num_microbatches, self.n_stages
+        mb_size = tokens.shape[0] // n_mb
+        x_mb = x.reshape((n_mb, mb_size) + x.shape[1:])
+        pos_mb = positions[:mb_size]
+        ticks = n_mb + n_stages - 1  # GPipe: M + S - 1
+
+        def constrain(states):
+            if outer_mesh is None:
+                return states
+            return jax.lax.with_sharding_constraint(
+                states,
+                NamedSharding(
+                    outer_mesh, P("pp", tuple(batch_axes(outer_mesh)))
+                ),
+            )
 
         class Stage(nn.Module):
             """`layers_per_stage` sequential Blocks = one pipeline stage."""
@@ -363,44 +378,55 @@ class PipelinedTransformerLM(nn.Module):
                     )
                 return x
 
-        stages = nn.vmap(
-            Stage,
-            in_axes=(0, None),
-            out_axes=0,
-            variable_axes={"params": 0},
-            split_rngs={"params": True},
-            axis_size=self.n_stages,
-            metadata_params={nn.meta.PARTITION_NAME: "stage"},
+        class Tick(nn.Module):
+            """One pipeline tick: inject, apply all stages in parallel
+            (vmap over the stacked stage axis), emit, rotate."""
+
+            @nn.compact
+            def __call__(self, carry, xs):
+                states, outputs = carry
+                t, inject = xs
+                stages = nn.vmap(
+                    Stage,
+                    in_axes=(0, None),
+                    out_axes=0,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True},
+                    axis_size=n_stages,
+                    metadata_params={nn.meta.PARTITION_NAME: "stage"},
+                )(name="blocks")
+                states = states.at[0].set(
+                    jnp.where(t < n_mb, inject, states[0])
+                )
+                states = constrain(stages(states, pos_mb))
+                out_idx = jnp.clip(t - (n_stages - 1), 0, n_mb - 1)
+                updated = outputs.at[out_idx].set(states[-1])
+                outputs = jnp.where(t >= n_stages - 1, updated, outputs)
+                # Neighbor handoff: stage i's output feeds stage i+1.
+                states = constrain(jnp.roll(states, 1, axis=0))
+                return (states, outputs), None
+
+        # nn.scan over ticks keeps the traced program CONSTANT in the
+        # microbatch count (one stage-stack in the jaxpr, not M+S-1
+        # copies); params broadcast across ticks = ordinary weight reuse.
+        scan_ticks = nn.scan(
+            Tick,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            length=ticks,
         )(name="stages")
 
-        n_mb, n_stages = self.num_microbatches, self.n_stages
-        mb_size = tokens.shape[0] // n_mb
-        x_mb = x.reshape((n_mb, mb_size) + x.shape[1:])
-        pos_mb = positions[:mb_size]
-
-        def constrain(states):
-            if outer_mesh is None:
-                return states
-            return jax.lax.with_sharding_constraint(
-                states,
-                NamedSharding(
-                    outer_mesh, P("pp", tuple(batch_axes(outer_mesh)))
-                ),
-            )
-
-        states = constrain(
+        states0 = constrain(
             jnp.zeros((n_stages, mb_size) + x.shape[1:], x.dtype)
         )
-        outputs = jnp.zeros_like(x_mb)
-        for t in range(n_mb + n_stages - 1):  # GPipe: M + S - 1 ticks
-            if t < n_mb:
-                states = states.at[0].set(x_mb[t])
-            states = constrain(stages(states, pos_mb))
-            if t >= n_stages - 1:
-                outputs = outputs.at[t - (n_stages - 1)].set(states[-1])
-            # Neighbor handoff: stage i's output becomes stage i+1's input.
-            states = constrain(jnp.roll(states, 1, axis=0))
-
+        # Per-tick inject stream: microbatch t for the first M ticks, then
+        # (masked) repeats of the last microbatch during drain.
+        inject_idx = jnp.minimum(jnp.arange(ticks), n_mb - 1)
+        (final_states, outputs), _ = scan_ticks(
+            (states0, jnp.zeros_like(x_mb)),
+            (jnp.arange(ticks), x_mb[inject_idx]),
+        )
+        del final_states
         x = outputs.reshape(x.shape)
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
         logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32), embed)

@@ -1,0 +1,114 @@
+"""The full data path end-to-end: record files on disk → the native
+compiled prefetching loader → device-sharded batches → Trainer.fit with
+checkpointing — the platform's IO story feeding real training, plus
+cross-topology checkpoint restore (save on one mesh layout, resume on
+another — the elastic-recovery move the reference never had,
+SURVEY.md §5 failure-detection row)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.resnet import tiny_resnet
+from kubeflow_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_tpu.train import TrainConfig, Trainer
+from kubeflow_tpu.train.checkpoint import Checkpointer
+from kubeflow_tpu.train.loop import fit
+from kubeflow_tpu.train.records import RecordDataset, RecordSpec, write_records
+
+
+def _write_dataset(tmp_path, n=64, image=12):
+    spec = RecordSpec.of(
+        image=("float32", (image, image, 3)), label=("int32", ())
+    )
+    rng = np.random.RandomState(0)
+    examples = []
+    for i in range(n):
+        # Learnable signal: label = 1 when the image mean is positive.
+        img = rng.randn(image, image, 3).astype(np.float32)
+        lbl = np.int32(1 if img.mean() > 0 else 0)
+        examples.append({"image": img, "label": lbl})
+    path = tmp_path / "train.rec"
+    write_records(str(path), spec, examples)
+    return spec, [str(path)]
+
+
+def _trainer(mesh, *, image=12, fsdp_params=False, total_steps=30):
+    config = TrainConfig(
+        batch_size=16,
+        learning_rate=0.05,
+        warmup_steps=2,
+        total_steps=total_steps,
+        fsdp_params=fsdp_params,
+    )
+    return Trainer(
+        tiny_resnet(num_classes=2),
+        config,
+        mesh,
+        example_input_shape=(2, image, image, 3),
+    )
+
+
+def test_records_feed_training_and_loss_drops(tmp_path):
+    spec, paths = _write_dataset(tmp_path)
+    mesh = build_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    trainer = _trainer(mesh)
+    dataset = RecordDataset(
+        paths, spec, batch_size=16, seed=3, shuffle_buffer=32, drop_remainder=True, epochs=0
+    )
+    losses = []
+    fit(
+        trainer,
+        dataset.device_iter(mesh),
+        total_steps=30,
+        on_metrics=lambda step, m: losses.append(float(m["loss"])),
+        log_every=1,
+    )
+    assert len(losses) == 30 and all(np.isfinite(losses))
+    # The label is a deterministic function of the image: 30 steps of SGD
+    # must make clear progress (typ. 0.75 -> 0.60 here).
+    assert min(losses[-5:]) < losses[0] * 0.87, losses[:3] + losses[-3:]
+
+
+def test_cross_topology_checkpoint_restore(tmp_path):
+    """Save on a dp=4/fsdp-sharded mesh, resume on dp=2: the abstract
+    template carries the NEW mesh's shardings, so orbax re-shards on
+    restore and training continues with identical math."""
+    spec, paths = _write_dataset(tmp_path)
+
+    mesh_a = build_mesh(MeshSpec(dp=2, fsdp=2), jax.devices()[:4])
+    trainer_a = _trainer(mesh_a, fsdp_params=True, total_steps=6)
+    data_a = RecordDataset(
+        paths, spec, batch_size=16, seed=3, shuffle_buffer=32, drop_remainder=True, epochs=0
+    )
+    ckpt_a = Checkpointer(tmp_path / "ckpt", save_interval_steps=2)
+    result_a = fit(
+        trainer_a, data_a.device_iter(mesh_a), total_steps=6,
+        checkpointer=ckpt_a,
+    )
+    ckpt_a.wait()
+    ckpt_a.close()
+    assert result_a.steps_done == 6
+
+    # New topology: half the chips, no fsdp (pure DP, params replicated).
+    mesh_b = build_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    trainer_b = _trainer(mesh_b, fsdp_params=False, total_steps=10)
+    ckpt_b = Checkpointer(tmp_path / "ckpt", save_interval_steps=100)
+    restored, at = ckpt_b.restore_latest(trainer_b.abstract_state())
+    assert at == 6
+    # Restored arrays live on mesh_b with the pure-DP (replicated) layout.
+    stem = restored.params["conv_stem"]["kernel"]
+    assert stem.sharding.mesh.devices.size == 2
+
+    data_b = RecordDataset(
+        paths, spec, batch_size=16, seed=4, shuffle_buffer=32, drop_remainder=True, epochs=0
+    )
+    result_b = fit(
+        trainer_b, data_b.device_iter(mesh_b), total_steps=10,
+        checkpointer=ckpt_b,
+    )
+    ckpt_b.close()
+    assert result_b.resumed_from == 6
+    assert result_b.steps_done == 4  # 6 -> 10
+    assert all(np.isfinite(m["loss"]) for m in result_b.history)

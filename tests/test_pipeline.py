@@ -142,9 +142,9 @@ def test_pipelined_transformer_matches_flat():
     logits_pipe = jax.jit(lambda v, t: pipe.apply(v, t))(variables, tokens)
 
     # Rebuild the flat model's params from the stacked stage params:
-    # stages/layer_i[stage s] -> layer_{s*per_stage + i}.
+    # stages/blocks/layer_i[stage s] -> layer_{s*per_stage + i}.
     flat = TransformerLM(cfg)
-    stacked = variables["params"]["stages"]
+    stacked = variables["params"]["stages"]["blocks"]
     flat_params = {
         "embedding": variables["params"]["embedding"],
         "ln_final": variables["params"]["ln_final"],
