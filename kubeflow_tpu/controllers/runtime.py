@@ -24,6 +24,7 @@ import time
 from typing import Callable, Iterable
 
 from kubeflow_tpu.api.objects import Resource
+from kubeflow_tpu.utils import tracing
 from kubeflow_tpu.utils.metrics import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -225,7 +226,10 @@ class Controller:
             return False
         key = _decode(key_s)
         try:
-            result = self._reconcile(self.api, key) or Result()
+            with tracing.tracer.span(
+                "reconcile", controller=self.name, key="/".join(key)
+            ):
+                result = self._reconcile(self.api, key) or Result()
         except Exception:
             backoff = self._queue.requeue_error(key_s)
             log.exception(

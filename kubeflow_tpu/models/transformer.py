@@ -300,10 +300,12 @@ class PipelinedTransformerLM(nn.Module):
     same wire pattern `parallel.pipeline.spmd_pipeline` spells manually.
     The reference has no pipeline parallelism anywhere (SURVEY.md §2.2).
 
-    Weights match `TransformerLM` exactly (same Block), so a checkpoint
-    reshapes between the flat and stacked layouts by a transpose of the
-    layer axis. MoE stages are not supported (the aux-loss channel would
-    accumulate bubble garbage)."""
+    Weights match `TransformerLM` block-for-block: the stacked params
+    live at `params/stages/blocks/layer_<i>` with a leading stage axis,
+    and `params/stages/blocks/layer_i[s]` equals the flat model's
+    `params/layer_{s * layers_per_stage + i}` (the equivalence test
+    restacks one into the other). MoE stages are not supported (the
+    aux-loss channel would accumulate bubble garbage)."""
 
     config: TransformerConfig
     n_stages: int
@@ -400,8 +402,12 @@ class PipelinedTransformerLM(nn.Module):
                 )
                 states = constrain(stages(states, pos_mb))
                 out_idx = jnp.clip(t - (n_stages - 1), 0, n_mb - 1)
-                updated = outputs.at[out_idx].set(states[-1])
-                outputs = jnp.where(t >= n_stages - 1, updated, outputs)
+                # Single-slot select: masking only the written microbatch
+                # keeps output collection O(M) across the scan (a select
+                # over the whole buffer per tick would be O(M^2)).
+                outputs = outputs.at[out_idx].set(
+                    jnp.where(t >= n_stages - 1, states[-1], outputs[out_idx])
+                )
                 # Neighbor handoff: stage i's output feeds stage i+1.
                 states = constrain(jnp.roll(states, 1, axis=0))
                 return (states, outputs), None

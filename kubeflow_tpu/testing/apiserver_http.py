@@ -28,6 +28,7 @@ import urllib.parse
 import urllib.request
 
 from kubeflow_tpu.api.objects import Resource
+from kubeflow_tpu.utils import tracing
 from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
     Conflict,
@@ -61,6 +62,19 @@ class ApiServerApp(App):
         self.add_route("/apis/<kind>/<ns>/<name>", self.delete, ("DELETE",))
         self.add_route(
             "/apis/<kind>/<ns>/<name>/status", self.update_status, ("PUT",)
+        )
+        # In-process trace collector drain (the platform's jaeger-query
+        # stand-in): returns and clears all finished spans.
+        self.add_route("/debug/traces", self.drain_traces)
+
+    def drain_traces(self, req: Request) -> Response:
+        from kubeflow_tpu.utils import tracing
+
+        return json_response(
+            {
+                "spans": tracing.tracer.export(),
+                "dropped": tracing.tracer.dropped,
+            }
         )
 
     def list_kind(self, req: Request) -> Response:
@@ -145,7 +159,12 @@ class HttpApiClient:
             self.base_url + path,
             method=method,
             data=json.dumps(body).encode() if body is not None else None,
-            headers={"Content-Type": "application/json"},
+            # An active span's trace id rides along, so a reconcile's
+            # apiserver calls land in the same trace (`utils.tracing`).
+            headers={
+                "Content-Type": "application/json",
+                **tracing.trace_header(),
+            },
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:

@@ -25,6 +25,7 @@ import socketserver
 from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from kubeflow_tpu.testing import fake_apiserver as storage
+from kubeflow_tpu.utils import tracing
 
 log = logging.getLogger(__name__)
 
@@ -212,6 +213,20 @@ class App:
     # -- dispatch ----------------------------------------------------------
 
     def handle(self, req: Request) -> Response:
+        # Every request is a span; an inbound x-kftpu-trace-id header
+        # continues the caller's trace (the traceparent analog).
+        with tracing.tracer.span(
+            "http",
+            trace_id=tracing.from_header(req.headers),
+            app=self.name,
+            method=req.method,
+            path=req.path,
+        ) as span:
+            resp = self._handle_inner(req)
+            span.attributes["status"] = resp.status
+            return resp
+
+    def _handle_inner(self, req: Request) -> Response:
         try:
             return self._dispatch(req)
         except HttpError as e:

@@ -1,0 +1,151 @@
+"""Control-plane tracing spans (the reference had none — SURVEY.md §5)."""
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.utils import tracing
+from kubeflow_tpu.utils.tracing import HEADER, Tracer
+
+
+def test_span_records_timing_and_attributes():
+    t = Tracer()
+    with t.span("work", component="test") as span:
+        assert span.trace_id and span.span_id
+    (rec,) = t.export()
+    assert rec["name"] == "work"
+    assert rec["attributes"]["component"] == "test"
+    assert rec["durationMs"] >= 0
+    assert rec["error"] is None
+    assert t.export() == []  # drained
+
+
+def test_nested_spans_share_trace_and_link_parent():
+    t = Tracer()
+    with t.span("outer") as outer:
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    inner_rec, outer_rec = t.export()  # inner finishes first
+    assert inner_rec["parentId"] == outer_rec["spanId"]
+
+
+def test_error_flag_set_and_exception_propagates():
+    t = Tracer()
+    with pytest.raises(ValueError):
+        with t.span("boom"):
+            raise ValueError("nope")
+    (rec,) = t.export()
+    assert "ValueError" in rec["error"]
+
+
+def test_ring_buffer_drops_oldest():
+    t = Tracer(capacity=2)
+    for i in range(4):
+        with t.span(f"s{i}"):
+            pass
+    out = t.export()
+    assert [r["name"] for r in out] == ["s2", "s3"]
+    assert t.dropped == 2
+
+
+def test_threads_do_not_share_span_context():
+    t = Tracer()
+    seen = {}
+
+    def worker(name):
+        with t.span(name) as s:
+            seen[name] = s.parent_id
+
+    with t.span("main"):
+        th = threading.Thread(target=worker, args=("child-thread",))
+        th.start()
+        th.join()
+    # A fresh thread has no inherited context -> new root span.
+    assert seen["child-thread"] is None
+
+
+def test_header_roundtrip():
+    t = tracing.tracer
+    with t.span("req"):
+        hdr = tracing.trace_header()
+        assert HEADER in hdr
+        assert tracing.from_header(hdr) == tracing.current_trace_id()
+    t.export()
+    assert tracing.trace_header() == {}  # no active span
+
+
+def test_reconcile_spans_emitted():
+    from kubeflow_tpu.controllers.notebook import NotebookController
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+
+    tracing.tracer.export()  # drain whatever other tests left
+    api = FakeApiServer()
+    ctl = NotebookController(api)
+    api.create(new_resource("Notebook", "nb", "team", spec={"image": "i"}))
+    ctl.controller.run_until_idle()
+    spans = [
+        s for s in tracing.tracer.export()
+        if s["name"] == "reconcile"
+        and s["attributes"].get("controller") == "notebook-controller"
+    ]
+    assert spans
+    assert spans[0]["attributes"]["key"] == "team/nb"
+
+
+def test_http_spans_with_propagation():
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+    from kubeflow_tpu.web import TestClient
+
+    tracing.tracer.export()
+    client = TestClient(ApiServerApp(FakeApiServer()))
+    resp = client.get("/apis/Notebook", headers={HEADER: "abc123"})
+    assert resp.status == 200
+    spans = [
+        s for s in tracing.tracer.export() if s["name"] == "http"
+    ]
+    assert spans
+    assert spans[-1]["traceId"] == "abc123"  # caller's trace continued
+    assert spans[-1]["attributes"]["status"] == 200
+    assert spans[-1]["attributes"]["path"] == "/apis/Notebook"
+
+
+def test_debug_traces_endpoint_drains():
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+    from kubeflow_tpu.web import TestClient
+
+    tracing.tracer.export()
+    client = TestClient(ApiServerApp(FakeApiServer()))
+    client.get("/apis/Notebook")
+    body = client.get("/debug/traces").json()
+    assert any(s["name"] == "http" for s in body["spans"])
+    # Drained: only the /debug/traces request's own span remains next time.
+    again = client.get("/debug/traces").json()
+    assert all(
+        s["attributes"].get("path") == "/debug/traces"
+        for s in again["spans"]
+    )
+
+
+def test_http_client_propagates_active_trace():
+    """A span active in the caller (e.g. a reconcile) must continue into
+    the apiserver's http span through HttpApiClient."""
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+    from kubeflow_tpu.web.wsgi import serve
+
+    tracing.tracer.export()
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    try:
+        client = HttpApiClient(f"http://127.0.0.1:{server.server_port}")
+        with tracing.tracer.span("caller") as outer:
+            client.list("Notebook")
+            want = outer.trace_id
+    finally:
+        server.shutdown()
+    http = [s for s in tracing.tracer.export() if s["name"] == "http"]
+    assert http and http[-1]["traceId"] == want
