@@ -31,16 +31,52 @@ import jax
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 2000.0
 
 
+def timed_run(step, state, it, warmup_steps: int, steps: int):
+    """Warm up, then time `steps` training steps; returns
+    (elapsed_seconds, final_loss).
+
+    On tunneled/remote platforms block_until_ready can return before the
+    device has executed; a scalar device_get (`float(...)`) is the only
+    reliable fence. The warmup ends with the same fence so warmup work
+    cannot leak into the timed window."""
+    metrics = None
+    for _ in range(warmup_steps):
+        state, metrics = step(state, next(it))
+    if metrics is not None:
+        float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, next(it))
+    final_loss = float(metrics["loss"])  # fences all timed steps
+    return time.perf_counter() - t0, final_loss
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument(
+        "--workload",
+        choices=("resnet", "lm"),
+        default="resnet",
+        help="resnet = the driver's headline metric; lm = transformer-LM "
+        "tokens/sec with the flash-attention kernel (secondary metric)",
+    )
+    parser.add_argument(
+        "--batch-size",
+        type=int,
+        default=None,
+        help="per-chip batch; defaults to 256 for resnet, a seq-len-scaled "
+        "heuristic for lm",
+    )
     parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument("--warmup-steps", type=int, default=5)
     parser.add_argument("--steps", type=int, default=30)
     args = parser.parse_args()
     if args.steps < 1:
         parser.error("--steps must be >= 1 (the timing fence reads the "
                      "last step's metrics)")
+    if args.workload == "lm":
+        return bench_lm(args)
 
     import jax.numpy as jnp
 
@@ -49,9 +85,10 @@ def main() -> None:
     from kubeflow_tpu.train import SyntheticImages, TrainConfig, Trainer
 
     n_chips = jax.device_count()
+    per_chip_batch = args.batch_size or 256
     mesh = build_mesh(MeshSpec(dp=-1))
     config = TrainConfig(
-        batch_size=args.batch_size * n_chips,
+        batch_size=per_chip_batch * n_chips,
         learning_rate=0.4,
         total_steps=10_000,
         # Single-host bench: pure DP; params replicated (ResNet-50 is 25M
@@ -71,24 +108,10 @@ def main() -> None:
         dtype=jnp.bfloat16,
     )
     state = trainer.init_state(jax.random.PRNGKey(0))
-    step = trainer.make_train_step()
-    it = iter(data)
-
-    # On tunneled/remote platforms block_until_ready can return before the
-    # device has executed; a scalar device_get is the only reliable fence.
-    # Fence the start the same way so warmup work can't leak into the
-    # timed window.
-    for _ in range(args.warmup_steps):
-        state, metrics = step(state, next(it))
-    if args.warmup_steps:
-        float(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = step(state, next(it))
-    final_loss = float(metrics["loss"])  # fences: forces all steps to finish
-    elapsed = time.perf_counter() - t0
-
+    elapsed, final_loss = timed_run(
+        trainer.make_train_step(), state, iter(data),
+        args.warmup_steps, args.steps,
+    )
     images_per_sec = config.batch_size * args.steps / elapsed
     per_chip = images_per_sec / n_chips
     print(
@@ -107,6 +130,79 @@ def main() -> None:
         f"# devices={n_chips} global_batch={config.batch_size} "
         f"steps={args.steps} elapsed={elapsed:.2f}s "
         f"total={images_per_sec:.1f} img/s loss={final_loss:.3f}",
+        file=sys.stderr,
+    )
+
+
+def bench_lm(args) -> None:
+    """Transformer-LM training throughput (tokens/sec/chip) with the
+    Pallas flash-attention kernel — the long-context datapoint the
+    ResNet metric can't show. Model: ~350M-param GPT-ish (d=1024, 16
+    layers, 16 heads), bf16 compute."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from kubeflow_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_tpu.train import SyntheticTokens, TrainConfig, Trainer
+
+    n_chips = jax.device_count()
+    mesh = build_mesh(MeshSpec(dp=-1))
+    cfg = TransformerConfig(
+        vocab_size=32_000,
+        d_model=1024,
+        n_layers=16,
+        n_heads=16,
+        head_dim=64,
+        d_ff=4096,
+        attention_impl="auto",  # flash on TPU at these shapes
+    )
+    per_chip_batch = args.batch_size or max(
+        1, 8 // max(1, args.seq_len // 2048)
+    )
+    batch = per_chip_batch * n_chips
+    config = TrainConfig(
+        batch_size=batch,
+        learning_rate=3e-4,
+        total_steps=10_000,
+        optimizer="adamw",
+        label_smoothing=0.0,
+        fsdp_params=False,
+    )
+    trainer = Trainer(
+        TransformerLM(cfg, mesh=mesh),
+        config,
+        mesh,
+        example_input_shape=(2, args.seq_len),
+        example_input_dtype=jnp.int32,
+        input_key="tokens",
+        label_key="labels",
+    )
+    data = SyntheticTokens(
+        mesh, batch_size=batch, seq_len=args.seq_len, vocab_size=cfg.vocab_size
+    )
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    elapsed, final_loss = timed_run(
+        trainer.make_train_step(), state, iter(data),
+        args.warmup_steps, args.steps,
+    )
+    tokens_per_sec = batch * args.seq_len * args.steps / elapsed
+    per_chip = tokens_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": None,  # greenfield: no reference number
+            }
+        )
+    )
+    print(
+        f"# devices={n_chips} batch={batch} seq={args.seq_len} "
+        f"steps={args.steps} elapsed={elapsed:.2f}s loss={final_loss:.3f}",
         file=sys.stderr,
     )
 
