@@ -47,13 +47,118 @@ class CullerConfig:
 
 
 # Probe returns the notebook's last-activity timestamp (epoch seconds) or
-# None if unreachable. The default HTTP probe hits Jupyter's
-# /api/status `last_activity` (culler.go:138-143); tests inject fakes.
+# None if unreachable. `http_activity_probe` is the production probe
+# (Jupyter's /api/status, culler.go:138-143); `tpu_duty_probe` treats a
+# busy TPU as activity; tests inject fakes.
 ActivityProbe = Callable[[Resource], float | None]
 
 
 def _never_active(_nb: Resource) -> float | None:
     return None
+
+
+def _parse_last_activity(raw: str) -> float | None:
+    """Jupyter's ISO-8601 `last_activity` → epoch seconds."""
+    import datetime
+
+    try:
+        stamp = datetime.datetime.fromisoformat(raw.replace("Z", "+00:00"))
+    except (ValueError, AttributeError, TypeError):
+        return None
+    if stamp.tzinfo is None:
+        stamp = stamp.replace(tzinfo=datetime.timezone.utc)
+    return stamp.timestamp()
+
+
+def http_activity_probe(
+    base_url: Callable[[Resource], str] | None = None,
+    timeout: float = 2.0,
+) -> ActivityProbe:
+    """The reference culler's probe (`culler.go:138-143`): GET the
+    notebook's Jupyter `/api/status` through its Service and read
+    `last_activity`. Unreachable/garbage ⇒ None (fail-safe: never cull on
+    a probe failure). `base_url` overrides the in-cluster
+    `http://<name>.<ns>.svc` for local setups/tests."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    def default_base(nb: Resource) -> str:
+        return f"http://{nb.metadata.name}.{nb.metadata.namespace}.svc"
+
+    base = base_url or default_base
+
+    def probe(nb: Resource) -> float | None:
+        url = f"{base(nb)}{route_prefix(nb)}/api/status"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                body = _json.loads(resp.read())
+        except (urllib.error.URLError, ValueError, OSError):
+            return None
+        if not isinstance(body, dict):
+            return None  # valid JSON but not the status object: garbage
+        return _parse_last_activity(body.get("last_activity"))
+
+    return probe
+
+
+def tpu_duty_probe(
+    api: FakeApiServer,
+    threshold: float = 0.05,
+    clock: Callable[[], float] = time.time,
+) -> ActivityProbe:
+    """TPU-aware activity (SURVEY.md §7.3 "culling becomes a cost
+    feature"): a notebook whose chips are running kernels is ACTIVE right
+    now even if no browser has touched Jupyter — a long training cell
+    must never be culled mid-run. Reads the mirrored `tpuDutyCycle` of
+    the node hosting the notebook's pod, and only for pods that actually
+    request `google.com/tpu` — a CPU-only notebook sharing a TPU node
+    with someone else's training job must not ride that job's duty cycle
+    forever. (Attribution is still node-granular for TPU-holding pods;
+    per-chip accounting needs telemetry the platform doesn't model.)"""
+
+    def _requests_tpu(pod: Resource) -> bool:
+        for container in pod.spec.get("containers", []):
+            limits = container.get("resources", {}).get("limits", {})
+            chips = limits.get("google.com/tpu")
+            if isinstance(chips, (int, float)) and chips > 0:
+                return True
+            if isinstance(chips, str) and chips.isdigit() and int(chips) > 0:
+                return True
+        return False
+
+    def probe(nb: Resource) -> float | None:
+        pods = api.list(
+            "Pod",
+            nb.metadata.namespace,
+            label_selector={"notebook": nb.metadata.name},
+        )
+        for pod in pods:
+            node_name = pod.spec.get("nodeName")
+            if not node_name or not _requests_tpu(pod):
+                continue
+            try:
+                node = api.get("Node", node_name, "")
+            except NotFound:
+                continue
+            duty = node.status.get("tpuDutyCycle")
+            if isinstance(duty, (int, float)) and duty > threshold:
+                return clock()  # busy chips = active now
+        return None
+
+    return probe
+
+
+def combined_probe(*probes: ActivityProbe) -> ActivityProbe:
+    """Latest activity across several probes (jupyter HTTP + TPU duty):
+    any one reporting recent activity keeps the notebook alive."""
+
+    def probe(nb: Resource) -> float | None:
+        stamps = [p(nb) for p in probes]
+        stamps = [s for s in stamps if s is not None]
+        return max(stamps) if stamps else None
+
+    return probe
 
 
 class NotebookController:

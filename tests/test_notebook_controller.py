@@ -125,3 +125,142 @@ def test_pending_notebook_not_culled(api):
     _make_nb(api)  # no running pod yet
     ctl.controller.run_until_idle()
     assert STOP_ANNOTATION not in api.get(KIND, "nb", "user1").metadata.annotations
+
+
+# -- production activity probes --------------------------------------------
+
+
+def test_http_activity_probe_reads_jupyter_status():
+    """The culler.go:138 probe against a real HTTP endpoint serving the
+    Jupyter /api/status shape."""
+    import json as _json
+
+    from kubeflow_tpu.controllers.notebook import (
+        http_activity_probe,
+        route_prefix,
+    )
+    from kubeflow_tpu.web.wsgi import App, json_response, serve
+
+    nb = new_resource("Notebook", "nb", "team")
+
+    app = App("fake-jupyter")
+    app.add_route(
+        f"{route_prefix(nb)}/api/status",
+        lambda req: json_response(
+            {"last_activity": "2026-01-02T03:04:05.000000Z"}
+        ),
+    )
+    server, _ = serve(app, host="127.0.0.1", port=0)
+    try:
+        probe = http_activity_probe(
+            base_url=lambda _nb: f"http://127.0.0.1:{server.server_port}"
+        )
+        stamp = probe(nb)
+    finally:
+        server.shutdown()
+    import datetime
+
+    want = datetime.datetime(
+        2026, 1, 2, 3, 4, 5, tzinfo=datetime.timezone.utc
+    ).timestamp()
+    assert stamp == want
+
+
+def test_http_activity_probe_fail_safe():
+    from kubeflow_tpu.controllers.notebook import http_activity_probe
+
+    nb = new_resource("Notebook", "nb", "team")
+    # Nothing listening: unreachable => None (never cull on probe failure).
+    probe = http_activity_probe(
+        base_url=lambda _nb: "http://127.0.0.1:1", timeout=0.2
+    )
+    assert probe(nb) is None
+
+
+def test_tpu_duty_probe_counts_busy_chips_as_activity():
+    from kubeflow_tpu.controllers.notebook import tpu_duty_probe
+
+    api = FakeApiServer()
+    nb = new_resource("Notebook", "nb", "team")
+    node = new_resource("Node", "tpu-0", "", spec={"chips": 4})
+    node.status["tpuDutyCycle"] = 0.9
+    api.create(node)
+    pod = new_resource(
+        "Pod", "nb-0", "team",
+        spec={"nodeName": "tpu-0", "containers": [
+            {"name": "nb", "resources": {"limits": {"google.com/tpu": 4}}}
+        ]},
+        labels={"notebook": "nb"},
+    )
+    pod.status["phase"] = "Running"
+    api.create(pod)
+
+    now = {"t": 1000.0}
+    probe = tpu_duty_probe(api, clock=lambda: now["t"])
+    assert probe(nb) == 1000.0  # busy TPU = active right now
+
+    # A CPU-only notebook on the same (busy) node must NOT ride the
+    # co-tenant's duty cycle.
+    cpu_nb = new_resource("Notebook", "cpu-nb", "team")
+    cpu_pod = new_resource(
+        "Pod", "cpu-nb-0", "team",
+        spec={"nodeName": "tpu-0", "containers": [{"name": "nb"}]},
+        labels={"notebook": "cpu-nb"},
+    )
+    cpu_pod.status["phase"] = "Running"
+    api.create(cpu_pod)
+    assert probe(cpu_nb) is None
+    fresh = api.get("Node", "tpu-0", "")
+    fresh.status["tpuDutyCycle"] = 0.0
+    api.update_status(fresh)
+    assert probe(nb) is None  # idle chips: no claimed activity
+
+
+def test_combined_probe_takes_latest_and_culler_respects_it():
+    """A notebook idle in Jupyter but running TPU kernels must NOT be
+    culled; once the chips idle too, it is."""
+    from kubeflow_tpu.controllers.notebook import (
+        CullerConfig,
+        STOP_ANNOTATION,
+        combined_probe,
+        tpu_duty_probe,
+    )
+
+    api = FakeApiServer()
+    now = {"t": 10_000.0}
+    jupyter_last = {"t": 0.0}  # idle in the UI since t=0
+    ctl = NotebookController(
+        api,
+        culler=CullerConfig(enabled=True, idle_seconds=100.0),
+        activity_probe=combined_probe(
+            lambda nb: jupyter_last["t"],
+            tpu_duty_probe(api, clock=lambda: now["t"]),
+        ),
+        clock=lambda: now["t"],
+    )
+    api.create(new_resource("Notebook", "nb", "team", spec={"image": "i"}))
+    node = new_resource("Node", "tpu-0", "", spec={"chips": 4})
+    node.status["tpuDutyCycle"] = 0.8
+    api.create(node)
+    ctl.controller.run_until_idle()
+    pod = new_resource(
+        "Pod", "nb-0", "team",
+        spec={"nodeName": "tpu-0", "containers": [
+            {"name": "nb", "resources": {"limits": {"google.com/tpu": 4}}}
+        ]},
+        labels={"notebook": "nb"},
+    )
+    pod.status["phase"] = "Running"
+    api.create(pod)
+    ctl.controller.run_until_idle()
+    nb = api.get("Notebook", "nb", "team")
+    assert STOP_ANNOTATION not in nb.metadata.annotations  # chips busy
+
+    fresh = api.get("Node", "tpu-0", "")
+    fresh.status["tpuDutyCycle"] = 0.0
+    api.update_status(fresh)
+    now["t"] += 200.0  # idle everywhere, past IDLE_TIME
+    ctl.controller.enqueue(("team", "nb"))
+    ctl.controller.run_until_idle()
+    nb = api.get("Notebook", "nb", "team")
+    assert STOP_ANNOTATION in nb.metadata.annotations  # culled
