@@ -12,7 +12,8 @@ once the DAG is terminal.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+import re
+from typing import Any, Mapping
 
 KIND = "Workflow"
 
@@ -72,6 +73,11 @@ class WorkflowSpec:
     # Host path every step sees at STEP_ARTIFACTS (the NFS share analog).
     artifacts_dir: str = ""
     parallelism: int = 8
+    # Workflow-level parameters, substituted into step command/args/env as
+    # ${workflow.parameters.<name>} — the Argo templating surface the
+    # reference's jsonnet workflows parameterize with
+    # (workflows.libsonnet's per-workflow params).
+    parameters: dict[str, str] = dataclasses.field(default_factory=dict)
 
     def validate(self) -> None:
         if not self.steps:
@@ -128,6 +134,8 @@ class WorkflowSpec:
             d["onExit"] = self.on_exit.to_dict()
         if self.artifacts_dir:
             d["artifactsDir"] = self.artifacts_dir
+        if self.parameters:
+            d["parameters"] = dict(self.parameters)
         return d
 
     @classmethod
@@ -139,6 +147,76 @@ class WorkflowSpec:
             ),
             artifacts_dir=d.get("artifactsDir", ""),
             parallelism=int(d.get("parallelism", 8)),
+            parameters={
+                str(k): str(v)
+                for k, v in (d.get("parameters") or {}).items()
+            },
         )
         spec.validate()
         return spec
+
+
+_TOKEN_RE = re.compile(
+    r"\$\{workflow\.parameters\.([A-Za-z0-9_.-]+)\}"
+    r"|\$\{steps\.([A-Za-z0-9_.-]+)\.output\}"
+)
+
+
+def render_value(
+    value: str,
+    parameters: Mapping[str, str],
+    outputs: Mapping[str, str],
+    *,
+    partial: bool = False,
+) -> str:
+    """Substitute `${workflow.parameters.<p>}` and `${steps.<s>.output}`
+    in one string.
+
+    One `re.sub` pass over the ORIGINAL string — substituted values are
+    never rescanned, so an output that itself contains template-looking
+    text cannot re-trigger (or fail) rendering. An unresolved reference
+    raises — a typo'd parameter must fail loudly, not launch a step with
+    a literal placeholder — unless `partial=True`, which substitutes what
+    resolves and leaves the rest verbatim (the teardown path: a
+    best-effort render beats none)."""
+
+    def repl(match: re.Match) -> str:
+        param_name, step_name = match.group(1), match.group(2)
+        if param_name is not None and param_name in parameters:
+            return parameters[param_name]
+        if step_name is not None and step_name in outputs:
+            return outputs[step_name]
+        if partial:
+            return match.group(0)
+        raise ValueError(f"unresolved reference {match.group(0)!r}")
+
+    return _TOKEN_RE.sub(repl, value)
+
+
+def render_step(
+    step: StepSpec,
+    parameters: Mapping[str, str],
+    outputs: Mapping[str, str],
+    *,
+    partial: bool = False,
+) -> StepSpec:
+    """The step with all templating applied to command/args/env values.
+
+    `outputs` maps step name → that step's reported output; the
+    controller only creates a step after its dependencies succeeded, so
+    every `${steps.<dep>.output}` a well-formed DAG references exists."""
+    return dataclasses.replace(
+        step,
+        command=tuple(
+            render_value(c, parameters, outputs, partial=partial)
+            for c in step.command
+        ),
+        args=tuple(
+            render_value(a, parameters, outputs, partial=partial)
+            for a in step.args
+        ),
+        env=tuple(
+            (k, render_value(v, parameters, outputs, partial=partial))
+            for k, v in step.env
+        ),
+    )

@@ -79,6 +79,7 @@ def http_activity_probe(
     `last_activity`. Unreachable/garbage ⇒ None (fail-safe: never cull on
     a probe failure). `base_url` overrides the in-cluster
     `http://<name>.<ns>.svc` for local setups/tests."""
+    import http.client
     import json as _json
     import urllib.error
     import urllib.request
@@ -93,7 +94,12 @@ def http_activity_probe(
         try:
             with urllib.request.urlopen(url, timeout=timeout) as resp:
                 body = _json.loads(resp.read())
-        except (urllib.error.URLError, ValueError, OSError):
+        except (
+            urllib.error.URLError,
+            http.client.HTTPException,  # BadStatusLine, IncompleteRead
+            ValueError,
+            OSError,
+        ):
             return None
         if not isinstance(body, dict):
             return None  # valid JSON but not the status object: garbage

@@ -37,6 +37,17 @@ def step_pod_name(workflow: str, step: str, attempt: int) -> str:
     return f"{workflow}-{step}-{attempt}"
 
 
+def report_step_output(api, pod_name: str, namespace: str, output) -> None:
+    """Called by a step process (through HttpApiClient using the POD_NAME
+    env) before exiting 0: stores the step's output on its pod status for
+    downstream `${steps.<name>.output}` rendering — the Argo
+    output-parameter contract, apiserver-reported like a trial's
+    observation."""
+    pod = api.get("Pod", pod_name, namespace)
+    pod.status["output"] = str(output)
+    api.update_status(pod)
+
+
 def next_attempt(attempts: list[Resource]) -> int:
     """max(observed attempt label)+1, NOT len(observed): a deleted
     attempt pod must not make us recreate a name that still exists."""
@@ -83,6 +94,11 @@ class WorkflowController:
         env = dict(step.env)
         env["WORKFLOW_NAME"] = workflow.metadata.name
         env["STEP_NAME"] = step.name
+        # Its own pod name, so the step can report_step_output over the
+        # apiserver facade.
+        env["POD_NAME"] = step_pod_name(
+            workflow.metadata.name, step.name, attempt
+        )
         if spec.artifacts_dir:
             env["STEP_ARTIFACTS"] = spec.artifacts_dir
         pod = new_resource(
@@ -173,16 +189,31 @@ class WorkflowController:
                     state = "Failed"
                 else:
                     state = "Retrying"  # next pass creates attempt N+1
+            # Harvest the step's reported output (report_step_output) from
+            # the succeeded attempt; persisted in status so a GC'd pod
+            # doesn't lose it for downstream template rendering.
+            output = prev_steps.get(step.name, {}).get("output")
+            if state == "Succeeded" and output is None:
+                for p in attempts:
+                    if p.status.get("phase") == "Succeeded":
+                        output = p.status.get("output")
+                        if output is not None:
+                            break
             steps_status[step.name] = {
                 "state": state,
                 "attempts": len(attempts),
                 "failedAttempts": sorted(failed_attempts),
             }
+            if output is not None:
+                steps_status[step.name]["output"] = str(output)
 
         # Schedule: dependencies satisfied, budget left, parallelism cap.
         dag_failed = any(
             s["state"] == "Failed" for s in steps_status.values()
         )
+        outputs = {
+            n: s["output"] for n, s in steps_status.items() if "output" in s
+        }
         for step in spec.steps:
             if active >= spec.parallelism:
                 break
@@ -202,7 +233,23 @@ class WorkflowController:
                 next_attempt(by_step.get(step.name, [])),
                 max(st["failedAttempts"], default=-1) + 1,
             )
-            self._create_step_pod(wf, spec, step, attempt)
+            try:
+                rendered = wf_api.render_step(
+                    step, spec.parameters, outputs
+                )
+            except ValueError as e:
+                # A typo'd parameter/output reference fails the STEP (so
+                # the DAG fails and the exit handler still runs — teardown
+                # must never be skipped), never crash-loops.
+                api.record_event(
+                    wf, "InvalidSpec",
+                    f"step {step.name!r}: {e}", type_="Warning",
+                )
+                st["state"] = "Failed"
+                st["renderError"] = str(e)
+                dag_failed = True
+                continue
+            self._create_step_pod(wf, spec, rendered, attempt)
             st["state"] = "Running"
             st["attempts"] += 1
             active += 1
@@ -228,13 +275,20 @@ class WorkflowController:
                 if p.status.get("phase") == "Failed"
             )
             exit_prev = prev_steps.get(spec.on_exit.name, {}).get("state")
+            # The exit handler renders best-effort (partial=True): on a
+            # failed DAG some referenced outputs may not exist, but every
+            # resolvable value (cluster names, zones) must still land —
+            # teardown runs with the most information available.
+            exit_step = wf_api.render_step(
+                spec.on_exit, spec.parameters, outputs, partial=True
+            )
             if (
                 any(ph == "Succeeded" for ph in exit_phases)
                 or exit_prev == "Succeeded"
             ):
                 exit_state = "Succeeded"
             elif not exit_attempts and not exit_failed:
-                self._create_step_pod(wf, spec, spec.on_exit, 0)
+                self._create_step_pod(wf, spec, exit_step, 0)
                 exit_state = "Running"
             elif any(ph in ("Pending", "Running") for ph in exit_phases):
                 exit_state = "Running"
@@ -242,7 +296,7 @@ class WorkflowController:
                 exit_state = "Failed"
             else:
                 self._create_step_pod(
-                    wf, spec, spec.on_exit,
+                    wf, spec, exit_step,
                     max(
                         next_attempt(exit_attempts),
                         max(exit_failed, default=-1) + 1,

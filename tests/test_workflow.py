@@ -298,3 +298,151 @@ def test_platform_e2e_shape():
         assert "deploy" in s.dependencies
     assert spec.on_exit is not None and spec.on_exit.name == "teardown"
     assert spec.step("deploy").retries == 2
+
+
+# -- parameters + step outputs (the Argo templating surface) ---------------
+
+
+def test_render_step_substitutes_params_and_outputs():
+    from kubeflow_tpu.api.workflow import render_step
+
+    s = StepSpec(
+        name="deploy",
+        command=("deploy", "--project", "${workflow.parameters.project}"),
+        args=("--endpoint", "${steps.provision.output}"),
+        env=(("TARGET", "${workflow.parameters.zone}"),),
+    )
+    out = render_step(
+        s,
+        {"project": "kf-ci", "zone": "us-east5"},
+        {"provision": "10.0.0.7"},
+    )
+    assert out.command == ("deploy", "--project", "kf-ci")
+    assert out.args == ("--endpoint", "10.0.0.7")
+    assert out.env == (("TARGET", "us-east5"),)
+
+
+def test_render_unresolved_reference_raises():
+    from kubeflow_tpu.api.workflow import render_step
+
+    s = StepSpec(name="s", command=("x", "${workflow.parameters.missing}"))
+    with pytest.raises(ValueError, match="unresolved"):
+        render_step(s, {}, {})
+
+
+def test_outputs_flow_between_steps():
+    """provision reports an output; deploy's args render with it; the
+    output also lands in workflow status."""
+    from kubeflow_tpu.controllers.workflow import report_step_output
+
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(name="provision", command=ECHO),
+            StepSpec(
+                name="deploy",
+                command=("deploy", "${steps.provision.output}"),
+                dependencies=("provision",),
+            ),
+        ),
+        parameters={"project": "kf-ci"},
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    (pod,) = pods_for(api, "provision")
+    report_step_output(api, pod.metadata.name, "ci", "endpoint-42")
+    finish(api, pod)
+    ctl.controller.run_until_idle()
+    (deploy_pod,) = pods_for(api, "deploy")
+    container = deploy_pod.spec["containers"][0]
+    assert container["command"] == ["deploy", "endpoint-42"]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["POD_NAME"] == deploy_pod.metadata.name
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["steps"]["provision"]["output"] == "endpoint-42"
+    finish(api, deploy_pod)
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Succeeded"
+
+
+def test_bad_reference_fails_workflow_terminally():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(StepSpec(name="s", command=("x", "${steps.ghost.output}")),),
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Failed"
+    assert "unresolved" in wf.status["steps"]["s"]["renderError"]
+    assert pods_for(api, "s") == []  # the broken step never launched
+
+
+def test_parameters_roundtrip_and_exit_handler_renders():
+    from kubeflow_tpu.api.workflow import WorkflowSpec as WS
+
+    spec = WorkflowSpec(
+        steps=(StepSpec(name="a", command=ECHO),),
+        on_exit=StepSpec(
+            name="teardown",
+            command=("rm", "${workflow.parameters.cluster}"),
+        ),
+        parameters={"cluster": "ci-1"},
+    )
+    again = WS.from_dict(spec.to_dict())
+    assert again.parameters == {"cluster": "ci-1"}
+
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    (pod,) = pods_for(api, "a")
+    finish(api, pod)
+    ctl.controller.run_until_idle()
+    (teardown,) = pods_for(api, "teardown")
+    assert teardown.spec["containers"][0]["command"] == ["rm", "ci-1"]
+
+
+def test_render_failure_still_runs_teardown():
+    """A typo'd reference fails the step and the DAG, but the exit
+    handler STILL runs (teardown must never be skipped) with every
+    resolvable value substituted."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(StepSpec(name="s", command=("x", "${steps.ghost.output}")),),
+        on_exit=StepSpec(
+            name="teardown",
+            command=("rm", "${workflow.parameters.cluster}",
+                     "${steps.s.output}"),
+        ),
+        parameters={"cluster": "ci-1"},
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    (teardown,) = pods_for(api, "teardown")
+    # Resolvable parameter substituted; the genuinely-missing output
+    # stays a literal placeholder rather than nuking the whole render.
+    assert teardown.spec["containers"][0]["command"] == [
+        "rm", "ci-1", "${steps.s.output}"
+    ]
+    finish(api, teardown)
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Failed"
+    assert "unresolved" in wf.status["steps"]["s"]["renderError"]
+
+
+def test_output_containing_template_text_is_safe():
+    """A step output that itself looks like a template must be passed
+    through literally, not rescanned (re.sub never rescans)."""
+    from kubeflow_tpu.api.workflow import render_value
+
+    out = render_value(
+        "use ${steps.gen.output}",
+        {},
+        {"gen": "${workflow.parameters.evil}"},
+    )
+    assert out == "use ${workflow.parameters.evil}"
