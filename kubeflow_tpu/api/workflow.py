@@ -101,8 +101,40 @@ class WorkflowSpec:
                         f"step {s.name!r} depends on unknown step {dep!r}"
                     )
         self._check_acyclic()
+        self._check_output_refs()
         if self.parallelism < 1:
             raise ValueError("parallelism must be >= 1")
+
+    def _check_output_refs(self) -> None:
+        """`${steps.X.output}` is only well-defined when X is a
+        (transitive) dependency — otherwise rendering would succeed or
+        fail depending on step timing. Argo infers dependencies from
+        such references; here they must be declared, and this check makes
+        the omission a load-time error instead of a nondeterministic
+        runtime failure."""
+        deps = {s.name: set(s.dependencies) for s in self.steps}
+
+        def closure(name: str) -> set[str]:
+            seen: set[str] = set()
+            stack = list(deps.get(name, ()))
+            while stack:
+                d = stack.pop()
+                if d not in seen:
+                    seen.add(d)
+                    stack.extend(deps.get(d, ()))
+            return seen
+
+        for s in self.steps:
+            reachable = closure(s.name)
+            for value in (*s.command, *s.args, *(v for _, v in s.env)):
+                for match in _TOKEN_RE.finditer(value):
+                    ref = match.group(2)
+                    if ref is not None and ref not in reachable:
+                        raise ValueError(
+                            f"step {s.name!r} references "
+                            f"${{steps.{ref}.output}} but does not depend "
+                            f"on {ref!r} (declare it in dependencies)"
+                        )
 
     def _check_acyclic(self) -> None:
         deps = {s.name: set(s.dependencies) for s in self.steps}

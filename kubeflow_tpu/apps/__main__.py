@@ -5,7 +5,9 @@ for local development and E2E tests we boot the same set in one process:
 
     python -m kubeflow_tpu.apps [--port-base 8080] [--anonymous me@x.co]
 
-Ports: base+0 dashboard, +1 kfam, +2 jupyter, +3 tensorboards.
+Ports: base+0 dashboard, +1 kfam, +2 jupyter, +3 tensorboards,
++4 apiserver facade (the CLI's default target at the default base;
+with a custom base, point the CLI via KFTPU_SERVER/--server).
 """
 
 from __future__ import annotations
@@ -31,6 +33,7 @@ from kubeflow_tpu.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controllers.tpujob import TpuJobController
 from kubeflow_tpu.controllers.workflow import WorkflowController
 from kubeflow_tpu.runtime import LocalPodRunner, WorkloadMaterializer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 from kubeflow_tpu.web.authn import HeaderAuthn
 from kubeflow_tpu.web.wsgi import serve
@@ -131,6 +134,10 @@ def main() -> None:
         KfamApp(api, authn=authn),
         JupyterApp(api, authn=authn),
         TensorboardsApp(api, authn=authn),
+        # The raw apiserver facade (base+4): the kubectl-analog CLI's
+        # target (`python -m kubeflow_tpu.cli --server ...`) and the
+        # /debug/traces drain. In-cluster trust domain — local use only.
+        ApiServerApp(api),
     ]
     servers = []
     for offset, app in enumerate(apps):

@@ -1,0 +1,212 @@
+"""kubectl-style CLI for the platform API.
+
+The reference assumed `kubectl`/`ks` for every operator interaction;
+this is the equivalent surface against the platform's own apiserver
+facade (`testing/apiserver_http.ApiServerApp`):
+
+    python -m kubeflow_tpu.cli get notebooks -n team
+    python -m kubeflow_tpu.cli get tpujobs train-resnet -n ml -o yaml
+    python -m kubeflow_tpu.cli apply -f job.yaml
+    python -m kubeflow_tpu.cli delete notebook nb1 -n team
+    python -m kubeflow_tpu.cli traces
+
+Server discovery: --server or KFTPU_SERVER (default
+http://127.0.0.1:18084). Kinds accept kubectl-ish aliases
+(notebooks/notebook/nb → Notebook, tpujobs/tj → TpuJob, ...); unknown
+kinds pass through verbatim so new CRDs need no CLI release.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import yaml
+
+from kubeflow_tpu.api.objects import Resource
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+from kubeflow_tpu.testing.fake_apiserver import ApiError
+
+# Matches `python -m kubeflow_tpu.apps` default (--port-base 8080, facade
+# at base+4). Override with --server / KFTPU_SERVER.
+DEFAULT_SERVER = "http://127.0.0.1:8084"
+
+ALIASES = {
+    "notebook": "Notebook", "notebooks": "Notebook", "nb": "Notebook",
+    "tpujob": "TpuJob", "tpujobs": "TpuJob", "tj": "TpuJob",
+    "profile": "Profile", "profiles": "Profile",
+    "tensorboard": "Tensorboard", "tensorboards": "Tensorboard",
+    "tb": "Tensorboard",
+    "study": "Study", "studies": "Study",
+    "workflow": "Workflow", "workflows": "Workflow", "wf": "Workflow",
+    "pod": "Pod", "pods": "Pod",
+    "node": "Node", "nodes": "Node",
+    "pvc": "PersistentVolumeClaim", "pvcs": "PersistentVolumeClaim",
+    "snapshot": "VolumeSnapshot", "snapshots": "VolumeSnapshot",
+    "poddefault": "PodDefault", "poddefaults": "PodDefault",
+    "event": "Event", "events": "Event",
+    "service": "Service", "services": "Service", "svc": "Service",
+    "deployment": "Deployment", "deployments": "Deployment",
+    "statefulset": "StatefulSet", "statefulsets": "StatefulSet",
+    "sts": "StatefulSet",
+}
+
+
+def resolve_kind(raw: str) -> str:
+    return ALIASES.get(raw.lower(), raw)
+
+
+def _emit(obj, fmt: str) -> None:
+    if fmt == "json":
+        print(json.dumps(obj, indent=2, default=str))
+    else:
+        print(yaml.safe_dump(obj, sort_keys=False), end="")
+
+
+def _phase(res: Resource) -> str:
+    status = res.status or {}
+    for key in ("phase", "containerState", "state"):
+        if status.get(key):
+            return str(status[key])
+    if status.get("readyReplicas"):
+        return "Ready"
+    return ""
+
+
+def cmd_get(client: HttpApiClient, args) -> int:
+    kind = resolve_kind(args.kind)
+    if args.name:
+        res = client.get(kind, args.name, args.namespace or "default",
+                         version=args.api_version)
+        _emit(res.to_dict(), args.output or "yaml")
+        return 0
+    # Lists default to ALL namespaces (the table shows the namespace
+    # column anyway, and cluster-scoped kinds live in ""); -n narrows.
+    items = client.list(kind, namespace=args.namespace,
+                        version=args.api_version)
+    if args.output in ("yaml", "json"):
+        _emit([r.to_dict() for r in items], args.output)
+        return 0
+    rows = [
+        (r.metadata.namespace, r.metadata.name, _phase(r)) for r in items
+    ]
+    widths = [
+        max([len(h)] + [len(row[i]) for row in rows])
+        for i, h in enumerate(("NAMESPACE", "NAME", "STATUS"))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format("NAMESPACE", "NAME", "STATUS"))
+    for row in rows:
+        print(fmt.format(*row))
+    return 0
+
+
+def cmd_apply(client: HttpApiClient, args) -> int:
+    text = (
+        sys.stdin.read() if args.filename == "-"
+        else open(args.filename).read()
+    )
+    rc = 0
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        res = Resource.from_dict(doc)
+        try:
+            client.create(res)
+            action = "created"
+        except ApiError:
+            try:
+                current = client.get(
+                    res.kind, res.metadata.name, res.metadata.namespace
+                )
+                res.metadata.resource_version = (
+                    current.metadata.resource_version
+                )
+                res.metadata.uid = current.metadata.uid
+                client.update(res)
+                action = "configured"
+            except ApiError as e:
+                print(f"error: {res.kind}/{res.metadata.name}: {e}",
+                      file=sys.stderr)
+                rc = 1
+                continue
+        print(f"{res.kind.lower()}/{res.metadata.name} {action}")
+    return rc
+
+
+def cmd_delete(client: HttpApiClient, args) -> int:
+    kind = resolve_kind(args.kind)
+    client.delete(kind, args.name, args.namespace)
+    print(f"{kind.lower()}/{args.name} deleted")
+    return 0
+
+
+def cmd_traces(client: HttpApiClient, args) -> int:
+    data = client._call("GET", "/debug/traces")
+    for span in data.get("spans", []):
+        dur = span.get("durationMs")
+        dur_s = f"{dur:8.2f}ms" if isinstance(dur, (int, float)) else "    ?   "
+        attrs = " ".join(
+            f"{k}={v}" for k, v in (span.get("attributes") or {}).items()
+        )
+        err = f"  ERROR {span['error']}" if span.get("error") else ""
+        print(f"{span['traceId']}  {dur_s}  {span['name']:<10} {attrs}{err}")
+    if data.get("dropped"):
+        print(f"# {data['dropped']} spans dropped (collector overflow)",
+              file=sys.stderr)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu")
+    parser.add_argument(
+        "--server",
+        default=os.environ.get("KFTPU_SERVER", DEFAULT_SERVER),
+        help="apiserver facade URL (env KFTPU_SERVER)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    get = sub.add_parser("get", help="list a kind or fetch one object")
+    get.add_argument("kind")
+    get.add_argument("name", nargs="?")
+    get.add_argument("-n", "--namespace", default=None,
+                     help="narrow lists / locate a named object "
+                     "(default: all namespaces for lists, 'default' "
+                     "for a named get)")
+    get.add_argument("-o", "--output", choices=("yaml", "json"))
+    get.add_argument("--api-version", dest="api_version",
+                     help="read at a served CRD version (e.g. v1alpha1)")
+    get.set_defaults(fn=cmd_get)
+
+    apply_p = sub.add_parser("apply", help="create-or-update from YAML")
+    apply_p.add_argument("-f", "--filename", required=True,
+                         help="YAML file ('-' = stdin; multi-doc ok)")
+    apply_p.set_defaults(fn=cmd_apply)
+
+    delete = sub.add_parser("delete", help="delete one object")
+    delete.add_argument("kind")
+    delete.add_argument("name")
+    delete.add_argument("-n", "--namespace", default="default")
+    delete.set_defaults(fn=cmd_delete)
+
+    traces = sub.add_parser("traces", help="drain control-plane trace spans")
+    traces.set_defaults(fn=cmd_traces)
+
+    args = parser.parse_args(argv)
+    client = HttpApiClient(args.server)
+    try:
+        return args.fn(client, args)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        return 0  # downstream pager/head closed the pipe; not an error
+    except OSError as e:
+        print(f"error: cannot reach {args.server}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,11 +94,12 @@ class WorkflowController:
         env = dict(step.env)
         env["WORKFLOW_NAME"] = workflow.metadata.name
         env["STEP_NAME"] = step.name
-        # Its own pod name, so the step can report_step_output over the
-        # apiserver facade.
+        # Its own pod coordinates, so the step can report_step_output over
+        # the apiserver facade.
         env["POD_NAME"] = step_pod_name(
             workflow.metadata.name, step.name, attempt
         )
+        env["POD_NAMESPACE"] = workflow.metadata.namespace
         if spec.artifacts_dir:
             env["STEP_ARTIFACTS"] = spec.artifacts_dir
         pod = new_resource(
@@ -174,13 +175,19 @@ class WorkflowController:
                 if p.status.get("phase") == "Failed"
             )
             state = "Pending"
+            render_error = prev_steps.get(step.name, {}).get("renderError")
             # Success persists in status too: a GC'd Succeeded pod must
             # not make a completed step (and its side effects) re-run.
+            # A render failure persists the same way — re-deriving it
+            # every pass would flip the status and spam InvalidSpec
+            # events until the DAG drains.
             if (
                 any(ph == "Succeeded" for ph in phases)
                 or prev_steps.get(step.name, {}).get("state") == "Succeeded"
             ):
                 state = "Succeeded"
+            elif render_error:
+                state = "Failed"
             elif any(ph in ("Pending", "Running") for ph in phases):
                 state = "Running"
                 active += 1
@@ -204,6 +211,8 @@ class WorkflowController:
                 "attempts": len(attempts),
                 "failedAttempts": sorted(failed_attempts),
             }
+            if render_error:
+                steps_status[step.name]["renderError"] = render_error
             if output is not None:
                 steps_status[step.name]["output"] = str(output)
 

@@ -1,0 +1,107 @@
+"""kubectl-analog CLI against a live apiserver facade."""
+
+import io
+import sys
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.cli import main, resolve_kind
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.wsgi import serve
+
+
+@pytest.fixture
+def server():
+    api = FakeApiServer()
+    httpd, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    yield api, f"http://127.0.0.1:{httpd.server_port}"
+    httpd.shutdown()
+
+
+def run(server_url, *argv, stdin=None):
+    out, err = io.StringIO(), io.StringIO()
+    old = sys.stdout, sys.stderr, sys.stdin
+    sys.stdout, sys.stderr = out, err
+    if stdin is not None:
+        sys.stdin = io.StringIO(stdin)
+    try:
+        rc = main(["--server", server_url, *argv])
+    finally:
+        sys.stdout, sys.stderr, sys.stdin = old
+    return rc, out.getvalue(), err.getvalue()
+
+
+def test_kind_aliases():
+    assert resolve_kind("notebooks") == "Notebook"
+    assert resolve_kind("tj") == "TpuJob"
+    assert resolve_kind("FancyNewKind") == "FancyNewKind"  # pass-through
+
+
+def test_get_table_and_yaml(server):
+    api, url = server
+    nb = new_resource("Notebook", "nb1", "team", spec={"image": "i"})
+    nb.status = {"containerState": "Running"}
+    api.create(nb)
+    rc, out, _ = run(url, "get", "notebooks", "-n", "team")
+    assert rc == 0
+    assert "NAMESPACE" in out and "nb1" in out and "Running" in out
+    rc, out, _ = run(url, "get", "nb", "nb1", "-n", "team", "-o", "yaml")
+    assert rc == 0 and "image: i" in out
+
+
+def test_get_at_api_version(server):
+    api, url = server
+    api.create(new_resource("Notebook", "nb2", "team", spec={"image": "x"}))
+    rc, out, _ = run(url, "get", "notebook", "nb2", "-n", "team",
+                     "--api-version", "v1alpha1")
+    assert rc == 0 and "containerImage: x" in out
+
+
+def test_apply_create_then_configure(server):
+    api, url = server
+    doc = """
+apiVersion: kubeflow-tpu.org/v1
+kind: Notebook
+metadata: {name: nb3, namespace: team}
+spec: {image: first}
+"""
+    rc, out, _ = run(url, "apply", "-f", "-", stdin=doc)
+    assert rc == 0 and "notebook/nb3 created" in out
+    rc, out, _ = run(url, "apply", "-f", "-",
+                     stdin=doc.replace("first", "second"))
+    assert rc == 0 and "notebook/nb3 configured" in out
+    assert api.get("Notebook", "nb3", "team").spec["image"] == "second"
+
+
+def test_delete_and_missing_is_error(server):
+    api, url = server
+    api.create(new_resource("Notebook", "nb4", "team"))
+    rc, out, _ = run(url, "delete", "notebook", "nb4", "-n", "team")
+    assert rc == 0 and "deleted" in out
+    rc, _, err = run(url, "delete", "notebook", "nb4", "-n", "team")
+    assert rc == 1 and "not found" in err
+
+
+def test_traces_listing(server):
+    api, url = server
+    api.create(new_resource("Notebook", "nb5", "team"))
+    rc, out, _ = run(url, "traces")
+    assert rc == 0
+    assert "http" in out  # the create request's span
+
+
+def test_unreachable_server_is_clean_error():
+    rc, _, err = run("http://127.0.0.1:1", "get", "notebooks")
+    assert rc == 1 and "cannot reach" in err
+
+
+def test_cluster_scoped_kinds_listed_by_default(server):
+    api, url = server
+    api.create(new_resource("Node", "tpu-0", ""))
+    rc, out, _ = run(url, "get", "nodes")
+    assert rc == 0 and "tpu-0" in out
+    # -n narrows to a namespace (and so hides cluster-scoped objects).
+    rc, out, _ = run(url, "get", "nodes", "-n", "team")
+    assert rc == 0 and "tpu-0" not in out

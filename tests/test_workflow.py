@@ -366,18 +366,29 @@ def test_outputs_flow_between_steps():
     assert api.get(KIND, "wf", "ci").status["phase"] == "Succeeded"
 
 
-def test_bad_reference_fails_workflow_terminally():
+def test_undeclared_output_reference_rejected_at_load():
+    """${steps.X.output} without depending on X would succeed or fail on
+    step timing — a load-time error instead (Argo infers such deps)."""
+    with pytest.raises(ValueError, match="does not depend"):
+        WorkflowSpec(
+            steps=(
+                StepSpec(name="c", command=ECHO),
+                StepSpec(name="s", command=("x", "${steps.c.output}")),
+            ),
+        ).validate()
+    # Through the controller: terminal InvalidSpec, nothing launched.
     api = FakeApiServer()
     ctl = WorkflowController(api)
-    spec = WorkflowSpec(
-        steps=(StepSpec(name="s", command=("x", "${steps.ghost.output}")),),
-    )
-    make_workflow(api, spec)
+    api.create(new_resource(KIND, "wf", "ci", spec={
+        "steps": [
+            {"name": "c", "command": ["x"]},
+            {"name": "s", "command": ["x", "${steps.c.output}"]},
+        ]}))
     ctl.controller.run_until_idle()
     wf = api.get(KIND, "wf", "ci")
     assert wf.status["phase"] == "Failed"
-    assert "unresolved" in wf.status["steps"]["s"]["renderError"]
-    assert pods_for(api, "s") == []  # the broken step never launched
+    assert "does not depend" in wf.status["reason"]
+    assert pods_for(api, "s") == []
 
 
 def test_parameters_roundtrip_and_exit_handler_renders():
@@ -406,33 +417,47 @@ def test_parameters_roundtrip_and_exit_handler_renders():
 
 
 def test_render_failure_still_runs_teardown():
-    """A typo'd reference fails the step and the DAG, but the exit
-    handler STILL runs (teardown must never be skipped) with every
-    resolvable value substituted."""
+    """The remaining RUNTIME render failure: a dependency succeeded but
+    never reported an output. The referencing step fails, the DAG fails,
+    but the exit handler STILL runs (teardown must never be skipped)
+    with every resolvable value substituted — and the render failure
+    persists in status (no event spam across reconciles)."""
     api = FakeApiServer()
     ctl = WorkflowController(api)
     spec = WorkflowSpec(
-        steps=(StepSpec(name="s", command=("x", "${steps.ghost.output}")),),
+        steps=(
+            StepSpec(name="s", command=ECHO),
+            StepSpec(name="use", command=("x", "${steps.s.output}"),
+                     dependencies=("s",)),
+        ),
         on_exit=StepSpec(
             name="teardown",
             command=("rm", "${workflow.parameters.cluster}",
-                     "${steps.s.output}"),
+                     "${steps.use.output}"),
         ),
         parameters={"cluster": "ci-1"},
     )
     make_workflow(api, spec)
     ctl.controller.run_until_idle()
+    (s_pod,) = pods_for(api, "s")
+    finish(api, s_pod)  # Succeeded, but no output reported
+    ctl.controller.run_until_idle()
     (teardown,) = pods_for(api, "teardown")
     # Resolvable parameter substituted; the genuinely-missing output
     # stays a literal placeholder rather than nuking the whole render.
     assert teardown.spec["containers"][0]["command"] == [
-        "rm", "ci-1", "${steps.s.output}"
+        "rm", "ci-1", "${steps.use.output}"
     ]
+    wf = api.get(KIND, "wf", "ci")
+    assert "unresolved" in wf.status["steps"]["use"]["renderError"]
+    # The render failure persisted: another pass emits no new event.
+    events_before = len(api.list("Event", "ci"))
+    ctl.controller.enqueue(("ci", "wf"))
+    ctl.controller.run_until_idle()
+    assert len(api.list("Event", "ci")) == events_before
     finish(api, teardown)
     ctl.controller.run_until_idle()
-    wf = api.get(KIND, "wf", "ci")
-    assert wf.status["phase"] == "Failed"
-    assert "unresolved" in wf.status["steps"]["s"]["renderError"]
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Failed"
 
 
 def test_output_containing_template_text_is_safe():
