@@ -11,7 +11,7 @@ facade (`testing/apiserver_http.ApiServerApp`):
     python -m kubeflow_tpu.cli traces
 
 Server discovery: --server or KFTPU_SERVER (default
-http://127.0.0.1:18084). Kinds accept kubectl-ish aliases
+http://127.0.0.1:8084). Kinds accept kubectl-ish aliases
 (notebooks/notebook/nb → Notebook, tpujobs/tj → TpuJob, ...); unknown
 kinds pass through verbatim so new CRDs need no CLI release.
 """
@@ -27,7 +27,11 @@ import yaml
 
 from kubeflow_tpu.api.objects import Resource
 from kubeflow_tpu.testing.apiserver_http import HttpApiClient
-from kubeflow_tpu.testing.fake_apiserver import ApiError
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    ApiError,
+    Conflict,
+)
 
 # Matches `python -m kubeflow_tpu.apps` default (--port-base 8080, facade
 # at base+4). Override with --server / KFTPU_SERVER.
@@ -114,10 +118,13 @@ def cmd_apply(client: HttpApiClient, args) -> int:
             continue
         res = Resource.from_dict(doc)
         try:
-            client.create(res)
-            action = "created"
-        except ApiError:
             try:
+                client.create(res)
+                action = "created"
+            # Only "it exists already" falls through to update; anything
+            # else (e.g. 422 validation) is the create's real error and
+            # must surface as such, not as the fallback get's NotFound.
+            except (AlreadyExists, Conflict):
                 current = client.get(
                     res.kind, res.metadata.name, res.metadata.namespace
                 )
@@ -127,11 +134,11 @@ def cmd_apply(client: HttpApiClient, args) -> int:
                 res.metadata.uid = current.metadata.uid
                 client.update(res)
                 action = "configured"
-            except ApiError as e:
-                print(f"error: {res.kind}/{res.metadata.name}: {e}",
-                      file=sys.stderr)
-                rc = 1
-                continue
+        except ApiError as e:
+            print(f"error: {res.kind}/{res.metadata.name}: {e}",
+                  file=sys.stderr)
+            rc = 1
+            continue
         print(f"{res.kind.lower()}/{res.metadata.name} {action}")
     return rc
 

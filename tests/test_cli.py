@@ -75,6 +75,23 @@ spec: {image: first}
     assert api.get("Notebook", "nb3", "team").spec["image"] == "second"
 
 
+def test_apply_invalid_create_surfaces_real_error(server):
+    # A new object written at an unserved version is a 422 — the CLI must
+    # report the validation failure, not fall through to get+update and
+    # mask it behind "not found" (ADVICE r1).
+    _, url = server
+    doc = """
+apiVersion: kubeflow-tpu.org/v9000
+kind: Notebook
+metadata: {name: nb-bad, namespace: team}
+spec: {image: x}
+"""
+    rc, out, err = run(url, "apply", "-f", "-", stdin=doc)
+    assert rc == 1
+    assert "not found" not in err
+    assert "v9000" in err
+
+
 def test_delete_and_missing_is_error(server):
     api, url = server
     api.create(new_resource("Notebook", "nb4", "team"))
