@@ -7,8 +7,9 @@ HTTP (`openmpi-controller/controller/util.py` uses the kubernetes client;
 processes* (sidecar CLI, e2e workers, probers) get the same boundary:
 
     GET    /apis/<kind>                      ?namespace=&labelSelector=k=v&version=
+    GET    /apis/<kind>?watch=true           &resourceVersion=N&timeoutSeconds=S
     GET    /apis/<kind>/<ns>/<name>          ('_' namespace = cluster scope; ?version=)
-    POST   /apis/<kind>
+    POST   /apis/<kind>                      (?apply=true → create-or-update)
     PUT    /apis/<kind>/<ns>/<name>[/status]
     DELETE /apis/<kind>/<ns>/<name>
 
@@ -16,8 +17,18 @@ Multi-version kinds: POST/PUT bodies may carry any served apiVersion
 (storage normalizes to the hub version); GETs pass `?version=` to read at
 a specific served version.
 
+Watch semantics match the real apiserver's (the reference's controllers
+are watch-driven across process boundaries — controller-runtime's
+`SetupWithManager`, `notebook_controller.go:516`): a long-poll returns
+events with rv > resourceVersion plus the rv to resume from; a bookmark
+older than the journal horizon gets 410 Gone, and the client recovers the
+way an informer does (re-list, deliver synthetic events, re-watch).
+
 `HttpApiClient` mirrors the FakeApiServer method surface (get/list/create/
-update/update_status/delete) so controller-side code is client-agnostic.
+update/update_status/delete/apply/record_event/watch) so controller-side
+code — including `controllers/runtime.Controller` — is client-agnostic:
+the same reconciler binary runs in-process against the store or in a
+separate process against this facade.
 """
 
 from __future__ import annotations
@@ -27,15 +38,22 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
-from kubeflow_tpu.api.objects import Resource
+import logging
+import threading
+
+from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid
 from kubeflow_tpu.utils import tracing
 from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
     Conflict,
     FakeApiServer,
+    Gone,
     Invalid,
     NotFound,
+    WatchHandler,
 )
+
+log = logging.getLogger(__name__)
 from kubeflow_tpu.web.wsgi import App, HttpError, Request, Response, json_response
 
 
@@ -78,6 +96,8 @@ class ApiServerApp(App):
         )
 
     def list_kind(self, req: Request) -> Response:
+        if req.query.get("watch") in ("true", "1"):
+            return self._watch(req)
         selector = None
         if "labelSelector" in req.query:
             selector = dict(
@@ -86,13 +106,54 @@ class ApiServerApp(App):
                 if "=" in part
             )
         namespace = req.query.get("namespace")
+        # The list's rv is the watch bookmark (informer list-then-watch).
+        # Read it BEFORE listing: an object committed between the two
+        # reads is then re-delivered by the watch (at-least-once), whereas
+        # rv-after-list would place it behind the bookmark and lose it.
+        rv = self.api.current_rv
         items = self.api.list(
             req.path_params["kind"],
             namespace=_seg_ns(namespace) if namespace is not None else None,
             label_selector=selector,
         )
         items = [self._at_version(r, req) for r in items]
-        return json_response({"items": [r.to_dict() for r in items]})
+        return json_response(
+            {
+                "items": [r.to_dict() for r in items],
+                "resourceVersion": rv,
+            }
+        )
+
+    def _watch(self, req: Request) -> Response:
+        """Long-poll watch: block until events land past the bookmark (or
+        timeoutSeconds), return them with the rv to resume from. `_` as
+        the kind watches everything (the client multiplexes one stream
+        across all its registered handlers)."""
+        try:
+            since = int(req.query.get("resourceVersion", "0"))
+        except ValueError:
+            raise HttpError(400, "resourceVersion must be an integer")
+        timeout = min(float(req.query.get("timeoutSeconds", "10")), 60.0)
+        kind = req.path_params["kind"]
+        namespace = req.query.get("namespace")
+        try:
+            events, rv = self.api.wait_events(
+                since,
+                kind=None if kind == "_" else kind,
+                namespace=_seg_ns(namespace) if namespace is not None else None,
+                timeout=timeout,
+            )
+        except Gone as e:
+            raise HttpError(410, str(e))
+        return json_response(
+            {
+                "events": [
+                    {"type": ev, "rv": ev_rv, "object": obj.to_dict()}
+                    for ev_rv, ev, obj in events
+                ],
+                "resourceVersion": rv,
+            }
+        )
 
     def _at_version(self, obj: Resource, req: Request) -> Resource:
         version = req.query.get("version")
@@ -114,6 +175,11 @@ class ApiServerApp(App):
         obj = Resource.from_dict(req.json())
         if obj.kind != req.path_params["kind"]:
             raise HttpError(400, "kind mismatch between path and body")
+        if req.query.get("apply") in ("true", "1"):
+            # Server-side apply: create-or-update with the store's own
+            # no-op detection (post-admission, post-conversion compare) so
+            # remote reconcilers don't re-trigger their own watches.
+            return json_response(self.api.apply(obj).to_dict())
         return json_response(self.api.create(obj).to_dict(), status=201)
 
     def _body_matching_path(self, req: Request) -> Resource:
@@ -148,11 +214,30 @@ class ApiServerApp(App):
 
 
 class HttpApiClient:
-    """Remote twin of FakeApiServer's CRUD surface."""
+    """Remote twin of FakeApiServer's CRUD + watch surface.
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    `watch()` makes this a real informer client: one multiplexed
+    long-poll stream feeds every registered handler, resuming from the
+    last seen resourceVersion across reconnects and recovering from 410
+    Gone via list-then-rewatch (synthetic MODIFIED events). A
+    `controllers/runtime.Controller` built over this client is therefore
+    event-driven across the process boundary — zero list polling."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 10.0,
+        watch_poll_timeout: float = 5.0,
+        watch_retry: float = 0.5,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.watch_poll_timeout = watch_poll_timeout
+        self.watch_retry = watch_retry
+        self._watchers: list[tuple[str | None, WatchHandler]] = []
+        self._watch_lock = threading.Lock()
+        self._watch_thread: threading.Thread | None = None
+        self._closed = threading.Event()
 
     def _call(self, method: str, path: str, body: dict | None = None) -> dict:
         req = urllib.request.Request(
@@ -179,6 +264,8 @@ class HttpApiClient:
                 if "already exists" in detail:
                     raise AlreadyExists(detail)
                 raise Conflict(detail)
+            if e.code == 410:
+                raise Gone(detail)
             if e.code == 422:
                 raise Invalid(detail)
             raise
@@ -242,3 +329,131 @@ class HttpApiClient:
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         self._call("DELETE", f"/apis/{kind}/{_ns_seg(namespace)}/{name}")
+
+    def apply(self, obj: Resource) -> Resource:
+        """Create-or-update, evaluated server-side (the store's compare is
+        post-admission/post-conversion, so a remote reconciler's apply
+        no-ops exactly when an in-process one would)."""
+        return Resource.from_dict(
+            self._call("POST", f"/apis/{obj.kind}?apply=true", obj.to_dict())
+        )
+
+    def record_event(
+        self,
+        about: Resource,
+        reason: str,
+        message: str,
+        *,
+        type_: str = "Normal",
+    ) -> Resource:
+        """Same Event shape FakeApiServer.record_event emits
+        (`notebook_controller.go:87-103` event mirroring works unchanged
+        from a remote controller)."""
+        ev = Resource(
+            kind="Event",
+            metadata=ObjectMeta(
+                name=f"{about.metadata.name}.{fresh_uid()[:8]}",
+                namespace=about.metadata.namespace,
+            ),
+            spec={
+                "involvedObject": {
+                    "kind": about.kind,
+                    "name": about.metadata.name,
+                    "uid": about.metadata.uid,
+                },
+                "reason": reason,
+                "message": message,
+                "type": type_,
+            },
+            status={},
+        )
+        return self.create(ev)
+
+    # -- watch (informer client) ------------------------------------------
+
+    def watch(self, handler: WatchHandler, kind: str | None = None) -> None:
+        """Register a handler; the first registration starts the stream.
+        Initial sync delivers every existing object of each concretely
+        watched kind as a synthetic MODIFIED (list-then-watch), so a
+        controller starting late still reconciles pre-existing objects."""
+        with self._watch_lock:
+            self._watchers.append((kind, handler))
+            started = self._watch_thread is None
+            if started:
+                self._watch_thread = threading.Thread(
+                    target=self._watch_loop,
+                    name="apiclient-watch",
+                    daemon=True,
+                )
+                self._watch_thread.start()
+        if not started and kind is not None:
+            # Late registration: the running stream's bookmark may already
+            # be past this kind's existing objects, and the initial resync
+            # never listed it. Deliver current state now — possibly
+            # duplicating a concurrent stream delivery, which level-
+            # triggered consumers tolerate by construction.
+            try:
+                data = self._call("GET", f"/apis/{kind}")
+                for item in data["items"]:
+                    self._dispatch("MODIFIED", Resource.from_dict(item))
+            except Exception:
+                log.debug(
+                    "late-registration sync for %s failed", kind,
+                    exc_info=True,
+                )
+
+    def close(self) -> None:
+        self._closed.set()
+
+    def _dispatch(self, event: str, obj: Resource) -> None:
+        for kind, handler in list(self._watchers):
+            if kind is None or kind == obj.kind:
+                try:
+                    handler(event, obj)
+                except Exception:
+                    log.exception("watch handler failed for %s %s",
+                                  event, obj.key)
+
+    def _resync(self) -> int:
+        """List every concretely watched kind, delivering synthetic
+        MODIFIED events; returns the rv to watch from. The bookmark is the
+        FIRST list's rv, so anything committed mid-resync is re-delivered
+        by the subsequent watch — at-least-once, which level-triggered
+        reconcilers tolerate by construction."""
+        with self._watch_lock:
+            kinds = {k for k, _ in self._watchers if k is not None}
+        rv: int | None = None
+        for kind in sorted(kinds):
+            data = self._call("GET", f"/apis/{kind}")
+            if rv is None:
+                rv = data.get("resourceVersion", 0)
+            for item in data["items"]:
+                self._dispatch("MODIFIED", Resource.from_dict(item))
+        return rv if rv is not None else 0
+
+    def _watch_loop(self) -> None:
+        rv = None
+        while not self._closed.is_set():
+            try:
+                if rv is None:
+                    rv = self._resync()
+                params = urllib.parse.urlencode(
+                    {
+                        "watch": "true",
+                        "resourceVersion": rv,
+                        "timeoutSeconds": self.watch_poll_timeout,
+                    }
+                )
+                data = self._call("GET", f"/apis/_?{params}")
+            except Gone:
+                rv = None  # journal horizon passed us — relist
+                continue
+            except Exception:
+                if self._closed.is_set():
+                    return
+                log.debug("watch stream error; retrying", exc_info=True)
+                self._closed.wait(self.watch_retry)
+                continue
+            rv = data["resourceVersion"]
+            for ev in data["events"]:
+                self._dispatch(ev["type"], Resource.from_dict(ev["object"]))

@@ -22,6 +22,7 @@ Thread-safe; watch delivery is synchronous (deterministic tests).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterable
 
 from kubeflow_tpu.api import versioning
@@ -50,17 +51,31 @@ class Invalid(ApiError):
     pass
 
 
+class Gone(ApiError):
+    """The requested resourceVersion predates the journal's oldest entry
+    (the real apiserver's HTTP 410 on an expired watch bookmark). Clients
+    recover the way informers do: re-list, then watch from the list's
+    resourceVersion."""
+
+
 def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
 class FakeApiServer:
-    def __init__(self):
+    def __init__(self, *, journal_size: int = 10_000):
         self._objects: dict[tuple[str, str, str], Resource] = {}
         self._rv = 0
         self._lock = threading.RLock()
         self._watchers: list[tuple[str | None, WatchHandler]] = []
         self._admission: list[tuple[str | None, Callable[[Resource], Resource]]] = []
+        # Resumable event journal: (resourceVersion, event, object), rv-
+        # ordered. This is what the real apiserver keeps in etcd's event
+        # history and serves on `GET ...?watch=true&resourceVersion=N`;
+        # bounded, with Gone (410) past the horizon.
+        self._journal: list[tuple[int, str, Resource]] = []
+        self._journal_size = journal_size
+        self._journal_cv = threading.Condition(self._lock)
 
     # -- admission --------------------------------------------------------
 
@@ -88,9 +103,72 @@ class FakeApiServer:
             self._watchers.append((kind, handler))
 
     def _emit(self, event: str, obj: Resource) -> None:
+        # Journal under the lock (all callers hold it) so journal order is
+        # resourceVersion order — a watcher resuming from rv N can never
+        # miss an event that commits with rv > N after N was served.
+        with self._journal_cv:
+            self._journal.append(
+                (obj.metadata.resource_version, event, obj.deepcopy())
+            )
+            if len(self._journal) > self._journal_size:
+                del self._journal[: -self._journal_size]
+            self._journal_cv.notify_all()
         for kind, handler in list(self._watchers):
             if kind is None or kind == obj.kind:
                 handler(event, obj.deepcopy())
+
+    @property
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def events_since(
+        self,
+        resource_version: int,
+        kind: str | None = None,
+        namespace: str | None = None,
+    ) -> tuple[list[tuple[int, str, Resource]], int]:
+        """Journal entries with rv > resource_version, filtered; plus the
+        server's current rv (the resume point even when nothing matched
+        the filter). Raises Gone when the bookmark predates the journal."""
+        with self._lock:
+            if self._journal and resource_version < self._journal[0][0] - 1:
+                raise Gone(
+                    f"resourceVersion {resource_version} is too old "
+                    f"(journal begins at {self._journal[0][0]})"
+                )
+            out = [
+                (rv, event, obj.deepcopy())
+                for rv, event, obj in self._journal
+                if rv > resource_version
+                and (kind is None or obj.kind == kind)
+                and (
+                    namespace is None
+                    or obj.metadata.namespace == namespace
+                )
+            ]
+            return out, self._rv
+
+    def wait_events(
+        self,
+        resource_version: int,
+        kind: str | None = None,
+        namespace: str | None = None,
+        timeout: float = 10.0,
+    ) -> tuple[list[tuple[int, str, Resource]], int]:
+        """Long-poll form of events_since: block until at least one
+        matching event lands past the bookmark, or the timeout passes
+        (returning an empty batch with the current rv)."""
+        deadline = time.monotonic() + timeout
+        with self._journal_cv:
+            while True:
+                events, rv = self.events_since(resource_version, kind, namespace)
+                if events:
+                    return events, rv
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], rv
+                self._journal_cv.wait(remaining)
 
     # -- CRUD -------------------------------------------------------------
 
@@ -124,7 +202,7 @@ class FakeApiServer:
             stored.metadata.creation_timestamp = now()
             self._objects[key] = stored
             out = stored.deepcopy()
-        self._emit("ADDED", stored)
+            self._emit("ADDED", stored)
         return out
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
@@ -189,10 +267,10 @@ class FakeApiServer:
             self._objects[key] = stored
             deleted = self._maybe_finalize(stored)
             out = stored.deepcopy()
-        if deleted:
-            self._emit("DELETED", stored)
-        else:
-            self._emit("MODIFIED", stored)
+            if deleted:
+                self._emit("DELETED", stored)
+            else:
+                self._emit("MODIFIED", stored)
         return out
 
     def update(self, obj: Resource) -> Resource:
@@ -232,6 +310,11 @@ class FakeApiServer:
     def _remove(self, key: tuple, *, emit_delete: bool = True) -> None:
         obj = self._objects.pop(key)
         if emit_delete:
+            # Deletion is a state transition of its own: give the DELETED
+            # event a fresh rv so a watcher resuming from the object's
+            # last-seen version still observes the removal.
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
             self._emit("DELETED", obj)
         self._cascade(obj)
         if obj.kind == "Namespace":

@@ -1,0 +1,39 @@
+"""Out-of-process TpuJob operator: the controller binary.
+
+Runs the exact same TpuJobController the in-process tests use, but over
+the HTTP apiserver facade's watch stream — the distributed-control-plane
+topology the reference runs in production (controller pod ↔ apiserver,
+`notebook_controller.go:516` SetupWithManager watches). The only loop in
+this process is the workqueue's blocking get: every reconcile is caused
+by a watch event (or a reconcile-requested timed requeue), never by list
+polling.
+"""
+
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+from kubeflow_tpu.controllers.tpujob import TpuJobController  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+
+
+def main() -> None:
+    client = HttpApiClient(
+        os.environ["KFTPU_APISERVER"],
+        watch_poll_timeout=2.0,
+        watch_retry=0.1,
+    )
+    ctl = TpuJobController(client)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    print("controller ready", flush=True)
+    ctl.controller.run(stop)
+    client.close()
+
+
+if __name__ == "__main__":
+    main()

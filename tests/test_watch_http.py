@@ -1,0 +1,286 @@
+"""Watch streaming: journal semantics, long-poll endpoint, informer client.
+
+The reference's controllers are event-driven across process boundaries
+(controller-runtime watches, `notebook-controller/controllers/
+notebook_controller.go:516`); these tests pin the equivalent contract on
+our HTTP apiserver facade: resumable rv bookmarks, 410 Gone past the
+journal horizon, list-then-watch recovery, and a reconcile runtime that
+runs unchanged over the remote client.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.objects import ObjectMeta, Resource
+from kubeflow_tpu.controllers.runtime import Controller, Result
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, Gone
+from kubeflow_tpu.web.wsgi import serve
+
+
+def mk(name, kind="Widget", ns="default", spec=None):
+    return Resource(
+        kind=kind, metadata=ObjectMeta(name=name, namespace=ns),
+        spec=spec or {"size": 1},
+    )
+
+
+def wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- journal ---------------------------------------------------------------
+
+
+def test_journal_orders_events_by_rv():
+    api = FakeApiServer()
+    for i in range(3):
+        api.create(mk(f"w{i}"))
+    events, rv = api.events_since(0)
+    assert [e for _, e, _ in events] == ["ADDED", "ADDED", "ADDED"]
+    rvs = [r for r, _, _ in events]
+    assert rvs == sorted(rvs)
+    assert rv == rvs[-1]
+    # Resuming from the middle replays only the tail.
+    tail, _ = api.events_since(rvs[0])
+    assert [o.metadata.name for _, _, o in tail] == ["w1", "w2"]
+
+
+def test_journal_filters_kind_and_namespace():
+    api = FakeApiServer()
+    api.create(mk("a", kind="Widget", ns="team1"))
+    api.create(mk("b", kind="Gadget", ns="team2"))
+    events, _ = api.events_since(0, kind="Gadget")
+    assert [o.metadata.name for _, _, o in events] == ["b"]
+    events, _ = api.events_since(0, namespace="team1")
+    assert [o.metadata.name for _, _, o in events] == ["a"]
+
+
+def test_delete_event_gets_fresh_rv():
+    """A watcher whose bookmark is the object's last-seen rv must still
+    observe the removal (real apiservers bump rv on delete)."""
+    api = FakeApiServer()
+    obj = api.create(mk("doomed"))
+    bookmark = obj.metadata.resource_version
+    api.delete("Widget", "doomed")
+    events, _ = api.events_since(bookmark)
+    assert [(e, o.metadata.name) for _, e, o in events] == [
+        ("DELETED", "doomed")
+    ]
+
+
+def test_finalized_delete_emits_deleted_past_bookmark():
+    api = FakeApiServer()
+    obj = mk("fin")
+    obj.metadata.finalizers = ["keep"]
+    stored = api.create(obj)
+    api.delete("Widget", "fin")  # marks deletionTimestamp (MODIFIED)
+    pending = api.get("Widget", "fin")
+    bookmark = pending.metadata.resource_version
+    pending.metadata.finalizers = []
+    api.update(pending)  # clears last finalizer → actual removal
+    events, _ = api.events_since(bookmark)
+    assert ("DELETED", "fin") in [
+        (e, o.metadata.name) for _, e, o in events
+    ]
+    assert stored.metadata.resource_version < bookmark
+
+
+def test_journal_compaction_raises_gone():
+    api = FakeApiServer(journal_size=4)
+    for i in range(10):
+        api.create(mk(f"w{i}"))
+    with pytest.raises(Gone):
+        api.events_since(0)
+    # Within the horizon still works.
+    events, rv = api.events_since(api.current_rv - 1)
+    assert len(events) == 1 and rv == api.current_rv
+
+
+def test_wait_events_long_poll_wakes_on_write():
+    api = FakeApiServer()
+    start_rv = api.current_rv
+    result = {}
+
+    def waiter():
+        result["events"], result["rv"] = api.wait_events(
+            start_rv, timeout=10.0
+        )
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    api.create(mk("late"))
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert [o.metadata.name for _, _, o in result["events"]] == ["late"]
+
+
+def test_wait_events_times_out_empty():
+    api = FakeApiServer()
+    t0 = time.monotonic()
+    events, rv = api.wait_events(api.current_rv, timeout=0.1)
+    assert events == [] and rv == api.current_rv
+    assert time.monotonic() - t0 < 5.0
+
+
+# -- HTTP endpoint ---------------------------------------------------------
+
+
+@pytest.fixture()
+def served():
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    client = HttpApiClient(
+        f"http://127.0.0.1:{server.server_port}",
+        watch_poll_timeout=1.0,
+        watch_retry=0.05,
+    )
+    yield api, client
+    client.close()
+    server.shutdown()
+
+
+def test_http_list_carries_resource_version(served):
+    api, client = served
+    api.create(mk("w0"))
+    data = client._call("GET", "/apis/Widget")
+    assert data["resourceVersion"] == api.current_rv
+    assert len(data["items"]) == 1
+
+
+def test_http_watch_long_poll_returns_events(served):
+    api, client = served
+    api.create(mk("w0"))
+    data = client._call(
+        "GET", "/apis/Widget?watch=true&resourceVersion=0&timeoutSeconds=5"
+    )
+    assert [e["type"] for e in data["events"]] == ["ADDED"]
+    assert data["resourceVersion"] == api.current_rv
+    # Resume: nothing new → empty batch after the (short) timeout.
+    data2 = client._call(
+        "GET",
+        f"/apis/Widget?watch=true&resourceVersion={data['resourceVersion']}"
+        "&timeoutSeconds=0.1",
+    )
+    assert data2["events"] == []
+
+
+def test_http_watch_gone_maps_to_410(served):
+    api, client = served
+    api._journal_size = 2
+    for i in range(6):
+        api.create(mk(f"w{i}"))
+    with pytest.raises(Gone):
+        client._call(
+            "GET", "/apis/Widget?watch=true&resourceVersion=0"
+        )
+
+
+def test_http_apply_is_server_side(served):
+    api, client = served
+    obj = mk("app1")
+    created = client.apply(obj)
+    rv_before = api.current_rv
+    again = client.apply(mk("app1"))  # identical → must no-op server-side
+    assert again.metadata.resource_version == created.metadata.resource_version
+    assert api.current_rv == rv_before  # no MODIFIED event generated
+
+
+def test_client_record_event(served):
+    api, client = served
+    about = client.create(mk("thing"))
+    client.record_event(about, "Tested", "hello", type_="Warning")
+    events = api.list("Event", "default")
+    assert len(events) == 1
+    assert events[0].spec["reason"] == "Tested"
+    assert events[0].spec["involvedObject"]["uid"] == about.metadata.uid
+
+
+# -- informer client -------------------------------------------------------
+
+
+def test_client_watch_syncs_then_streams(served):
+    api, client = served
+    api.create(mk("pre-existing"))
+    seen = []
+    client.watch(lambda ev, obj: seen.append((ev, obj.metadata.name)),
+                 "Widget")
+    # Initial list-then-watch delivers the pre-existing object.
+    assert wait_for(lambda: ("MODIFIED", "pre-existing") in seen)
+    api.create(mk("live"))
+    assert wait_for(lambda: ("ADDED", "live") in seen)
+    api.delete("Widget", "live")
+    assert wait_for(lambda: ("DELETED", "live") in seen)
+
+
+def test_client_watch_filters_by_kind(served):
+    api, client = served
+    widgets, gadgets = [], []
+    client.watch(lambda ev, o: widgets.append(o.metadata.name), "Widget")
+    client.watch(lambda ev, o: gadgets.append(o.metadata.name), "Gadget")
+    api.create(mk("w", kind="Widget"))
+    api.create(mk("g", kind="Gadget"))
+    assert wait_for(lambda: "w" in widgets and "g" in gadgets)
+    assert "g" not in widgets and "w" not in gadgets
+
+
+def test_client_watch_recovers_from_gone(served):
+    """Journal horizon passes the client mid-stream → 410 → the client
+    relists and keeps streaming without dropping the world."""
+    api, client = served
+    api._journal_size = 3
+    seen = []
+    client.watch(lambda ev, obj: seen.append(obj.metadata.name), "Widget")
+    api.create(mk("first"))
+    assert wait_for(lambda: "first" in seen)
+    # Stall the stream long enough for its bookmark to expire: burst many
+    # writes so the journal horizon moves past the client's bookmark
+    # while it is parked in a long-poll that returns these events in one
+    # batch — then compact further with another burst.
+    for i in range(20):
+        api.create(mk(f"burst{i}"))
+    assert wait_for(lambda: "burst19" in seen)
+    api.create(mk("after-recovery"))
+    assert wait_for(lambda: "after-recovery" in seen)
+
+
+def test_controller_runtime_over_http_client(served):
+    """The reconcile runtime works unchanged over the remote client:
+    watch events enqueue keys, the reconciler reads and writes through
+    HTTP. This is the in-process half of the subprocess e2e
+    (tests/e2e/test_remote_controller_e2e.py)."""
+    api, client = served
+
+    def reconcile(capi, key):
+        ns, name = key
+        try:
+            obj = capi.get("Widget", name, ns)
+        except Exception:
+            return Result()
+        if obj.status.get("phase") != "Ready":
+            fresh = capi.get("Widget", name, ns)
+            fresh.status["phase"] = "Ready"
+            capi.update_status(fresh)
+        return Result()
+
+    ctl = Controller(client, "Widget", reconcile)
+    stop = threading.Event()
+    t = threading.Thread(target=ctl.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        api.create(mk("managed"))
+        assert wait_for(
+            lambda: api.get("Widget", "managed").status.get("phase")
+            == "Ready"
+        )
+    finally:
+        stop.set()
+        t.join(timeout=5)
