@@ -1,0 +1,367 @@
+"""Cloud-IAM plugins: pure policy transforms + profile-controller wiring.
+
+Table tests at the fidelity of the reference's
+`plugin_iam_test.go:302` (trust-policy add/dedupe/remove) and
+`plugin_workload_identity_test.go` (binding edits), plus end-to-end
+apply/idempotence/revoke through the ProfileController finalizer.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import new_resource
+from kubeflow_tpu.controllers.cloud_iam import (
+    AWS_ANNOTATION_KEY,
+    AwsIamPlugin,
+    GCP_ANNOTATION_KEY,
+    InMemoryAwsIam,
+    InMemoryGcpIam,
+    KIND_AWS_IAM,
+    KIND_WORKLOAD_IDENTITY,
+    PluginError,
+    WORKLOAD_IDENTITY_ROLE,
+    WorkloadIdentityPlugin,
+    add_trusted_service_account,
+    add_workload_identity_binding,
+    gcp_project_from_sa,
+    issuer_from_provider_arn,
+    remove_trusted_service_account,
+    remove_workload_identity_binding,
+    role_name_from_arn,
+    workload_identity_member,
+)
+from kubeflow_tpu.controllers.profile import KIND, ProfileController
+from kubeflow_tpu.testing import FakeApiServer
+
+ISSUER = "oidc.eks.us-west-2.amazonaws.com/id/DEADBEEF"
+PROVIDER_ARN = f"arn:aws:iam::123456789012:oidc-provider/{ISSUER}"
+ROLE_ARN = "arn:aws:iam::123456789012:role/kf-user-role"
+
+
+def trust_doc(subs=None, extra_subs_key=True):
+    cond = {"StringEquals": {f"{ISSUER}:aud": ["sts.amazonaws.com"]}}
+    if subs is not None and extra_subs_key:
+        cond["StringEquals"][f"{ISSUER}:sub"] = subs
+    return {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Action": "sts:AssumeRoleWithWebIdentity",
+                "Principal": {"Federated": PROVIDER_ARN},
+                "Condition": cond,
+            }
+        ],
+    }
+
+
+# -- GCP parsing -----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "email,project",
+    [
+        ("kf-user@my-proj.iam.gserviceaccount.com", "my-proj"),
+        ("a@b.iam.gserviceaccount.com", "b"),
+    ],
+)
+def test_gcp_project_extraction(email, project):
+    assert gcp_project_from_sa(email) == project
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "kf-user@my-proj.example.com",           # wrong suffix
+        "not-an-email.iam.gserviceaccount.com",  # no @
+        "",
+    ],
+)
+def test_gcp_project_extraction_rejects(bad):
+    with pytest.raises(PluginError):
+        gcp_project_from_sa(bad)
+
+
+def test_workload_identity_member_format():
+    # plugin_workload_identity.go:123
+    assert (
+        workload_identity_member("my-proj", "team-a", "default-editor")
+        == "serviceAccount:my-proj.svc.id.goog[team-a/default-editor]"
+    )
+
+
+# -- GCP binding table -----------------------------------------------------
+
+MEMBER = "serviceAccount:p.svc.id.goog[ns/default-editor]"
+OTHER = "serviceAccount:p.svc.id.goog[other/default-editor]"
+
+
+@pytest.mark.parametrize(
+    "before,expect_members,expect_changed",
+    [
+        # empty policy → fresh binding
+        ({"bindings": []}, [MEMBER], True),
+        # merge into existing role binding (NOT a duplicate binding object)
+        (
+            {"bindings": [{"role": WORKLOAD_IDENTITY_ROLE,
+                           "members": [OTHER]}]},
+            [OTHER, MEMBER],
+            True,
+        ),
+        # already present → no-op
+        (
+            {"bindings": [{"role": WORKLOAD_IDENTITY_ROLE,
+                           "members": [MEMBER]}]},
+            [MEMBER],
+            False,
+        ),
+    ],
+)
+def test_add_workload_identity_binding(before, expect_members, expect_changed):
+    after, changed = add_workload_identity_binding(before, MEMBER)
+    assert changed is expect_changed
+    wi = [b for b in after["bindings"]
+          if b["role"] == WORKLOAD_IDENTITY_ROLE]
+    assert len(wi) == 1  # never a duplicate binding object
+    assert wi[0]["members"] == expect_members
+
+
+def test_add_preserves_unrelated_bindings_and_etag():
+    before = {
+        "etag": "abc123",
+        "bindings": [{"role": "roles/viewer", "members": ["user:x"]}],
+    }
+    after, changed = add_workload_identity_binding(before, MEMBER)
+    assert changed
+    assert after["etag"] == "abc123"
+    assert {"role": "roles/viewer", "members": ["user:x"]} in after["bindings"]
+    assert before["bindings"] == [
+        {"role": "roles/viewer", "members": ["user:x"]}
+    ]  # input not mutated
+
+
+@pytest.mark.parametrize(
+    "before,expect_bindings,expect_changed",
+    [
+        # removes the member, keeps co-members
+        (
+            [{"role": WORKLOAD_IDENTITY_ROLE, "members": [MEMBER, OTHER]}],
+            [{"role": WORKLOAD_IDENTITY_ROLE, "members": [OTHER]}],
+            True,
+        ),
+        # last member → binding dropped entirely
+        (
+            [{"role": WORKLOAD_IDENTITY_ROLE, "members": [MEMBER]}],
+            [],
+            True,
+        ),
+        # absent → no-op
+        (
+            [{"role": "roles/viewer", "members": [MEMBER]}],
+            [{"role": "roles/viewer", "members": [MEMBER]}],
+            False,
+        ),
+    ],
+)
+def test_remove_workload_identity_binding(
+    before, expect_bindings, expect_changed
+):
+    after, changed = remove_workload_identity_binding(
+        {"bindings": before}, MEMBER
+    )
+    assert changed is expect_changed
+    assert after["bindings"] == expect_bindings
+
+
+# -- AWS ARN parsing -------------------------------------------------------
+
+
+def test_issuer_and_role_parsing():
+    assert issuer_from_provider_arn(PROVIDER_ARN) == ISSUER
+    assert role_name_from_arn(ROLE_ARN) == "kf-user-role"
+    with pytest.raises(PluginError):
+        issuer_from_provider_arn("arn:aws:iam::1:oidc-provider")
+
+
+# -- AWS trust-policy table (plugin_iam_test.go:302 analog) ----------------
+
+SUBJECT = "system:serviceaccount:team-a:default-editor"
+EXISTING = "system:serviceaccount:other:default-editor"
+
+
+@pytest.mark.parametrize(
+    "before_subs,expect_subs,expect_changed",
+    [
+        (None, [SUBJECT], True),                      # no :sub condition yet
+        ([], [SUBJECT], True),                        # empty list
+        ([EXISTING], [EXISTING, SUBJECT], True),      # append, preserve
+        ([SUBJECT], [SUBJECT], False),                # dedupe → no-op
+        # scalar string form: recognized as present, doc returned verbatim
+        (SUBJECT, SUBJECT, False),
+    ],
+)
+def test_add_trusted_service_account(before_subs, expect_subs, expect_changed):
+    doc = trust_doc(before_subs, extra_subs_key=before_subs is not None)
+    after, changed = add_trusted_service_account(doc, "team-a",
+                                                 "default-editor")
+    assert changed is expect_changed
+    se = after["Statement"][0]["Condition"]["StringEquals"]
+    assert se[f"{ISSUER}:sub"] == expect_subs
+    assert se[f"{ISSUER}:aud"] == ["sts.amazonaws.com"]
+    assert after["Version"] == "2012-10-17"
+    assert (
+        after["Statement"][0]["Principal"]["Federated"] == PROVIDER_ARN
+    )
+
+
+@pytest.mark.parametrize(
+    "before_subs,expect_subs,expect_changed",
+    [
+        ([EXISTING, SUBJECT], [EXISTING], True),  # remove, preserve others
+        ([SUBJECT], None, True),                  # last one → :sub key dropped
+        ([EXISTING], [EXISTING], False),          # absent → no-op
+    ],
+)
+def test_remove_trusted_service_account(
+    before_subs, expect_subs, expect_changed
+):
+    doc = trust_doc(before_subs)
+    after, changed = remove_trusted_service_account(
+        doc, "team-a", "default-editor"
+    )
+    assert changed is expect_changed
+    se = after["Statement"][0]["Condition"]["StringEquals"]
+    if expect_subs is None:
+        # Empty identity list must OMIT the key, not serialize null/[]
+        # (plugin_iam.go:213-228).
+        assert f"{ISSUER}:sub" not in se
+    else:
+        assert se[f"{ISSUER}:sub"] == expect_subs
+    assert se[f"{ISSUER}:aud"] == ["sts.amazonaws.com"]
+
+
+def test_malformed_trust_policy_raises():
+    with pytest.raises(PluginError):
+        add_trusted_service_account({"Statement": []}, "ns", "sa")
+    with pytest.raises(PluginError):
+        add_trusted_service_account(
+            {"Statement": [{"Principal": {}}]}, "ns", "sa"
+        )
+
+
+# -- end-to-end through the ProfileController ------------------------------
+
+GSA = "kf-user@my-proj.iam.gserviceaccount.com"
+SA_RESOURCE = f"projects/my-proj/serviceAccounts/{GSA}"
+
+
+def _profile(name="team-a", plugins=None):
+    return new_resource(
+        KIND,
+        name,
+        "default",
+        spec={
+            "owner": {"kind": "User", "name": "alice@example.com"},
+            "plugins": plugins or [],
+        },
+    )
+
+
+def _controller(api):
+    gcp = InMemoryGcpIam()
+    aws = InMemoryAwsIam({"kf-user-role": trust_doc([])})
+    ctl = ProfileController(
+        api,
+        plugins={
+            KIND_WORKLOAD_IDENTITY: WorkloadIdentityPlugin(gcp),
+            KIND_AWS_IAM: AwsIamPlugin(aws),
+        },
+    )
+    return ctl, gcp, aws
+
+
+def test_workload_identity_apply_idempotent_and_revoke():
+    api = FakeApiServer()
+    ctl, gcp, aws = _controller(api)
+    api.create(
+        _profile(
+            plugins=[
+                {
+                    "kind": KIND_WORKLOAD_IDENTITY,
+                    "spec": {"gcpServiceAccount": GSA},
+                }
+            ]
+        )
+    )
+    ctl.controller.run_until_idle()
+
+    sa = api.get("ServiceAccount", "default-editor", "team-a")
+    assert sa.metadata.annotations[GCP_ANNOTATION_KEY] == GSA
+    member = workload_identity_member("my-proj", "team-a", "default-editor")
+    assert gcp.policies[SA_RESOURCE]["bindings"] == [
+        {"role": WORKLOAD_IDENTITY_ROLE, "members": [member]}
+    ]
+    set_calls = gcp.set_calls
+
+    # Re-reconcile: policy must be a fixed point — no further writes.
+    ctl.controller.enqueue(("default", "team-a"))
+    ctl.controller.run_until_idle()
+    assert gcp.set_calls == set_calls
+    assert gcp.policies[SA_RESOURCE]["bindings"][0]["members"] == [member]
+
+    # Finalize: binding revoked.
+    api.delete(KIND, "team-a")
+    ctl.controller.run_until_idle()
+    assert gcp.policies[SA_RESOURCE]["bindings"] == []
+
+
+def test_aws_iam_apply_idempotent_and_revoke():
+    api = FakeApiServer()
+    ctl, gcp, aws = _controller(api)
+    api.create(
+        _profile(
+            plugins=[
+                {"kind": KIND_AWS_IAM, "spec": {"awsIamRole": ROLE_ARN}}
+            ]
+        )
+    )
+    ctl.controller.run_until_idle()
+
+    sa = api.get("ServiceAccount", "default-editor", "team-a")
+    assert sa.metadata.annotations[AWS_ANNOTATION_KEY] == ROLE_ARN
+    se = aws.roles["kf-user-role"]["Statement"][0]["Condition"][
+        "StringEquals"
+    ]
+    assert se[f"{ISSUER}:sub"] == [SUBJECT]
+    update_calls = aws.update_calls
+
+    ctl.controller.enqueue(("default", "team-a"))
+    ctl.controller.run_until_idle()
+    assert aws.update_calls == update_calls  # idempotent re-apply
+
+    api.delete(KIND, "team-a")
+    ctl.controller.run_until_idle()
+    se = aws.roles["kf-user-role"]["Statement"][0]["Condition"][
+        "StringEquals"
+    ]
+    assert f"{ISSUER}:sub" not in se  # trust revoked on finalize
+
+
+def test_both_plugins_compose():
+    api = FakeApiServer()
+    ctl, gcp, aws = _controller(api)
+    api.create(
+        _profile(
+            plugins=[
+                {
+                    "kind": KIND_WORKLOAD_IDENTITY,
+                    "spec": {"gcpServiceAccount": GSA},
+                },
+                {"kind": KIND_AWS_IAM, "spec": {"awsIamRole": ROLE_ARN}},
+            ]
+        )
+    )
+    ctl.controller.run_until_idle()
+    sa = api.get("ServiceAccount", "default-editor", "team-a")
+    assert sa.metadata.annotations[GCP_ANNOTATION_KEY] == GSA
+    assert sa.metadata.annotations[AWS_ANNOTATION_KEY] == ROLE_ARN
+    assert api.get(KIND, "team-a").status["condition"] == "Ready"
