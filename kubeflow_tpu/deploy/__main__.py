@@ -32,6 +32,14 @@ def main() -> int:
     for mode in ("apply", "delete"):
         p = sub.add_parser(mode)
         p.add_argument("-f", "--file", required=True)
+        if mode == "apply":
+            p.add_argument(
+                "--dry-run",
+                action="store_true",
+                help="print the GKE API payloads the PLATFORM phase would "
+                "send (cluster + TPU node pools) and the K8S resource "
+                "count, without applying anything",
+            )
     sub.add_parser("generate")
     p = sub.add_parser("serve")
     p.add_argument("--host", default="127.0.0.1")
@@ -57,6 +65,17 @@ def main() -> int:
 
     with open(args.file) as f:
         spec = PlatformSpec.from_yaml(f.read())
+    if args.mode == "apply" and args.dry_run:
+        from kubeflow_tpu.deploy.bundles import bundle_resources
+        from kubeflow_tpu.deploy.gke import dry_run_requests
+
+        for request in dry_run_requests(spec):
+            print(request.to_json())
+        print(
+            f"# K8S phase would apply {len(bundle_resources(spec))} "
+            f"resources from bundles: {', '.join(spec.applications)}"
+        )
+        return 0
     if args.mode == "apply":
         result = apply_platform(spec, api, cloud)
         nodes = api.list("Node", "")

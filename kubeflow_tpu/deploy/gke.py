@@ -1,0 +1,248 @@
+"""GKE provider: real node-pool/cluster API payloads, transport-separated.
+
+The reference's PLATFORM phase builds actual GCP requests (Deployment
+Manager / GKE / IAM — `bootstrap/cmd/bootstrap/app/kfctlServer.go:219-294`,
+`gcpUtils.go`) and its tests exercise request *construction* without a
+cloud (`gcpUtils_test.go`, `tokenSource_test.go`). Same split here:
+`GkeCloud` implements the `CloudProvider` seam by building the
+container-API v1 payloads for **TPU slice node pools** and handing them
+to a `Transport`. CI and `--dry-run` use `RecordingTransport`; a real
+deployment plugs in a token-bearing HTTP transport. FakeCloud remains
+the provider that also materializes Node objects for platform-in-a-box.
+
+TPU specifics the payloads must get right (this is where a GPU-era
+deploy tool breaks on TPU):
+
+- machine type encodes the generation AND chips-per-host
+  (`ct5lp-hightpu-4t` = v5e, 4 chips); `initialNodeCount` is the slice's
+  host count, not a free choice — topology_chips / chips_per_host;
+- multi-host slices need `placementPolicy.tpuTopology` (COMPACT) so GKE
+  provisions one ICI domain, and every host carries the accelerator +
+  topology labels the gang scheduler matches on;
+- preemptible TPU slices are `spot` capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Protocol
+
+from kubeflow_tpu.deploy.kfdef import (
+    NodePool,
+    PlatformSpec,
+    TPU_CHIPS_PER_HOST,
+    topology_chips,
+)
+from kubeflow_tpu.deploy.provisioner import (
+    ACCELERATOR_LABEL,
+    CloudError,
+    PLATFORM_LABEL,
+    POOL_LABEL,
+    TOPOLOGY_LABEL,
+)
+
+API_BASE = "https://container.googleapis.com/v1"
+
+# GKE machine types per TPU generation at the standard chips-per-host.
+MACHINE_TYPES = {
+    ("v4", 4): "ct4p-hightpu-4t",
+    ("v5e", 1): "ct5lp-hightpu-1t",
+    ("v5e", 4): "ct5lp-hightpu-4t",
+    ("v5e", 8): "ct5l-hightpu-8t",
+    ("v5p", 4): "ct5p-hightpu-4t",
+    ("v6e", 1): "ct6e-standard-1t",
+    ("v6e", 4): "ct6e-standard-4t",
+    ("v6e", 8): "ct6e-standard-8t",
+}
+
+
+def machine_type(accelerator: str, chips_per_host: int) -> str:
+    try:
+        return MACHINE_TYPES[(accelerator, chips_per_host)]
+    except KeyError:
+        raise CloudError(
+            f"no GKE machine type for {accelerator} at "
+            f"{chips_per_host} chips/host"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One cloud API call, fully constructed but not sent."""
+
+    method: str
+    url: str
+    body: dict | None = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"method": self.method, "url": self.url, "body": self.body},
+            indent=2,
+        )
+
+
+def _location(spec: PlatformSpec, cluster: str) -> str:
+    return (
+        f"projects/{spec.project}/locations/{spec.zone}"
+        f"/clusters/{cluster}"
+    )
+
+
+def cluster_create_request(
+    spec: PlatformSpec, cluster: str | None = None
+) -> Request:
+    """The cluster itself (the reference creates it through Deployment
+    Manager, `kfctlServer.go:268`; the direct v1 API is the modern path).
+    TPU pools are attached separately — the default pool is CPU-only for
+    the control-plane components."""
+    name = cluster or spec.name
+    return Request(
+        "POST",
+        f"{API_BASE}/projects/{spec.project}/locations/{spec.zone}/clusters",
+        {
+            "cluster": {
+                "name": name,
+                "initialNodeCount": 2,
+                "nodeConfig": {
+                    "machineType": "e2-standard-8",
+                    "oauthScopes": [
+                        "https://www.googleapis.com/auth/cloud-platform"
+                    ],
+                },
+                "releaseChannel": {"channel": "REGULAR"},
+                "workloadIdentityConfig": {
+                    "workloadPool": f"{spec.project}.svc.id.goog"
+                },
+                "resourceLabels": {PLATFORM_LABEL.replace("/", "_"): spec.name},
+            }
+        },
+    )
+
+
+def node_pool_create_request(
+    spec: PlatformSpec, pool: NodePool, cluster: str | None = None
+) -> Request:
+    """A TPU slice node pool (`google.com/tpu` capacity replaces the
+    reference's `nvidia.com/gpu` ask, `tf-cnn/create_job_specs.py:168`)."""
+    chips = topology_chips(pool.topology)
+    per_host = TPU_CHIPS_PER_HOST.get(pool.accelerator, 4)
+    num_hosts = max(1, chips // per_host)
+    body = {
+        "nodePool": {
+            "name": pool.name,
+            "initialNodeCount": num_hosts,
+            "config": {
+                "machineType": machine_type(
+                    pool.accelerator, min(chips, per_host) if num_hosts == 1
+                    else per_host
+                ),
+                "spot": pool.preemptible,
+                "labels": {
+                    PLATFORM_LABEL: spec.name,
+                    POOL_LABEL: pool.name,
+                    ACCELERATOR_LABEL: pool.accelerator,
+                    TOPOLOGY_LABEL: pool.topology,
+                },
+                "oauthScopes": [
+                    "https://www.googleapis.com/auth/cloud-platform"
+                ],
+            },
+            "management": {"autoRepair": True, "autoUpgrade": False},
+        }
+    }
+    if num_hosts > 1:
+        # Multi-host slice: one ICI domain, compactly placed.
+        body["nodePool"]["placementPolicy"] = {
+            "type": "COMPACT",
+            "tpuTopology": pool.topology,
+        }
+    return Request(
+        "POST",
+        f"{API_BASE}/{_location(spec, cluster or spec.name)}/nodePools",
+        body,
+    )
+
+
+def node_pool_delete_request(
+    spec: PlatformSpec, pool_name: str, cluster: str | None = None
+) -> Request:
+    return Request(
+        "DELETE",
+        f"{API_BASE}/{_location(spec, cluster or spec.name)}"
+        f"/nodePools/{pool_name}",
+    )
+
+
+def node_pool_list_request(
+    spec: PlatformSpec, cluster: str | None = None
+) -> Request:
+    return Request(
+        "GET",
+        f"{API_BASE}/{_location(spec, cluster or spec.name)}/nodePools",
+    )
+
+
+class Transport(Protocol):
+    """The network edge: send one constructed request, return the parsed
+    response body. Real deployments back this with an authenticated HTTP
+    client (the reference injects a TokenSource the same way,
+    `kfctlServer.go:179-201`)."""
+
+    def send(self, request: Request) -> dict: ...
+
+
+class RecordingTransport:
+    """Dry-run / golden-test transport: records every request; responses
+    come from a canned map (url-suffix matched) or default to {}."""
+
+    def __init__(self, responses: dict[str, dict] | None = None):
+        self.requests: list[Request] = []
+        self.responses = dict(responses or {})
+
+    def send(self, request: Request) -> dict:
+        self.requests.append(request)
+        for suffix, response in self.responses.items():
+            if request.url.endswith(suffix):
+                return response
+        return {}
+
+
+class GkeCloud:
+    """CloudProvider over real GKE payloads. Idempotent the GKE way:
+    create returns 409 for an existing pool, which ensure treats as
+    success (second apply must no-op, `kfctl_second_apply.py`)."""
+
+    def __init__(self, transport: Transport, cluster: str | None = None):
+        self.transport = transport
+        self.cluster = cluster
+
+    def ensure_node_pool(self, spec: PlatformSpec, pool: NodePool) -> None:
+        existing = self.list_node_pools(spec)
+        if pool.name in existing:
+            return
+        self.transport.send(
+            node_pool_create_request(spec, pool, self.cluster)
+        )
+
+    def delete_node_pool(self, spec: PlatformSpec, pool_name: str) -> None:
+        self.transport.send(
+            node_pool_delete_request(spec, pool_name, self.cluster)
+        )
+
+    def list_node_pools(self, spec: PlatformSpec) -> list[str]:
+        response = self.transport.send(
+            node_pool_list_request(spec, self.cluster)
+        )
+        return sorted(
+            p.get("name", "") for p in response.get("nodePools", [])
+        )
+
+
+def dry_run_requests(spec: PlatformSpec) -> list[Request]:
+    """Everything the PLATFORM phase would send, in order — the payloads
+    `--dry-run` prints."""
+    out = [cluster_create_request(spec)]
+    for pool in spec.node_pools:
+        out.append(node_pool_create_request(spec, pool))
+    return out

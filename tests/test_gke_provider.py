@@ -1,0 +1,156 @@
+"""GkeCloud: golden request construction + CloudProvider semantics.
+
+The reference unit-tests GCP request construction without a cloud
+(`bootstrap/cmd/bootstrap/app/gcpUtils_test.go`); these are the TPU
+equivalents — the exact container-v1 payloads for slice node pools.
+"""
+
+import subprocess
+import sys
+
+from kubeflow_tpu.deploy.apply import apply_platform
+from kubeflow_tpu.deploy.gke import (
+    GkeCloud,
+    RecordingTransport,
+    cluster_create_request,
+    dry_run_requests,
+    node_pool_create_request,
+    node_pool_delete_request,
+)
+from kubeflow_tpu.deploy.kfdef import NodePool, PlatformSpec
+from kubeflow_tpu.testing import FakeApiServer
+
+SPEC = PlatformSpec(
+    name="kf-prod",
+    project="my-proj",
+    zone="us-central2-b",
+    node_pools=[NodePool(name="tpu-pool-0", accelerator="v5e",
+                         topology="4x4")],
+)
+
+
+def test_multi_host_pool_golden_request():
+    req = node_pool_create_request(
+        SPEC, SPEC.node_pools[0]
+    )
+    assert req.method == "POST"
+    assert req.url == (
+        "https://container.googleapis.com/v1/projects/my-proj/locations/"
+        "us-central2-b/clusters/kf-prod/nodePools"
+    )
+    assert req.body == {
+        "nodePool": {
+            "name": "tpu-pool-0",
+            # 4x4 v5e = 16 chips at 4/host → exactly 4 hosts, not a knob.
+            "initialNodeCount": 4,
+            "config": {
+                "machineType": "ct5lp-hightpu-4t",
+                "spot": False,
+                "labels": {
+                    "kubeflow-tpu.org/platform": "kf-prod",
+                    "cloud.google.com/tpu-node-pool": "tpu-pool-0",
+                    "cloud.google.com/tpu-accelerator": "v5e",
+                    "cloud.google.com/tpu-topology": "4x4",
+                },
+                "oauthScopes": [
+                    "https://www.googleapis.com/auth/cloud-platform"
+                ],
+            },
+            "management": {"autoRepair": True, "autoUpgrade": False},
+            # Multi-host slice: one ICI domain.
+            "placementPolicy": {"type": "COMPACT", "tpuTopology": "4x4"},
+        }
+    }
+
+
+def test_single_host_pool_has_no_placement_policy():
+    pool = NodePool(name="small", accelerator="v5e", topology="2x2",
+                    preemptible=True)
+    req = node_pool_create_request(SPEC, pool)
+    body = req.body["nodePool"]
+    assert body["initialNodeCount"] == 1
+    assert "placementPolicy" not in body
+    assert body["config"]["spot"] is True
+    assert body["config"]["machineType"] == "ct5lp-hightpu-4t"
+
+
+def test_v6e_and_v4_machine_types():
+    assert (
+        node_pool_create_request(
+            SPEC, NodePool(name="p", accelerator="v6e", topology="2x2")
+        ).body["nodePool"]["config"]["machineType"]
+        == "ct6e-standard-4t"
+    )
+    assert (
+        node_pool_create_request(
+            SPEC, NodePool(name="p", accelerator="v4", topology="2x2x2")
+        ).body["nodePool"]["config"]["machineType"]
+        == "ct4p-hightpu-4t"
+    )
+
+
+def test_cluster_request_enables_workload_identity():
+    req = cluster_create_request(SPEC)
+    cluster = req.body["cluster"]
+    assert (
+        cluster["workloadIdentityConfig"]["workloadPool"]
+        == "my-proj.svc.id.goog"
+    )
+    assert req.url.endswith("/locations/us-central2-b/clusters")
+
+
+def test_delete_request():
+    req = node_pool_delete_request(SPEC, "tpu-pool-0")
+    assert req.method == "DELETE"
+    assert req.url.endswith("/clusters/kf-prod/nodePools/tpu-pool-0")
+
+
+def test_ensure_skips_existing_pool():
+    transport = RecordingTransport(
+        responses={"/nodePools": {"nodePools": [{"name": "tpu-pool-0"}]}}
+    )
+    cloud = GkeCloud(transport)
+    cloud.ensure_node_pool(SPEC, SPEC.node_pools[0])
+    # Only the list went out — idempotent second apply sends no create.
+    assert [r.method for r in transport.requests] == ["GET"]
+
+
+def test_ensure_creates_missing_pool():
+    transport = RecordingTransport(
+        responses={"/nodePools": {"nodePools": []}}
+    )
+    GkeCloud(transport).ensure_node_pool(SPEC, SPEC.node_pools[0])
+    methods = [r.method for r in transport.requests]
+    assert methods == ["GET", "POST"]
+
+
+def test_gke_cloud_drives_platform_phase():
+    """GkeCloud slots in behind apply_platform's CloudProvider seam: the
+    PLATFORM phase emits exactly the expected create calls."""
+    api = FakeApiServer()
+    transport = RecordingTransport(responses={"/nodePools": {"nodePools": []}})
+    spec = PlatformSpec(
+        name="kf-prod", project="my-proj", zone="us-central2-b",
+        node_pools=[NodePool(name="a", topology="4x4"),
+                    NodePool(name="b", topology="2x2")],
+        applications=[],
+    )
+    result = apply_platform(spec, api, GkeCloud(transport))
+    assert result.succeeded
+    creates = [r for r in transport.requests if r.method == "POST"]
+    assert [r.body["nodePool"]["name"] for r in creates] == ["a", "b"]
+
+
+def test_dry_run_cli_prints_payloads(tmp_path):
+    spec_file = tmp_path / "platform.yaml"
+    spec_file.write_text(SPEC.to_yaml())
+    out = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.deploy", "apply",
+         "-f", str(spec_file), "--dry-run"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "ct5lp-hightpu-4t" in out.stdout
+    assert "container.googleapis.com" in out.stdout
+    assert "K8S phase would apply" in out.stdout
+    assert dry_run_requests(SPEC)[0].body["cluster"]["name"] == "kf-prod"
