@@ -119,7 +119,7 @@ class ProfileController:
                 annotations={OWNER_ANNOTATION: owner_name},
             )
             ns.metadata.owner_references = [owner_ref(profile)]
-            api.create(ns)
+            ns = api.create(ns)
 
         for sa in (EDITOR_SA, VIEWER_SA):
             api.apply(new_resource("ServiceAccount", sa, ns_name))
@@ -137,6 +137,31 @@ class ProfileController:
             },
         )
         api.apply(rb)
+
+        # Mesh policy for the owner at namespace creation — the Istio
+        # ServiceRole/ServiceRoleBinding pair of the reference
+        # (`profile_controller.go:190`). Without it the owner has RBAC
+        # but the mesh (web/mesh.py) would deny their traffic; kfam adds
+        # the equivalent policies for contributors only.
+        if owner_name:
+            ap = new_resource(
+                "AuthorizationPolicy",
+                "ns-owner",
+                ns_name,
+                annotations={
+                    "manager": "profile-controller",
+                    "user": owner_name,
+                    "role": "admin",
+                },
+                spec={
+                    "action": "ALLOW",
+                    "rules": [
+                        {"from": [{"source": {"principals": [owner_name]}}]}
+                    ],
+                },
+            )
+            ap.metadata.owner_references = [owner_ref(ns, controller=False)]
+            api.apply(ap)
 
         quota = profile.spec.get("resourceQuotaSpec")
         if quota:

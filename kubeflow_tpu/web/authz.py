@@ -32,3 +32,12 @@ def ensure_authorized(
         raise Forbidden(
             f"user {user!r} is not allowed to {verb} {resource} {scope}"
         )
+    if namespace:
+        # Second gate, mirroring production traffic flow: RBAC authorizes
+        # the API verb, the mesh admits the principal into the namespace
+        # (`profile_controller.go:190` owner policy + kfam contributor
+        # policies). RBAC-without-mesh-policy must fail closed here, not
+        # silently skip the mesh.
+        from kubeflow_tpu.web.mesh import ensure_mesh_admits
+
+        ensure_mesh_admits(api, user, namespace)

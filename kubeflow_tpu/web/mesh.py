@@ -1,0 +1,59 @@
+"""Mesh-policy admission — the Istio AuthorizationPolicy evaluator.
+
+The reference puts every tenant namespace behind Istio RBAC: the profile
+controller creates the owner's ServiceRole/ServiceRoleBinding at
+namespace creation (`profile_controller.go:190`) and kfam adds
+contributor bindings (`kfam/bindings.go:76-128`). Traffic into the
+namespace's services is admitted by the sidecars, not the apps. Our
+platform-in-a-box has no sidecars, so the web tier evaluates the same
+policy objects at the request boundary.
+
+Semantics follow Istio's ALLOW-policy rules: a namespace with no ALLOW
+policies admits everyone (policy-free namespaces stay open — hand-made
+test namespaces, system namespaces); once any ALLOW policy exists, a
+request is admitted only if some policy rule matches its principal (an
+empty `from` clause matches all sources).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.wsgi import HttpError
+
+
+def mesh_admits(api: FakeApiServer, user: str, namespace: str) -> bool:
+    policies = [
+        p
+        for p in api.list("AuthorizationPolicy", namespace)
+        if p.spec.get("action", "ALLOW") == "ALLOW"
+    ]
+    if not policies:
+        return True
+    for policy in policies:
+        for rule in policy.spec.get("rules", []):
+            sources = rule.get("from", [])
+            if not sources:
+                return True
+            for source in sources:
+                if user in source.get("source", {}).get("principals", []):
+                    return True
+    return False
+
+
+def ensure_mesh_admits(
+    api: FakeApiServer, user: str, namespace: str
+) -> None:
+    from kubeflow_tpu.api.rbac import is_cluster_admin
+
+    # Cluster-admins reach workloads through the platform gateway, which
+    # the mesh trusts (the reference's admins bypass the mesh via
+    # kubectl; the dashboard's admin probe is `api_default.go:270`).
+    if is_cluster_admin(api, user):
+        return
+    if not mesh_admits(api, user, namespace):
+        raise HttpError(
+            403,
+            f"mesh policy denies {user!r} access to namespace "
+            f"{namespace!r} (no AuthorizationPolicy admits this "
+            "principal — ask the profile owner for a contributor binding)",
+        )

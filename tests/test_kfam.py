@@ -83,8 +83,13 @@ def test_contributor_binding_lifecycle(world):
     assert r.status == 200, r.body
 
     # The pair exists: RBAC + mesh policy (bindings.go:76-128 parity).
+    # The namespace also carries the profile controller's ns-owner policy
+    # (profile_controller.go:190 parity) — select the contributor's.
     assert subject_access_review(api, "bob@x.co", "create", "notebooks", "alice")
-    [ap] = api.list("AuthorizationPolicy", "alice")
+    [ap] = [
+        p for p in api.list("AuthorizationPolicy", "alice")
+        if p.metadata.name != "ns-owner"
+    ]
     assert ap.spec["rules"][0]["from"][0]["source"]["principals"] == ["bob@x.co"]
 
     listed = client(app, "alice@x.co").get("/kfam/v1/bindings?namespace=alice")
@@ -99,7 +104,7 @@ def test_contributor_binding_lifecycle(world):
     assert not subject_access_review(
         api, "bob@x.co", "create", "notebooks", "alice"
     )
-    assert api.list("AuthorizationPolicy", "alice") == []
+    assert [p.metadata.name for p in api.list("AuthorizationPolicy", "alice")] == ["ns-owner"]
 
 
 def test_non_owner_cannot_bind(world):

@@ -1,0 +1,139 @@
+"""Mesh AuthorizationPolicy: profile-owner parity + web-tier enforcement.
+
+VERDICT round-1 item #7: the reference creates the owner's Istio policy
+at namespace creation (`profile_controller.go:190`); kfam only covered
+contributors here. These tests pin owner-policy creation, the Istio
+ALLOW-semantics evaluator, and the fail-closed web gate.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import new_resource
+from kubeflow_tpu.api.rbac import (
+    make_cluster_role_binding,
+    seed_cluster_roles,
+)
+from kubeflow_tpu.apps.kfam import KfamApp
+from kubeflow_tpu.controllers.profile import KIND, ProfileController
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.web.authz import ensure_authorized
+from kubeflow_tpu.web.mesh import ensure_mesh_admits, mesh_admits
+from kubeflow_tpu.web.wsgi import HttpError, TestClient
+
+
+@pytest.fixture
+def api():
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    return api
+
+
+def _profile(name="team-a", owner="alice@example.com"):
+    return new_resource(
+        KIND, name, "default",
+        spec={"owner": {"kind": "User", "name": owner}},
+    )
+
+
+def test_profile_creates_owner_authorization_policy(api):
+    ctl = ProfileController(api)
+    api.create(_profile())
+    ctl.controller.run_until_idle()
+
+    ap = api.get("AuthorizationPolicy", "ns-owner", "team-a")
+    assert ap.spec["action"] == "ALLOW"
+    assert ap.spec["rules"][0]["from"][0]["source"]["principals"] == [
+        "alice@example.com"
+    ]
+    # Owned by the namespace: dies with the profile's cascade.
+    ns = api.get("Namespace", "team-a", "")
+    assert ap.metadata.owner_references[0]["uid"] == ns.metadata.uid
+
+
+def test_mesh_semantics():
+    api = FakeApiServer()
+    # No policies → open (hand-made/system namespaces stay reachable).
+    assert mesh_admits(api, "anyone@example.com", "plain-ns")
+    api.create(
+        new_resource(
+            "AuthorizationPolicy", "ns-owner", "team-a",
+            spec={
+                "action": "ALLOW",
+                "rules": [{"from": [{"source": {"principals": [
+                    "alice@example.com"]}}]}],
+            },
+        )
+    )
+    assert mesh_admits(api, "alice@example.com", "team-a")
+    assert not mesh_admits(api, "mallory@example.com", "team-a")
+    # A rule with no `from` admits all sources (Istio semantics).
+    api.create(
+        new_resource(
+            "AuthorizationPolicy", "open-door", "team-b",
+            spec={"action": "ALLOW", "rules": [{}]},
+        )
+    )
+    assert mesh_admits(api, "anyone@example.com", "team-b")
+
+
+def test_rbac_without_mesh_policy_fails_closed(api):
+    """A user holding an RBAC grant but no mesh policy is stopped at the
+    web tier — the exact gap VERDICT #7 describes, fail-closed."""
+    ctl = ProfileController(api)
+    api.create(_profile())  # owner alice; creates the ns-owner policy
+    ctl.controller.run_until_idle()
+    # Hand Bob RBAC directly (bypassing kfam, so no mesh policy).
+    api.create(
+        new_resource(
+            "RoleBinding", "rogue-grant", "team-a",
+            spec={
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+                "subjects": [{"kind": "User",
+                              "name": "bob@example.com"}],
+            },
+        )
+    )
+    ensure_authorized(api, "alice@example.com", "list", "notebooks",
+                      "team-a")
+    with pytest.raises(HttpError) as err:
+        ensure_authorized(api, "bob@example.com", "list", "notebooks",
+                          "team-a")
+    assert err.value.status == 403
+    assert "mesh policy" in err.value.message
+
+
+def test_kfam_binding_restores_mesh_access(api):
+    """The production contributor flow: kfam's binding creates both the
+    RoleBinding and the mesh policy, so the web tier admits them."""
+    ctl = ProfileController(api)
+    api.create(_profile())
+    ctl.controller.run_until_idle()
+    kfam = TestClient(
+        KfamApp(api),
+        headers={
+            "x-goog-authenticated-user-email":
+                "accounts.google.com:alice@example.com"
+        },
+    )
+    resp = kfam.post(
+        "/kfam/v1/bindings",
+        body={
+            "user": {"kind": "User", "name": "carol@example.com"},
+            "referredNamespace": "team-a",
+            "roleRef": {"kind": "ClusterRole", "name": "edit"},
+        },
+    )
+    assert resp.status == 200, resp.body
+    ensure_authorized(api, "carol@example.com", "list", "notebooks",
+                      "team-a")
+
+
+def test_cluster_admin_bypasses_mesh(api):
+    api.create(
+        make_cluster_role_binding("boot", "kubeflow-admin",
+                                  "root@example.com")
+    )
+    ctl = ProfileController(api)
+    api.create(_profile())
+    ctl.controller.run_until_idle()
+    ensure_mesh_admits(api, "root@example.com", "team-a")  # no raise
