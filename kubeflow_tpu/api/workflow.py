@@ -20,7 +20,13 @@ KIND = "Workflow"
 
 @dataclasses.dataclass(frozen=True)
 class StepSpec:
-    """One DAG node: a container run to completion."""
+    """One DAG node: a container run to completion.
+
+    `with_items` fans the step out into one instance per item at spec
+    load time (`<name>-<i>`, with `${item}` substituted in command/args/
+    env) — the Argo `withItems` surface. `when` is a conditional guard
+    evaluated after templating, once dependencies are satisfied: false →
+    the step is Skipped, and (Argo DAG semantics) dependents still run."""
 
     name: str
     command: tuple[str, ...] = ()
@@ -29,6 +35,8 @@ class StepSpec:
     env: tuple[tuple[str, str], ...] = ()
     dependencies: tuple[str, ...] = ()
     retries: int = 0
+    with_items: tuple[str, ...] = ()
+    when: str = ""
 
     def validate(self) -> None:
         if not self.name:
@@ -39,7 +47,7 @@ class StepSpec:
             raise ValueError(f"step {self.name!r}: retries must be >= 0")
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        d = {
             "name": self.name,
             "command": list(self.command),
             "args": list(self.args),
@@ -48,6 +56,11 @@ class StepSpec:
             "dependencies": list(self.dependencies),
             "retries": self.retries,
         }
+        if self.with_items:
+            d["withItems"] = list(self.with_items)
+        if self.when:
+            d["when"] = self.when
+        return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "StepSpec":
@@ -61,6 +74,8 @@ class StepSpec:
             ),
             dependencies=tuple(d.get("dependencies") or ()),
             retries=int(d.get("retries", 0)),
+            with_items=tuple(str(i) for i in d.get("withItems") or ()),
+            when=str(d.get("when", "")),
         )
 
 
@@ -94,6 +109,13 @@ class WorkflowSpec:
                 raise ValueError("onExit step name collides with a DAG step")
             if self.on_exit.dependencies:
                 raise ValueError("onExit step cannot have dependencies")
+            if self.on_exit.with_items:
+                raise ValueError("onExit step cannot fan out (withItems)")
+            if self.on_exit.when:
+                raise ValueError(
+                    "onExit step cannot be conditional — teardown must "
+                    "never be skipped"
+                )
         for s in self.steps:
             for dep in s.dependencies:
                 if dep not in names:
@@ -126,7 +148,8 @@ class WorkflowSpec:
 
         for s in self.steps:
             reachable = closure(s.name)
-            for value in (*s.command, *s.args, *(v for _, v in s.env)):
+            for value in (*s.command, *s.args, *(v for _, v in s.env),
+                          s.when):
                 for match in _TOKEN_RE.finditer(value):
                     ref = match.group(2)
                     if ref is not None and ref not in reachable:
@@ -172,8 +195,11 @@ class WorkflowSpec:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "WorkflowSpec":
+        steps, fanned = _expand_with_items(
+            tuple(StepSpec.from_dict(s) for s in d.get("steps") or ())
+        )
         spec = cls(
-            steps=tuple(StepSpec.from_dict(s) for s in d.get("steps") or ()),
+            steps=steps,
             on_exit=(
                 StepSpec.from_dict(d["onExit"]) if d.get("onExit") else None
             ),
@@ -184,6 +210,26 @@ class WorkflowSpec:
                 for k, v in (d.get("parameters") or {}).items()
             },
         )
+        if fanned:
+            # `${steps.<group>.output}` has no single value once a step is
+            # fanned out — catch it here with a targeted message (the
+            # generic dependency check would fire with a confusing one).
+            every = list(spec.steps) + (
+                [spec.on_exit] if spec.on_exit else []
+            )
+            for s in every:
+                for value in (*s.command, *s.args,
+                              *(v for _, v in s.env), s.when):
+                    for match in _TOKEN_RE.finditer(value):
+                        if match.group(2) in fanned:
+                            raise ValueError(
+                                f"step {s.name!r} references the output of "
+                                f"fanned-out step {match.group(2)!r}; "
+                                "address an instance "
+                                f"({match.group(2)}-0 ... "
+                                f"{match.group(2)}-"
+                                f"{len(fanned[match.group(2)]) - 1})"
+                            )
         spec.validate()
         return spec
 
@@ -192,6 +238,82 @@ _TOKEN_RE = re.compile(
     r"\$\{workflow\.parameters\.([A-Za-z0-9_.-]+)\}"
     r"|\$\{steps\.([A-Za-z0-9_.-]+)\.output\}"
 )
+
+
+def _expand_with_items(
+    steps: tuple[StepSpec, ...],
+) -> tuple[tuple[StepSpec, ...], dict[str, tuple[str, ...]]]:
+    """Fan each `withItems` step into `<name>-<i>` instances with
+    `${item}` substituted (Argo's withItems, the loop surface its CI DAGs
+    shard suites with); dependencies on the group name are rewritten to
+    all instances, so a downstream join waits for the whole fan."""
+    rename: dict[str, tuple[str, ...]] = {}
+    expanded: list[StepSpec] = []
+    for s in steps:
+        if not s.with_items:
+            expanded.append(s)
+            continue
+        names = []
+        for i, item in enumerate(s.with_items):
+            inst = dataclasses.replace(
+                s,
+                name=f"{s.name}-{i}",
+                command=tuple(c.replace("${item}", item) for c in s.command),
+                args=tuple(a.replace("${item}", item) for a in s.args),
+                env=tuple(
+                    (k, v.replace("${item}", item)) for k, v in s.env
+                ),
+                when=s.when.replace("${item}", item),
+                with_items=(),
+            )
+            names.append(inst.name)
+            expanded.append(inst)
+        rename[s.name] = tuple(names)
+    if not rename:
+        return tuple(expanded), {}
+    out = []
+    for s in expanded:
+        deps: list[str] = []
+        for dep in s.dependencies:
+            deps.extend(rename.get(dep, (dep,)))
+        out.append(dataclasses.replace(s, dependencies=tuple(deps)))
+    return tuple(out), rename
+
+
+def eval_when(
+    expr: str,
+    parameters: Mapping[str, str] | None = None,
+    outputs: Mapping[str, str] | None = None,
+) -> bool:
+    """Minimal Argo-`when` evaluator: `A == B`, `A != B`, or a bare
+    truthy token; operands are stripped of quotes and whitespace.
+
+    The operator is parsed from the RAW (untemplated) expression —
+    spec-author-controlled text — and the operands are rendered
+    separately afterwards. Rendering first would let a step output that
+    happens to contain `==`/`!=` re-shape the comparison (outputs are
+    arbitrary pod-written strings). Anything fancier than one comparison
+    belongs in the step itself."""
+    parameters = parameters or {}
+    outputs = outputs or {}
+
+    def operand(raw: str) -> str:
+        return render_value(raw, parameters, outputs).strip().strip("'\"")
+
+    expr = expr.strip()
+    if not expr:
+        return True
+    found = [
+        (pos, op)
+        for op in ("==", "!=")
+        if (pos := expr.find(op)) >= 0
+    ]
+    if found:
+        pos, op = min(found)
+        lhs = operand(expr[:pos])
+        rhs = operand(expr[pos + len(op):])
+        return (lhs == rhs) if op == "==" else (lhs != rhs)
+    return operand(expr).lower() not in ("false", "0")
 
 
 def render_value(

@@ -186,6 +186,10 @@ class WorkflowController:
                 or prev_steps.get(step.name, {}).get("state") == "Succeeded"
             ):
                 state = "Succeeded"
+            elif prev_steps.get(step.name, {}).get("state") == "Skipped":
+                # A `when` that evaluated false is a terminal decision —
+                # outputs it was judged on never change after the fact.
+                state = "Skipped"
             elif render_error:
                 state = "Failed"
             elif any(ph in ("Pending", "Running") for ph in phases):
@@ -234,15 +238,26 @@ class WorkflowController:
                 # failed (Argo's default DAG behavior); running ones drain.
                 continue
             if not all(
-                steps_status[d]["state"] == "Succeeded"
+                steps_status[d]["state"] in ("Succeeded", "Skipped")
                 for d in step.dependencies
             ):
+                # Argo DAG semantics: Skipped satisfies a dependency —
+                # dependents of a when-skipped step still run.
                 continue
             attempt = max(
                 next_attempt(by_step.get(step.name, [])),
                 max(st["failedAttempts"], default=-1) + 1,
             )
             try:
+                if step.when:
+                    # Conditional guard, evaluated once dependencies are
+                    # satisfied so `${steps.<dep>.output}` is available;
+                    # eval_when parses the operator before templating.
+                    if not wf_api.eval_when(
+                        step.when, spec.parameters, outputs
+                    ):
+                        st["state"] = "Skipped"
+                        continue
                 rendered = wf_api.render_step(
                     step, spec.parameters, outputs
                 )
@@ -264,7 +279,8 @@ class WorkflowController:
             active += 1
 
         dag_done = all(
-            s["state"] == "Succeeded" for s in steps_status.values()
+            s["state"] in ("Succeeded", "Skipped")
+            for s in steps_status.values()
         )
         dag_terminal = dag_done or (dag_failed and active == 0)
 

@@ -1,0 +1,41 @@
+"""Run one CI test shard: pytest over a target, junit into the shared
+artifacts volume under a filesystem-safe name.
+
+The fan-out step of `sharded_unit_tests_workflow` — the per-step wrapper
+pattern of the reference's workload launchers (`tf-cnn/launcher.py:68-88`
+wraps the benchmark; CI steps wrap pytest the same way):
+
+    python -m kubeflow_tpu.testing.shard_runner <target> [--junit-dir D]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import subprocess
+import sys
+
+
+def safe_name(target: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", target)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="shard-runner")
+    parser.add_argument("target")
+    parser.add_argument("--junit-dir", default="")
+    parser.add_argument(
+        "--pytest-args", default="-q", help="extra pytest flags (split on space)"
+    )
+    args = parser.parse_args(argv)
+    cmd = [sys.executable, "-m", "pytest", args.target,
+           *args.pytest_args.split()]
+    if args.junit_dir:
+        cmd.append(
+            f"--junitxml={args.junit_dir}/junit_{safe_name(args.target)}.xml"
+        )
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,53 @@ def unit_tests_workflow(
     return new_resource(KIND, name, namespace, spec=spec.to_dict())
 
 
+def sharded_unit_tests_workflow(
+    shards: tuple[str, ...],
+    name: str = "unit-tests-sharded",
+    namespace: str = "kubeflow-ci",
+    *,
+    artifacts_dir: str = "",
+    collect_required: bool = True,
+) -> Resource:
+    """Fan-out CI: one pytest pod per shard (`withItems`), junit XML into
+    the shared artifacts volume, then a collect step that merges the
+    shards' junit into one suite — the Argo DAG + NFS + Gubernator-copy
+    shape of `kfctl_go_test.jsonnet` expressed with the engine's own
+    fan-out/artifact surfaces. `collect_required=False` adds a `when`
+    guard demonstrating conditional collection (skip merging when a
+    parameter disables it)."""
+    if not artifacts_dir:
+        raise ValueError(
+            "sharded CI needs an artifacts_dir — junit collection is the "
+            "point of the join step"
+        )
+    collect = StepSpec(
+        name="collect-junit",
+        command=(sys.executable, "-m", "kubeflow_tpu.testing.junit_merge"),
+        args=(artifacts_dir,),
+        dependencies=("shard",),
+        when="" if collect_required
+        else "${workflow.parameters.collect} == true",
+    )
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(
+                name="shard",
+                command=(
+                    sys.executable, "-m",
+                    "kubeflow_tpu.testing.shard_runner",
+                ),
+                args=("${item}", "--junit-dir", artifacts_dir),
+                with_items=tuple(shards),
+            ),
+            collect,
+        ),
+        artifacts_dir=artifacts_dir,
+        parameters={} if collect_required else {"collect": "true"},
+    )
+    return new_resource(KIND, name, namespace, spec=spec.to_dict())
+
+
 def platform_e2e_workflow(
     name: str = "platform-e2e",
     namespace: str = "kubeflow-ci",

@@ -97,3 +97,104 @@ def test_failing_step_still_tears_down(tmp_path):
     assert phase == "Failed"
     files = {p.stem for p in artifacts.glob("*.txt")}
     assert "teardown" in files and "never" not in files
+
+
+def test_sharded_ci_fanout_with_junit_collection(tmp_path):
+    """The VERDICT-#9 deliverable end-to-end: the CI DSL fans pytest
+    shards out via withItems, each shard writes junit into the shared
+    artifacts volume, and the join step merges them — real subprocesses
+    throughout (the Argo DAG + NFS + Gubernator-copy shape of
+    `kfctl_go_test.jsonnet`, run by our own engine)."""
+    artifacts = tmp_path / "artifacts"
+    artifacts.mkdir()
+    from kubeflow_tpu.testing.workflows import sharded_unit_tests_workflow
+
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    runner = LocalPodRunner(api)
+    wf = sharded_unit_tests_workflow(
+        ("tests/test_overlays.py", "tests/test_records.py"),
+        namespace="ci",
+        artifacts_dir=str(artifacts),
+    )
+    api.create(wf)
+    try:
+        phase = _drive(api, ctl, runner, "unit-tests-sharded",
+                       deadline_s=300)
+    finally:
+        runner.shutdown()
+
+    assert phase == "Succeeded"
+    # Each shard staged its junit in the shared volume; the collect step
+    # merged them.
+    shard_files = sorted(p.name for p in artifacts.glob("junit_tests*"))
+    assert len(shard_files) == 2, shard_files
+    merged = (artifacts / "junit_merged.xml").read_text()
+    assert "testsuite" in merged
+    status = api.get(KIND, "unit-tests-sharded", "ci").status
+    assert status["steps"]["shard-0"]["state"] == "Succeeded"
+    assert status["steps"]["collect-junit"]["state"] == "Succeeded"
+
+
+def test_conditional_step_skipped_end_to_end(tmp_path):
+    """`when` guard over a real step output: the probe reports healthy,
+    remediation is skipped, the report still runs."""
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+    from kubeflow_tpu.web.wsgi import serve
+
+    artifacts = tmp_path / "artifacts"
+    artifacts.mkdir()
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    ctl = WorkflowController(api)
+    runner = LocalPodRunner(
+        api,
+        extra_env={
+            "KFTPU_APISERVER": f"http://127.0.0.1:{server.server_port}"
+        },
+    )
+
+    # The probe honors the output contract: report_step_output over the
+    # facade BEFORE exiting 0, so the guard always sees the value.
+    probe = StepSpec(
+        name="probe",
+        command=(
+            sys.executable,
+            "-c",
+            "import os;"
+            "from kubeflow_tpu.testing.apiserver_http import HttpApiClient;"
+            "from kubeflow_tpu.controllers.workflow import report_step_output;"
+            "report_step_output("
+            "HttpApiClient(os.environ['KFTPU_APISERVER']),"
+            "os.environ['POD_NAME'],os.environ['POD_NAMESPACE'],'healthy')",
+        ),
+    )
+    spec = WorkflowSpec(
+        steps=(
+            probe,
+            StepSpec(
+                name="remediate",
+                command=(sys.executable, "-c",
+                         "import pathlib,os;"
+                         "pathlib.Path(os.environ['STEP_ARTIFACTS'],"
+                         "'remediated.txt').write_text('x')"),
+                dependencies=("probe",),
+                when="${steps.probe.output} == unhealthy",
+            ),
+            _write_step("report", deps=("remediate",)),
+        ),
+        artifacts_dir=str(artifacts),
+    )
+    api.create(new_resource(KIND, "guarded", "ci", spec=spec.to_dict()))
+
+    try:
+        _drive(api, ctl, runner, "guarded")
+    finally:
+        runner.shutdown()
+        server.shutdown()
+
+    status = api.get(KIND, "guarded", "ci").status
+    assert status["phase"] == "Succeeded", status
+    assert status["steps"]["remediate"]["state"] == "Skipped"
+    assert not (artifacts / "remediated.txt").exists()
+    assert (artifacts / "report.txt").exists()

@@ -471,3 +471,220 @@ def test_output_containing_template_text_is_safe():
         {"gen": "${workflow.parameters.evil}"},
     )
     assert out == "use ${workflow.parameters.evil}"
+
+
+# -- withItems fan-out + when conditionals (the remaining Argo surface) ----
+
+
+def test_with_items_expands_and_rewrites_dependencies():
+    spec = WorkflowSpec.from_dict(
+        {
+            "steps": [
+                {
+                    "name": "shard",
+                    "command": ["run", "${item}"],
+                    "withItems": ["a", "b", "c"],
+                },
+                {
+                    "name": "join",
+                    "command": ["collect"],
+                    "dependencies": ["shard"],
+                },
+            ]
+        }
+    )
+    names = [s.name for s in spec.steps]
+    assert names == ["shard-0", "shard-1", "shard-2", "join"]
+    assert spec.step("shard-1").command == ("run", "b")
+    # The join waits for the WHOLE fan.
+    assert spec.step("join").dependencies == ("shard-0", "shard-1", "shard-2")
+
+
+def test_with_items_output_reference_rejected():
+    with pytest.raises(ValueError, match="fanned-out"):
+        WorkflowSpec.from_dict(
+            {
+                "steps": [
+                    {
+                        "name": "shard",
+                        "command": ["run", "${item}"],
+                        "withItems": ["a", "b"],
+                    },
+                    {
+                        "name": "join",
+                        "command": ["collect", "${steps.shard.output}"],
+                        "dependencies": ["shard"],
+                    },
+                ]
+            }
+        )
+
+
+def test_eval_when_semantics():
+    from kubeflow_tpu.api.workflow import eval_when
+
+    assert eval_when("")                        # no guard → run
+    assert eval_when("x == x")
+    assert eval_when("'yes' == yes")
+    assert not eval_when("a == b")
+    assert eval_when("a != b")
+    assert not eval_when("false")
+    assert not eval_when("0")
+    assert eval_when("anything-else")
+
+
+def test_when_false_skips_step_and_dependents_still_run():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(
+            step("probe"),
+            StepSpec(
+                name="remediate",
+                command=ECHO,
+                dependencies=("probe",),
+                when="${steps.probe.output} == unhealthy",
+            ),
+            step("report", deps=("remediate",)),
+        )
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    [probe] = pods_for(api, "probe")
+    # probe reports healthy → remediate's guard is false.
+    fresh = api.get("Pod", probe.metadata.name, "ci")
+    fresh.status["phase"] = "Succeeded"
+    fresh.status["output"] = "healthy"
+    api.update_status(fresh)
+    ctl.controller.run_until_idle()
+    assert pods_for(api, "remediate") == []  # never materialized
+    # Argo DAG semantics: Skipped satisfies the dependent.
+    [report] = pods_for(api, "report")
+    finish(api, report)
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Succeeded"
+    assert wf.status["steps"]["remediate"]["state"] == "Skipped"
+
+
+def test_when_true_runs_step():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(
+            step("probe"),
+            StepSpec(
+                name="remediate",
+                command=ECHO,
+                dependencies=("probe",),
+                when="${steps.probe.output} == unhealthy",
+            ),
+        )
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    [probe] = pods_for(api, "probe")
+    fresh = api.get("Pod", probe.metadata.name, "ci")
+    fresh.status["phase"] = "Succeeded"
+    fresh.status["output"] = "unhealthy"
+    api.update_status(fresh)
+    ctl.controller.run_until_idle()
+    [remediate] = pods_for(api, "remediate")
+    finish(api, remediate)
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Succeeded"
+
+
+def test_on_exit_cannot_be_conditional_or_fanned():
+    with pytest.raises(ValueError, match="skipped"):
+        WorkflowSpec(
+            steps=(step("a"),),
+            on_exit=StepSpec(name="t", command=ECHO, when="x == y"),
+        ).validate()
+    with pytest.raises(ValueError, match="withItems"):
+        WorkflowSpec(
+            steps=(step("a"),),
+            on_exit=StepSpec(name="t", command=ECHO, with_items=("i",)),
+        ).validate()
+
+
+def test_sharded_ci_workflow_shape(tmp_path):
+    from kubeflow_tpu.testing.workflows import sharded_unit_tests_workflow
+
+    wf = sharded_unit_tests_workflow(
+        ("tests/a", "tests/b"), artifacts_dir=str(tmp_path)
+    )
+    spec = WorkflowSpec.from_dict(wf.spec)
+    names = [s.name for s in spec.steps]
+    assert names == ["shard-0", "shard-1", "collect-junit"]
+    assert "tests/a" in spec.step("shard-0").args
+    assert spec.step("collect-junit").dependencies == ("shard-0", "shard-1")
+
+
+def test_junit_merge(tmp_path):
+    from kubeflow_tpu.testing.e2e_util import TestResult, junit_xml
+    from kubeflow_tpu.testing.junit_merge import merge
+
+    (tmp_path / "junit_s1.xml").write_text(
+        junit_xml("s1", [TestResult("t1", 0.1), TestResult("t2", 0.2)])
+    )
+    (tmp_path / "junit_s2.xml").write_text(
+        junit_xml("s2", [TestResult("t3", 0.1, failure="boom")])
+    )
+    tests, fails, errs = merge(tmp_path)
+    assert (tests, fails) == (3, 1)
+    assert (tmp_path / "junit_merged.xml").exists()
+
+
+def test_eval_when_operator_parsed_before_templating():
+    """A step output containing '==' must not re-shape the comparison
+    (outputs are arbitrary pod-written strings)."""
+    from kubeflow_tpu.api.workflow import eval_when
+
+    # Raw guard: output != "ok". Output value contains " == ".
+    assert eval_when(
+        "${steps.probe.output} != ok", {}, {"probe": "x == y"}
+    )
+    assert not eval_when(
+        "${steps.probe.output} == ok", {}, {"probe": "x == y"}
+    )
+    assert not eval_when(
+        "${steps.probe.output} != ok", {}, {"probe": "ok"}
+    )
+
+
+def test_when_output_reference_requires_dependency():
+    """`when` is scanned by the same load-time guard as command/args/env:
+    referencing a non-dependency's output is a spec error, not a
+    timing-dependent runtime failure."""
+    with pytest.raises(ValueError, match="does not depend"):
+        WorkflowSpec.from_dict(
+            {
+                "steps": [
+                    {"name": "a", "command": ["x"]},
+                    {
+                        "name": "b",
+                        "command": ["y"],
+                        "when": "${steps.a.output} == go",
+                    },
+                ]
+            }
+        )
+    with pytest.raises(ValueError, match="fanned-out"):
+        WorkflowSpec.from_dict(
+            {
+                "steps": [
+                    {
+                        "name": "shard",
+                        "command": ["run", "${item}"],
+                        "withItems": ["a", "b"],
+                    },
+                    {
+                        "name": "b",
+                        "command": ["y"],
+                        "dependencies": ["shard"],
+                        "when": "${steps.shard.output} == go",
+                    },
+                ]
+            }
+        )
