@@ -205,18 +205,21 @@ class TrialRecord:
     suggestion state survives controller restarts for free."""
 
     index: int
-    state: str  # Pending | Running | Succeeded | Failed
+    state: str  # Pending | Running | Succeeded | Failed | Pruned
     assignment: dict[str, Any] = dataclasses.field(default_factory=dict)
     objective: float | None = None
 
     @property
     def terminal(self) -> bool:
-        return self.state in ("Succeeded", "Failed")
+        return self.state in ("Succeeded", "Failed", "Pruned")
 
     @property
     def scored(self) -> bool:
+        # A pruned trial scores with its last curve value: halving's
+        # survivor ranking then naturally eliminates it (it was pruned
+        # precisely for being worse than the median).
         return (
-            self.state == "Succeeded"
+            self.state in ("Succeeded", "Pruned")
             and isinstance(self.objective, (int, float))
             and math.isfinite(self.objective)
         )
@@ -249,6 +252,13 @@ class StudySpec:
     min_budget: float = 1.0
     max_budget: float = 9.0
     budget_parameter: str = "budget"
+    # Early stopping on trial metric curves (`status.metrics`, reported
+    # via launcher.report_metrics): a running trial whose curve value at
+    # step s is worse than the median of its peers' values at s is pruned
+    # mid-run (katib's median-stopping rule). Off unless minSteps is set.
+    #   {"minSteps": int   — don't judge before this step,
+    #    "minPeers": int}  — need this many comparable peers (default 2)
+    early_stopping: dict[str, Any] = dataclasses.field(default_factory=dict)
     # TpuJob spec dict with ${trialParameters.<name>} placeholders.
     trial_template: dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -272,6 +282,14 @@ class StudySpec:
                 raise ValueError("gamma must be in (0, 1)")
             if self.startup_trials < 1:
                 raise ValueError("startupTrials must be >= 1")
+        if self.early_stopping:
+            if int(self.early_stopping.get("minSteps", 0)) < 1:
+                raise ValueError(
+                    "earlyStopping.minSteps must be >= 1 (it is the "
+                    "enable switch)"
+                )
+            if int(self.early_stopping.get("minPeers", 2)) < 1:
+                raise ValueError("earlyStopping.minPeers must be >= 1")
         if self.algorithm == "halving":
             if self.eta < 2:
                 raise ValueError("eta must be >= 2")
@@ -545,7 +563,7 @@ class StudySpec:
             algorithm["minBudget"] = self.min_budget
             algorithm["maxBudget"] = self.max_budget
             algorithm["budgetParameter"] = self.budget_parameter
-        return {
+        d = {
             "parameters": [p.to_dict() for p in self.parameters],
             "objective": {"metric": self.objective_metric, "goal": self.goal},
             "algorithm": algorithm,
@@ -554,6 +572,9 @@ class StudySpec:
             "maxFailedTrials": self.max_failed_trials,
             "trialTemplate": dict(self.trial_template),
         }
+        if self.early_stopping:
+            d["earlyStopping"] = dict(self.early_stopping)
+        return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "StudySpec":
@@ -576,10 +597,53 @@ class StudySpec:
             max_trials=int(d.get("maxTrials", 10)),
             parallelism=int(d.get("parallelism", 2)),
             max_failed_trials=int(d.get("maxFailedTrials", 3)),
+            early_stopping=dict(d.get("earlyStopping") or {}),
             trial_template=dict(d.get("trialTemplate") or {}),
         )
         spec.validate()
         return spec
+
+    # -- early stopping (median rule over metric curves) -----------------
+
+    @property
+    def prunes(self) -> bool:
+        return bool(self.early_stopping.get("minSteps"))
+
+    def should_prune(
+        self,
+        curve: list[tuple[int, float]],
+        peer_curves: list[list[tuple[int, float]]],
+    ) -> bool:
+        """Curve-based early stopping, conservative by construction:
+        prune only a trial whose objective at its latest step is strictly
+        worse than EVERY peer's value at that step (which implies worse
+        than the peer median — katib's median-stop criterion — but cannot
+        cascade: naive worse-than-median pruning re-shifts the median
+        after each prune and eliminates half the healthy trials, while
+        worse-than-all prunes exactly the stragglers; bulk elimination
+        stays where it belongs, at halving's rung boundaries). Pruned
+        trials' last values remain in the comparison set, anchoring it.
+
+        Curves are (step, value) ascending; a peer contributes its value
+        at the largest step <= s, so a peer ahead of this trial is judged
+        where this trial is, not where the peer is."""
+        if not self.prunes or not curve:
+            return False
+        min_steps = int(self.early_stopping.get("minSteps", 0))
+        min_peers = int(self.early_stopping.get("minPeers", 2))
+        step, value = curve[-1]
+        if step < min_steps or not math.isfinite(value):
+            return False
+        peer_values = []
+        for peer in peer_curves:
+            at = [v for s, v in peer if s <= step]
+            if at and math.isfinite(at[-1]):
+                peer_values.append(at[-1])
+        if len(peer_values) < min_peers:
+            return False
+        if self.goal == "minimize":
+            return value > max(peer_values)
+        return value < min(peer_values)
 
 
 def render_template(template: Any, assignment: dict[str, Any]) -> Any:

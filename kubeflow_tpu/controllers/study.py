@@ -157,12 +157,18 @@ class StudyController:
             if t.metadata.labels.get(LABEL_TRIAL, "").isdigit()
         }
 
+        # Pruned trials persist in status only — their CRs are deleted, so
+        # status is the single witness (the workflow controller's
+        # failedAttempts pattern).
+        pruned: dict[str, dict] = dict(study.status.get("prunedTrials") or {})
+
         # Harvest: every terminal trial contributes a status row and a
         # TrialRecord (the suggester's view); succeeded trials with an
         # observation compete for best.
         rows = []
         records = []
         best = None
+        curves: dict[int, list[tuple[int, float]]] = {}
         active = failed = succeeded = 0
         for idx in sorted(by_index):
             trial = by_index[idx]
@@ -204,7 +210,84 @@ class StudyController:
                 failed += 1
             else:
                 active += 1
+            # Metric curve (launcher.report_metrics): (step, value)
+            # ascending, for the early-stopping pass below.
+            curve = []
+            for point in trial.status.get("metrics") or []:
+                v = _numeric(point.get(spec.objective_metric))
+                step_n = point.get("step")
+                if v is not None and isinstance(step_n, int):
+                    curve.append((step_n, v))
+            if curve:
+                curves[idx] = sorted(curve)
             rows.append(row)
+
+        # Early stopping: prune running trials whose learning curve is
+        # worse than the median of their peers at the same step (katib's
+        # median-stop; the reference only asserted StudyJob liveness,
+        # `katib_studyjob_test.py:115-120`). The pruned trial's CR is
+        # deleted (its gang frees the slice NOW — idle TPUs are the cost
+        # center) and its last value is recorded as its score.
+        if spec.prunes:
+            for idx in sorted(curves):
+                trial = by_index[idx]
+                if trial.status.get("phase") in TRIAL_TERMINAL:
+                    continue
+                peer_curves = [
+                    c for i, c in curves.items() if i != idx
+                ] + [
+                    [(int(e["step"]), float(e["objective"]))]
+                    for e in pruned.values()
+                ]
+                if not spec.should_prune(curves[idx], peer_curves):
+                    continue
+                step_n, value = curves[idx][-1]
+                pruned[str(idx)] = {
+                    "objective": value,
+                    "step": step_n,
+                    "assignment": _trial_assignment(trial),
+                    "name": trial.metadata.name,
+                }
+                api.record_event(
+                    study, "TrialPruned",
+                    f"trial {idx} pruned at step {step_n} "
+                    f"({spec.objective_metric}={value:g} worse than "
+                    "peer median)",
+                )
+                try:
+                    api.delete(tpujob_api.KIND, trial.metadata.name, ns)
+                except NotFound:
+                    pass
+                active -= 1
+                # Replace this trial's live row/record with the pruned view
+                # below (fall through to the merge).
+                rows = [r for r in rows if r["index"] != idx]
+                records = [r for r in records if r.index != idx]
+
+        # Merge pruned trials (current and prior passes) into the
+        # suggester's view: terminal + scored-with-bad-value, so halving
+        # settles its rungs and never promotes them.
+        for key, entry in sorted(pruned.items(), key=lambda kv: int(kv[0])):
+            idx = int(key)
+            rows.append(
+                {
+                    "name": entry.get("name", trial_name(name, idx)),
+                    "index": idx,
+                    "state": "Pruned",
+                    "objective": entry["objective"],
+                    "prunedAtStep": entry["step"],
+                }
+            )
+            records.append(
+                study_api.TrialRecord(
+                    index=idx,
+                    state="Pruned",
+                    assignment=dict(entry.get("assignment") or {}),
+                    objective=_numeric(entry["objective"]),
+                )
+            )
+        rows.sort(key=lambda r: r["index"])
+        records.sort(key=lambda r: r.index)
 
         if failed > spec.max_failed_trials:
             api.record_event(
@@ -225,14 +308,16 @@ class StudyController:
                         pass
             return self._finish(
                 api, study, "Failed", trials=rows, best=best,
-                reason="maxFailedTrials exceeded",
+                reason="maxFailedTrials exceeded", pruned=pruned,
             )
 
         # High-water mark: indices at/below it are spent even if their
-        # trial was deleted (deleted trials are never re-run).
+        # trial was deleted (deleted trials are never re-run). Pruned
+        # indices are spent by construction.
         floor = max(
             _int_or(study.status.get("maxTrialIndex"), -1),
             max(by_index, default=-1),
+            max((int(k) for k in pruned), default=-1),
         )
         new_trials, done = spec.suggest(
             records, slots=spec.parallelism - active, floor=floor
@@ -247,13 +332,18 @@ class StudyController:
 
         if done and not new_trials and active == 0:
             return self._finish(
-                api, study, "Succeeded", trials=rows, best=best
+                api, study, "Succeeded", trials=rows, best=best,
+                pruned=pruned,
             )
         return self._update_status(
             api, study, "Running",
             trials=rows, best=best,
-            counts={"active": active, "succeeded": succeeded, "failed": failed},
+            counts={
+                "active": active, "succeeded": succeeded,
+                "failed": failed, "pruned": len(pruned),
+            },
             max_index=floor,
+            pruned=pruned,
         )
 
     # -- status ----------------------------------------------------------
@@ -269,6 +359,7 @@ class StudyController:
         counts=None,
         reason: str | None = None,
         max_index: int | None = None,
+        pruned: dict | None = None,
     ) -> Result:
         fresh = api.get(
             study_api.KIND, study.metadata.name, study.metadata.namespace
@@ -278,6 +369,8 @@ class StudyController:
             new_status["trials"] = trials
         if best is not None:
             new_status["bestTrial"] = best
+        if pruned:
+            new_status["prunedTrials"] = pruned
         if counts is not None:
             new_status["trialStatuses"] = counts
         if max_index is not None and max_index >= 0:
@@ -305,12 +398,16 @@ class StudyController:
         )
         return Result()
 
-    def _finish(self, api, study, phase, *, trials=None, best=None, reason=None):
+    def _finish(
+        self, api, study, phase, *,
+        trials=None, best=None, reason=None, pruned=None,
+    ):
         api.record_event(
             study,
             "StudySucceeded" if phase == "Succeeded" else "StudyFailed",
             f"best: {best['name']}={best['objective']}" if best else phase,
         )
         return self._update_status(
-            api, study, phase, trials=trials, best=best, reason=reason
+            api, study, phase, trials=trials, best=best, reason=reason,
+            pruned=pruned,
         )

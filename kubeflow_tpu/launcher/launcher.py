@@ -77,6 +77,47 @@ def report_observation(
     log.info("reported observation %s for %s/%s", metrics, namespace, job_name)
 
 
+def report_metrics(
+    api,
+    job_name: str,
+    namespace: str,
+    step: int,
+    metrics: dict[str, float],
+) -> None:
+    """Publish one point of the training curve onto the TpuJob's
+    `status.metrics` — the per-step companion of `report_observation`.
+
+    The Study controller reads these curves to prune hopeless trials
+    mid-run (katib's early-stopping/median-stop service consumed the same
+    stream from its metrics collector; the reference only asserted
+    StudyJob liveness, `testing/katib_studyjob_test.py:115-120`). Process
+    0 calls this every eval interval with e.g. ``step=200,
+    {"loss": 0.8}``. Points are append-only and step-ordered; a
+    re-reported step overwrites its previous values (restart-after-resume
+    re-emits the resumed step)."""
+    from kubeflow_tpu.testing.fake_apiserver import Conflict
+
+    for attempt in range(10):
+        job = api.get("TpuJob", job_name, namespace)
+        curve = [
+            dict(p)
+            for p in job.status.get("metrics") or []
+            if int(p.get("step", -1)) != step
+        ]
+        point = {"step": int(step)}
+        point.update({k: float(v) for k, v in metrics.items()})
+        curve.append(point)
+        curve.sort(key=lambda p: p["step"])
+        job.status["metrics"] = curve
+        try:
+            api.update_status(job)
+            return
+        except Conflict:
+            if attempt == 9:
+                raise
+            time.sleep(0.05 * (attempt + 1))
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="kubeflow-tpu-launcher")
     parser.add_argument(

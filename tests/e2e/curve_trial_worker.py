@@ -1,0 +1,53 @@
+"""Curve-reporting trial worker for the early-stopping E2E.
+
+Simulates training: reports a per-step loss curve via
+launcher.report_metrics over the HTTP apiserver facade. A diverging
+configuration (--lr >= 1.0) reports exploding losses and then blocks
+"training" far longer than the test budget — only an external prune
+(Study controller deletes the trial, pod runner kills this process) ends
+it. Healthy configurations converge and report a final observation.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+from kubeflow_tpu.launcher.launcher import (  # noqa: E402
+    report_metrics,
+    report_observation,
+)
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lr", type=float, required=True)
+    args = parser.parse_args()
+
+    api = HttpApiClient(os.environ["KFTPU_APISERVER"])
+    job = os.environ["TPUJOB_NAME"]
+    ns = os.environ["TPUJOB_NAMESPACE"]
+    diverges = args.lr >= 1.0
+
+    for step in range(1, 4):
+        loss = (
+            10.0 ** step if diverges
+            else (args.lr - 0.05) ** 2 + 1.0 / step
+        )
+        report_metrics(api, job, ns, step, {"loss": loss})
+        time.sleep(0.3)
+
+    if diverges:
+        # "Training" that would never finish inside the test budget: the
+        # prune must kill us. Exiting 0 here would mask a missing prune.
+        time.sleep(600)
+        return
+
+    report_observation(api, job, ns, {"loss": (args.lr - 0.05) ** 2})
+
+
+if __name__ == "__main__":
+    main()

@@ -697,3 +697,172 @@ def test_observation_report_roundtrip():
     report_observation(api, "j", "team", {"loss": 0.2})
     job = api.get("TpuJob", "j", "team")
     assert job.status["observation"] == {"loss": 0.2, "acc": 0.9}
+
+
+# -- early stopping on metric curves (VERDICT #10) -------------------------
+
+
+ES_TEMPLATE = {
+    "replicas": 1,
+    "image": "kubeflow-tpu/worker:test",
+    "command": ["python", "train.py"],
+    "args": ["--lr", "${trialParameters.lr}"],
+    "tpu": {"chipsPerWorker": 0},
+}
+
+
+def _es_spec(**kw):
+    defaults = dict(
+        parameters=(
+            ParameterSpec("lr", "double", min=0.01, max=0.1, grid_points=4),
+        ),
+        objective_metric="loss",
+        algorithm="grid",
+        max_trials=4,
+        parallelism=4,
+        early_stopping={"minSteps": 2, "minPeers": 2},
+        trial_template=ES_TEMPLATE,
+    )
+    defaults.update(kw)
+    return StudySpec(**defaults)
+
+
+def test_should_prune_worse_than_all_peers():
+    spec = _es_spec()
+    good = [(1, 0.9), (2, 0.5)]
+    ok = [(1, 1.0), (2, 0.6)]
+    bad = [(1, 1.1), (2, 2.0)]
+    assert spec.should_prune(bad, [good, ok])
+    assert not spec.should_prune(good, [ok, bad])
+    # Worse than some but not ALL peers: kept (no cascade pruning).
+    assert not spec.should_prune(ok, [good, bad])
+    # Below minSteps: never judged.
+    assert not spec.should_prune([(1, 99.0)], [good, ok])
+    # Too few peers at a comparable step: never judged.
+    assert not spec.should_prune(bad, [good])
+    # A peer ahead of us is judged at OUR step, not its own.
+    ahead = [(1, 0.9), (2, 0.5), (3, 0.1)]
+    assert spec.should_prune([(2, 1.0)], [ahead, ok])  # ahead@2 = 0.5
+    # Maximize flips the direction.
+    up = _es_spec(goal="maximize")
+    assert up.should_prune([(2, 0.1)], [[(2, 0.5)], [(2, 0.6)]])
+    assert not up.should_prune([(2, 0.55)], [[(2, 0.5)], [(2, 0.6)]])
+
+
+def test_early_stopping_validation():
+    with pytest.raises(ValueError, match="minSteps"):
+        _es_spec(early_stopping={"minSteps": 0}).validate()
+    with pytest.raises(ValueError, match="minPeers"):
+        _es_spec(early_stopping={"minSteps": 1, "minPeers": 0}).validate()
+
+
+def _report_curve(api, name, points):
+    from kubeflow_tpu.launcher.launcher import report_metrics
+
+    for step, loss in points:
+        report_metrics(api, name, "team", step, {"loss": loss})
+
+
+def test_controller_prunes_bad_trial_mid_run():
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    spec = _es_spec()
+    api.create(new_resource(KIND, "study1", "team", spec=spec.to_dict()))
+    ctl.controller.run_until_idle()
+    trials = sorted(
+        t.metadata.name
+        for t in api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    )
+    assert len(trials) == 4
+
+    # Three trials learn; one diverges. All report curves mid-run.
+    _report_curve(api, trials[0], [(1, 0.9), (2, 0.5)])
+    _report_curve(api, trials[1], [(1, 1.0), (2, 0.6)])
+    _report_curve(api, trials[2], [(1, 1.0), (2, 0.55)])
+    _report_curve(api, trials[3], [(1, 1.2), (2, 4.0)])
+    ctl.controller.run_until_idle()
+
+    study = api.get(KIND, "study1", "team")
+    assert "3" in study.status["prunedTrials"]
+    assert study.status["prunedTrials"]["3"]["objective"] == 4.0
+    # The CR is gone — the gang's slice is freed immediately.
+    live = {
+        t.metadata.name
+        for t in api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    }
+    assert trials[3] not in live and len(live) == 3
+    reasons = [e.spec["reason"] for e in api.list("Event", "team")]
+    assert "TrialPruned" in reasons
+
+    # Survivors finish; the study completes with the pruned trial on
+    # record (state Pruned, never revived) and the best from survivors.
+    for t, loss in zip(trials[:3], (0.4, 0.5, 0.45)):
+        finish_trial(api, t, loss=loss)
+    ctl.controller.run_until_idle()
+    study = api.get(KIND, "study1", "team")
+    assert study.status["phase"] == "Succeeded", study.status
+    states = {r["index"]: r["state"] for r in study.status["trials"]}
+    assert states[3] == "Pruned"
+    assert study.status["bestTrial"]["objective"] == 0.4
+    assert study.status["trialStatuses"]["pruned"] == 1
+
+
+def test_pruned_trial_counts_for_halving_rung():
+    """Halving settles a rung whose worst member was pruned mid-run and
+    promotes only genuine survivors — pruning on learning curves, not
+    just final observations."""
+    api = FakeApiServer()
+    ctl = StudyController(api)
+    spec = StudySpec(
+        parameters=(
+            ParameterSpec("lr", "double", min=0.01, max=0.1, grid_points=4),
+        ),
+        objective_metric="loss",
+        algorithm="halving",
+        max_trials=4,
+        parallelism=4,
+        eta=2,
+        min_budget=1,
+        max_budget=2,
+        early_stopping={"minSteps": 2, "minPeers": 2},
+        trial_template=ES_TEMPLATE,
+    )
+    api.create(new_resource(KIND, "study1", "team", spec=spec.to_dict()))
+    ctl.controller.run_until_idle()
+    rung0 = sorted(
+        t.metadata.name
+        for t in api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    )
+    assert len(rung0) == 4
+
+    # One rung-0 trial diverges mid-run and is pruned on its curve.
+    _report_curve(api, rung0[0], [(1, 0.8), (2, 0.5)])
+    _report_curve(api, rung0[1], [(1, 0.9), (2, 0.6)])
+    _report_curve(api, rung0[2], [(1, 1.0), (2, 0.7)])
+    _report_curve(api, rung0[3], [(1, 1.1), (2, 9.0)])
+    ctl.controller.run_until_idle()
+    study = api.get(KIND, "study1", "team")
+    assert len(study.status.get("prunedTrials", {})) == 1
+
+    # The three live trials finish their rung-0 budget; the rung settles
+    # (the pruned one is terminal+scored) and rung 1 materializes with
+    # the best survivors, never the pruned config.
+    for t, loss in zip(rung0[:3], (0.5, 0.6, 0.7)):
+        finish_trial(api, t, loss=loss)
+    ctl.controller.run_until_idle()
+    live = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
+    rung1 = [
+        t for t in live
+        if int(t.metadata.labels[LABEL_TRIAL]) >= 4
+    ]
+    assert len(rung1) == 2, [t.metadata.name for t in live]
+    import json as _json
+
+    promoted = [
+        _json.loads(t.metadata.annotations[ANNOTATION_PARAMS])["lr"]
+        for t in rung1
+    ]
+    pruned_lr = study.status["prunedTrials"][
+        next(iter(study.status["prunedTrials"]))
+    ]["assignment"]["lr"]
+    assert pruned_lr not in promoted
