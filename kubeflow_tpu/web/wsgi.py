@@ -131,6 +131,9 @@ class _Route:
     def __init__(self, pattern: str, methods: tuple[str, ...], handler):
         self.methods = methods
         self.handler = handler
+        # Declared form, kept for introspection (the OpenAPI drift gate
+        # derives spec paths from it — kubeflow_tpu/web/openapi.py).
+        self.pattern = pattern
         # <name> matches one path segment; <name:path> matches the rest of
         # the path, slashes included (catch-all routes). Single-pass sub so
         # the emitted (?P<name>...) groups are never re-substituted.

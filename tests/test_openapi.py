@@ -1,0 +1,94 @@
+"""OpenAPI drift gates: docs/api/*.yaml must match the live route tables.
+
+The reference ships a swagger spec for its deploy service
+(`bootstrap/api/swagger.yaml`) and kfam is swagger-generated; our specs
+are checked in and this gate fails CI the moment a route and its spec
+disagree (VERDICT round-1 item #6).
+"""
+
+import pathlib
+
+import pytest
+import yaml
+
+from kubeflow_tpu.apps.kfam import KfamApp
+from kubeflow_tpu.deploy.provisioner import FakeCloud
+from kubeflow_tpu.deploy.server import DeployServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.openapi import (
+    route_table,
+    skeleton,
+    spec_drift,
+    spec_operations,
+)
+
+DOCS = pathlib.Path(__file__).resolve().parent.parent / "docs" / "api"
+
+
+def _apps():
+    api = FakeApiServer()
+    return {
+        "apiserver.yaml": ApiServerApp(api),
+        "kfam.yaml": KfamApp(api),
+        "deploy.yaml": DeployServer(api, FakeCloud(api)),
+    }
+
+
+@pytest.mark.parametrize("spec_file", ["apiserver.yaml", "kfam.yaml",
+                                       "deploy.yaml"])
+def test_spec_matches_routes(spec_file):
+    app = _apps()[spec_file]
+    spec = yaml.safe_load((DOCS / spec_file).read_text())
+    drift = spec_drift(app, spec)
+    assert not drift, "\n".join(drift)
+
+
+@pytest.mark.parametrize("spec_file", ["apiserver.yaml", "kfam.yaml",
+                                       "deploy.yaml"])
+def test_spec_is_valid_openapi3_shape(spec_file):
+    spec = yaml.safe_load((DOCS / spec_file).read_text())
+    assert spec["openapi"].startswith("3.")
+    assert spec["info"]["title"] and spec["info"]["version"]
+    assert spec_operations(spec)
+    for path, ops in spec["paths"].items():
+        assert path.startswith("/")
+        for method, op in ops.items():
+            assert "responses" in op, f"{method} {path} has no responses"
+            # Every templated path parameter is declared.
+            declared = {
+                p["name"]
+                for p in op.get("parameters", [])
+                if p.get("in") == "path"
+            }
+            import re
+
+            for param in re.findall(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}", path):
+                assert param in declared, (
+                    f"{method} {path}: path param {param!r} undeclared"
+                )
+
+
+def test_drift_gate_catches_both_directions():
+    api = FakeApiServer()
+    app = ApiServerApp(api)
+    spec = skeleton(app, "t")
+    assert spec_drift(app, spec) == []
+    # Route removed from the spec → flagged.
+    broken = yaml.safe_load(yaml.safe_dump(spec))
+    broken["paths"].pop("/debug/traces")
+    assert any("route not in spec" in d for d in spec_drift(app, broken))
+    # Spec documents a route that does not exist → flagged.
+    broken2 = yaml.safe_load(yaml.safe_dump(spec))
+    broken2["paths"]["/ghost"] = {
+        "get": {"responses": {"200": {"description": "x"}}}
+    }
+    assert any("missing route" in d for d in spec_drift(app, broken2))
+
+
+def test_route_table_extraction():
+    api = FakeApiServer()
+    routes = route_table(ApiServerApp(api))
+    assert ("get", "/apis/{kind}") in routes
+    assert ("put", "/apis/{kind}/{ns}/{name}/status") in routes
+    assert ("get", "/healthz") in routes
