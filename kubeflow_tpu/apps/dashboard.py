@@ -128,7 +128,12 @@ class DashboardApp(App):
     # -- core reads (api.ts) ----------------------------------------------
 
     def get_namespaces(self, req: Request) -> Response:
-        return json_response(namespaces_for(self.api, req.user))
+        # Envelope-shaped like every other app's /api/namespaces (the
+        # shared selector in ui.js reads payload.namespaces); the SPA's
+        # own boot path reads namespaces from /api/workgroup/env-info.
+        from kubeflow_tpu.apps.common import namespaces_response
+
+        return namespaces_response(self.api, req)
 
     def get_activities(self, req: Request) -> Response:
         ns = req.path_params["ns"]

@@ -76,6 +76,10 @@ class JupyterApp(App):
         self.config = load_spawner_config(config_path)
         self.before_request(authn or HeaderAuthn())
         self.add_route("/api/config", self.get_config)
+        # The shared namespace selector's data source — crud_backend
+        # exposes the same on every CRUD app so pages work standalone,
+        # not only iframed under the dashboard.
+        self.add_route("/api/namespaces", self.get_namespaces)
         self.add_route("/api/namespaces/<ns>/notebooks", self.list_notebooks)
         self.add_route(
             "/api/namespaces/<ns>/notebooks", self.post_notebook, ("POST",)
@@ -109,6 +113,11 @@ class JupyterApp(App):
 
     def get_config(self, req: Request) -> Response:
         return success_response("config", self.config)
+
+    def get_namespaces(self, req: Request) -> Response:
+        from kubeflow_tpu.apps.common import namespaces_response
+
+        return namespaces_response(self.api, req)
 
     def list_notebooks(self, req: Request) -> Response:
         ns = req.path_params["ns"]
@@ -267,9 +276,16 @@ class JupyterApp(App):
         vols += list(self._form_default("dataVolumes", body) or [])
         for vol in vols:
             vol_name = str(vol.get("name", "")).replace("{name}", name)
-            if not vol_name:
-                continue
             vol_type = vol.get("type", "New")
+            if not vol_name:
+                if vol_type == "Existing":
+                    # Silently dropping the volume would create a
+                    # notebook whose /home/jovyan lives on the container
+                    # filesystem — data loss on the first stop/cull.
+                    raise HttpError(
+                        400, "Existing volume needs a PVC name"
+                    )
+                continue
             if vol_type in ("New", "Snapshot"):
                 pvc = new_resource(
                     "PersistentVolumeClaim",

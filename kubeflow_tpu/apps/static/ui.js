@@ -78,3 +78,44 @@ export function showError(message) {
 export function namespaceFromUrl() {
   return new URLSearchParams(location.search).get("ns") || "default";
 }
+
+// Shared namespace selector — the kubeflow-common-lib NamespaceService
+// analog: every CRUD app offers /api/namespaces, the selection lives in
+// the URL (?ns=), so links are shareable and the dashboard can drive
+// iframed sub-apps with the same parameter.
+export async function namespaceSelector(container, { onchange } = {}) {
+  const current = namespaceFromUrl();
+  let namespaces = [current];
+  try {
+    namespaces = (await api("/api/namespaces")).namespaces || [current];
+  } catch { /* standalone page without the endpoint: keep URL value */ }
+  if (!namespaces.includes(current)) namespaces.unshift(current);
+  const select = el("select", { id: "ns-select", title: "namespace" },
+    ...namespaces.map(ns => {
+      const opt = el("option", { value: ns }, ns);
+      if (ns === current) opt.selected = true;
+      return opt;
+    }));
+  select.addEventListener("change", () => {
+    const url = new URL(location.href);
+    url.searchParams.set("ns", select.value);
+    if (onchange) { history.pushState({}, "", url); onchange(select.value); }
+    else location.href = url;  // full reload re-boots the page for the ns
+  });
+  container.textContent = "namespace: ";
+  container.append(select);
+  return select;
+}
+
+// Optimistic row update — the snack-bar/optimistic pattern of the
+// common lib: reflect the user's action immediately, let the next poll
+// converge to observed state (and any error banner explain a rollback).
+export function optimistic(row, label) {
+  if (!row) return;
+  const cell = row.querySelector(".status");
+  if (cell) {
+    cell.replaceWith(statusCell("waiting"));
+    row.querySelector(".status").lastChild.textContent = label;
+  }
+  for (const btn of row.querySelectorAll("button")) btn.disabled = true;
+}

@@ -34,6 +34,7 @@ class TensorboardsApp(App):
         )
         self.api = api
         self.before_request(authn or HeaderAuthn())
+        self.add_route("/api/namespaces", self.get_namespaces)
         self.add_route("/api/namespaces/<ns>/tensorboards", self.list_tbs)
         self.add_route(
             "/api/namespaces/<ns>/tensorboards", self.post_tb, ("POST",)
@@ -44,6 +45,11 @@ class TensorboardsApp(App):
             ("DELETE",),
         )
         self.add_route("/api/namespaces/<ns>/pvcs", self.list_pvcs)
+
+    def get_namespaces(self, req: Request) -> Response:
+        from kubeflow_tpu.apps.common import namespaces_response
+
+        return namespaces_response(self.api, req)
 
     def list_tbs(self, req: Request) -> Response:
         ns = req.path_params["ns"]

@@ -44,7 +44,7 @@ def test_registration_flow(world):
     assert info["hasWorkgroup"] is True
     assert info["namespaces"] == ["alice"]
     assert info["isClusterAdmin"] is False
-    assert c.get("/api/namespaces").json() == ["alice"]
+    assert c.get("/api/namespaces").json()["namespaces"] == ["alice"]
 
 
 def test_activities_surface_events(world):
