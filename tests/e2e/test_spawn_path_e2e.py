@@ -1,0 +1,186 @@
+"""Spawn-path E2E — the `testing/test_jwa.py:32-300` analog.
+
+The reference drives the spawner UI with Selenium against a live
+cluster. This image ships no browser or JS engine, so the equivalent
+here is two-layered:
+
+1. `test_spawn_path_over_live_servers` boots the REAL platform-in-a-box
+   process (`python -m kubeflow_tpu.apps`: all web apps + controllers +
+   pod materializer as one server process) and walks the full user
+   journey over live HTTP — issuing exactly the requests the SPA issues
+   (the frontend drift gate in tests/test_frontends.py pins that the
+   SPA's calls and these routes agree): register workgroup → spawner
+   config → create notebook → poll the row to Running (the Poller's
+   endpoint) → connect URL → cull (stop) → restart → snapshot →
+   delete.
+2. `test_spa_module_imports_resolve` is the no-JS-engine stand-in for
+   "the page's JS loads": every name a page imports from ui.js must be
+   exported there — the breakage class a browser smoke test catches
+   first (a bad import kills the whole module).
+"""
+
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+STATIC = REPO / "kubeflow_tpu" / "apps" / "static"
+USER = "alice@corp.com"
+
+
+def _req(url, body=None, method=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    with urllib.request.urlopen(r, timeout=20) as resp:
+        raw = resp.read()
+        return resp.status, json.loads(raw) if raw.strip() else {}
+
+
+def _wait(pred, timeout=90, interval=0.5):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        ok, last = pred()
+        if ok:
+            return last
+        time.sleep(interval)
+    raise TimeoutError(f"condition not reached; last={last!r}")
+
+
+def test_spawn_path_over_live_servers(tmp_path):
+    port = 18400
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.apps",
+         "--port-base", str(port), "--anonymous", USER],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env={**os.environ},
+    )
+    dash = f"http://127.0.0.1:{port}"
+    jup = f"http://127.0.0.1:{port + 2}"
+    try:
+        _wait(lambda: _probe_up(f"{dash}/healthz"), timeout=60)
+
+        # 1. Fresh user: no workgroup yet → register (dashboard flow).
+        _, info = _req(f"{dash}/api/workgroup/env-info")
+        assert info["user"] == USER
+        if not info.get("hasWorkgroup"):
+            _req(f"{dash}/api/workgroup/create", body={}, method="POST")
+        ns = _wait(lambda: _ns_ready(dash))
+
+        # 2. Spawner page boot: config + namespaces (the selector).
+        _, cfg = _req(f"{jup}/api/config")
+        assert cfg["config"]["image"]["options"]
+        _, nss = _req(f"{jup}/api/namespaces")
+        assert ns in nss["namespaces"]
+
+        # 3. Spawn a notebook with a new workspace volume — the exact
+        #    body jupyter.html posts.
+        _req(
+            f"{jup}/api/namespaces/{ns}/notebooks",
+            method="POST",
+            body={
+                "name": "my-nb",
+                "image": cfg["config"]["image"]["options"][0],
+                "cpu": "1.0",
+                "memory": "2Gi",
+                "tpu": "none",
+                "workspaceVolume": {
+                    "type": "New", "name": "{name}-workspace",
+                    "size": "1Gi", "mountPath": "/home/jovyan",
+                    "accessMode": "ReadWriteOnce",
+                },
+                "configurations": [],
+            },
+        )
+
+        def row(status=None):
+            _, data = _req(f"{jup}/api/namespaces/{ns}/notebooks")
+            rows = {n["name"]: n for n in data["notebooks"]}
+            nb = rows.get("my-nb")
+            return (nb is not None and (status is None
+                                        or nb["status"] == status), nb)
+
+        # 4. The poller's view reaches Running (materializer backs it).
+        nb = _wait(lambda: row("running"))
+        # The workspace PVC is mounted (the admin config may add more,
+        # e.g. the dshm emptyDir).
+        assert "my-nb-workspace" in nb["volumes"], nb
+
+        # 5. Connect URL routes: the Connect button opens
+        #    /notebook/{ns}/my-nb/, which the controller's
+        #    VirtualService carries (generateVirtualService parity,
+        #    notebook_controller.go:379) — read it off the facade.
+        facade = f"http://127.0.0.1:{port + 4}"
+        _, vs = _req(
+            f"{facade}/apis/VirtualService/{ns}/notebook-{ns}-my-nb"
+        )
+        assert f"/notebook/{ns}/my-nb/" in json.dumps(vs["spec"]), vs
+
+        # 6. Cull: stop → row shows stopped; restart → running again.
+        _req(f"{jup}/api/namespaces/{ns}/notebooks/my-nb",
+             method="PATCH", body={"stopped": True})
+        _wait(lambda: row("stopped"))
+        _req(f"{jup}/api/namespaces/{ns}/notebooks/my-nb",
+             method="PATCH", body={"stopped": False})
+        _wait(lambda: row("running"))
+
+        # 7. Snapshot the workspace (the row's Snapshot action), then
+        #    delete the notebook.
+        _req(f"{jup}/api/namespaces/{ns}/snapshots", method="POST",
+             body={"pvc": "my-nb-workspace"})
+        _, snaps = _req(f"{jup}/api/namespaces/{ns}/snapshots")
+        assert any(
+            s["source"] == "my-nb-workspace" for s in snaps["snapshots"]
+        )
+        _req(f"{jup}/api/namespaces/{ns}/notebooks/my-nb",
+             method="DELETE")
+        _wait(lambda: (row()[1] is None, row()[1]))
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def _probe_up(url):
+    try:
+        return _req(url)[0] == 200, None
+    except (urllib.error.URLError, ConnectionError) as e:
+        return False, str(e)
+
+
+def _ns_ready(dash):
+    _, info = _req(f"{dash}/api/workgroup/env-info")
+    nss = info.get("namespaces") or []
+    return (bool(nss), nss[0] if nss else None)
+
+
+def test_spa_module_imports_resolve():
+    """No JS engine in CI, so pin the first thing a browser would catch:
+    every symbol a page imports from ./ui.js exists as an export."""
+    exported = set(
+        re.findall(
+            r"export\s+(?:async\s+)?(?:function|class|const|let)\s+"
+            r"([A-Za-z_$][\w$]*)",
+            (STATIC / "ui.js").read_text(),
+        )
+    )
+    assert exported, "ui.js exports nothing?"
+    for page in ("jupyter.html", "tensorboards.html"):
+        text = (STATIC / page).read_text()
+        for block in re.findall(
+            r"import\s*\{([^}]+)\}\s*from\s*\"\./ui\.js\"", text
+        ):
+            for name in re.split(r"[,\s]+", block.strip()):
+                if name:
+                    assert name in exported, (
+                        f"{page} imports {name!r} which ui.js does not "
+                        f"export (exports: {sorted(exported)})"
+                    )
