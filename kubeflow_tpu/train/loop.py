@@ -112,7 +112,9 @@ def fit(
                 rec = {
                     "step": step + 1,
                     "loss": loss,
-                    "accuracy": float(metrics["accuracy"]),
+                    # Absent in train_metrics="loss" mode (LM trainers
+                    # skip the per-step full-vocab argmax).
+                    "accuracy": float(metrics.get("accuracy", float("nan"))),
                     "examples_per_sec": examples / (now - t_last),
                 }
                 history.append(rec)

@@ -59,6 +59,11 @@ class TrainConfig:
     # "sgd" (benchmark parity with tf_cnn_benchmarks' default) or "adamw"
     optimizer: str = "sgd"
     fsdp_params: bool = True
+    # Per-step training metrics: "full" also computes accuracy (an
+    # argmax over the logits — at LM vocab sizes that is a multi-GB
+    # logits readback per step, which production LM trainers skip);
+    # "loss" returns the objective only. Eval always computes both.
+    train_metrics: str = "full"
 
 
 def decay_mask(params) -> Any:
@@ -85,13 +90,26 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
 
 
 def softmax_cross_entropy(logits, labels, label_smoothing: float = 0.0):
-    num_classes = logits.shape[-1]
-    onehot = jax.nn.one_hot(labels, num_classes)
+    """Fused gather-based cross entropy (equals
+    `optax.softmax_cross_entropy(logits, smoothed_onehot).mean()`).
+
+    The one-hot formulation materializes a [B, S, vocab] dense target and
+    streams it from HBM alongside the logits; at LM vocab sizes that is
+    gigabytes per step of pure bandwidth waste on an HBM-bound chip. The
+    identity `CE = logsumexp(logits) - logits[label]` (smoothing mixes in
+    `logsumexp - mean(logits)`, the uniform-target term) needs only a
+    rank-reducing reduce and a gather, both of which XLA fuses into the
+    logits producer."""
+    logits = logits.astype(jnp.float32)
+    log_z = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1
+    )[..., 0]
+    nll = log_z - label_logits
     if label_smoothing:
-        onehot = (
-            onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
-        )
-    return optax.softmax_cross_entropy(logits, onehot).mean()
+        uniform = log_z - logits.mean(axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * uniform
+    return nll.mean()
 
 
 class Trainer:
@@ -216,7 +234,11 @@ class Trainer:
                     new_vars.get("losses", {})
                 ):
                     loss = loss + aux
-                return loss, (new_vars, logits)
+                # "loss" mode drops the logits from the aux output: kept
+                # alive only for accuracy, they'd otherwise pin a
+                # [B, S, vocab] f32 buffer through the whole backward.
+                aux_logits = logits if cfg.train_metrics == "full" else None
+                return loss, (new_vars, aux_logits)
 
             (loss, (new_vars, logits)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
@@ -225,10 +247,13 @@ class Trainer:
                 grads=grads,
                 batch_stats=new_vars.get("batch_stats", state.batch_stats),
             )
-            accuracy = jnp.mean(
-                (jnp.argmax(logits, -1) == batch[label_key]).astype(jnp.float32)
-            )
-            return state, {"loss": loss, "accuracy": accuracy}
+            metrics = {"loss": loss}
+            if logits is not None:
+                metrics["accuracy"] = jnp.mean(
+                    (jnp.argmax(logits, -1) == batch[label_key])
+                    .astype(jnp.float32)
+                )
+            return state, metrics
 
         return jax.jit(
             train_step,

@@ -1,7 +1,10 @@
 """Transformer LM forward/backward, MoE aux loss, sharded step."""
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
 from kubeflow_tpu.parallel import MeshSpec, build_mesh
@@ -141,3 +144,61 @@ def test_flash_impl_matches_dense(mesh8):
     np.testing.assert_allclose(
         np.asarray(out_sharded), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
+
+
+def test_fused_cross_entropy_matches_onehot_formulation():
+    """The gather-based CE must equal optax's dense-one-hot version
+    (including label smoothing) — it replaced it purely to kill the
+    [B,S,vocab] HBM traffic."""
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.train.trainer import softmax_cross_entropy
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 16, 37)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 37, size=(4, 16)))
+    for smoothing in (0.0, 0.1):
+        onehot = jax.nn.one_hot(labels, 37)
+        if smoothing:
+            onehot = onehot * (1 - smoothing) + smoothing / 37
+        want = optax.softmax_cross_entropy(logits, onehot).mean()
+        got = softmax_cross_entropy(logits, labels, smoothing)
+        assert abs(float(want) - float(got)) < 1e-5
+
+
+def test_remat_policies_agree():
+    """'dots' and 'full' remat are performance knobs, not semantics: same
+    logits, same grads."""
+    cfg_full = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+        d_ff=64, remat_policy="full", attention_impl="dense",
+    )
+    cfg_dots = dataclasses.replace(cfg_full, remat_policy="dots")
+    tokens = jnp.arange(2 * 8, dtype=jnp.int32).reshape(2, 8) % 64
+
+    out = {}
+    for name, cfg in (("full", cfg_full), ("dots", cfg_dots)):
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0), tokens)
+
+        def loss(p):
+            return model.apply(p, tokens).astype(jnp.float32).sum()
+
+        out[name] = (loss(params), jax.grad(loss)(params))
+
+    assert jnp.allclose(out["full"][0], out["dots"][0], atol=1e-4)
+    flat_f = jax.tree_util.tree_leaves(out["full"][1])
+    flat_d = jax.tree_util.tree_leaves(out["dots"][1])
+    for a, b in zip(flat_f, flat_d):
+        assert jnp.allclose(a, b, atol=1e-3), (a - b)
+
+
+def test_unknown_remat_policy_rejected():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, head_dim=16,
+        d_ff=64, remat_policy="bogus",
+    )
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="remat_policy"):
+        TransformerLM(cfg).init(jax.random.PRNGKey(0), tokens)
