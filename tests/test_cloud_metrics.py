@@ -1,0 +1,98 @@
+"""Cloud Monitoring metrics provider: golden requests + dashboard wiring
+(the `stackdriver_metrics_service.ts:15` analog behind MetricsService)."""
+
+import pytest
+
+from kubeflow_tpu.apps.cloud_metrics import CloudMonitoringMetricsService
+from kubeflow_tpu.apps.dashboard import DashboardApp
+from kubeflow_tpu.deploy.gke import RecordingTransport
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.web import TestClient
+from kubeflow_tpu.web.wsgi import HttpError
+
+NOW = 1_700_000_000.0
+
+RESPONSE = {
+    "timeSeries": [
+        {
+            "resource": {"labels": {"node_name": "tpu-node-0"}},
+            "points": [
+                {
+                    "interval": {"endTime": "2023-11-14T22:12:00Z"},
+                    "value": {"doubleValue": 0.83},
+                },
+                {
+                    "interval": {"endTime": "2023-11-14T22:11:00Z"},
+                    "value": {"doubleValue": 0.79},
+                },
+            ],
+        }
+    ]
+}
+
+
+def _service(**kw):
+    transport = RecordingTransport(responses={"/timeSeries": RESPONSE})
+    return (
+        CloudMonitoringMetricsService(
+            transport, "my-proj", now=lambda: NOW, **kw
+        ),
+        transport,
+    )
+
+
+def test_golden_request_construction():
+    svc, _ = _service(cluster="kf-prod")
+    req = svc.request_for("tpuduty", minutes=15)
+    assert req.method == "GET"
+    assert req.url == (
+        "https://monitoring.googleapis.com/v3/projects/my-proj/timeSeries"
+    )
+    assert req.body == {
+        "filter": (
+            'metric.type = "kubernetes.io/node/accelerator/duty_cycle"'
+            ' AND resource.labels.cluster_name = "kf-prod"'
+        ),
+        "interval.startTime": "2023-11-14T21:58:20Z",
+        "interval.endTime": "2023-11-14T22:13:20Z",
+        "aggregation.alignmentPeriod": "60s",
+        "aggregation.perSeriesAligner": "ALIGN_MEAN",
+    }
+
+
+def test_metric_type_mapping():
+    svc, _ = _service()
+    assert "cpu/allocatable_utilization" in svc.request_for(
+        "nodecpu", 5
+    ).body["filter"]
+    assert "memory/allocatable_utilization" in svc.request_for(
+        "nodemem", 5
+    ).body["filter"]
+    with pytest.raises(HttpError):
+        svc.request_for("bogus", 5)
+
+
+def test_query_parses_time_series():
+    svc, transport = _service()
+    points = svc.query("tpuduty", 15)
+    assert [p["value"] for p in points] == [0.79, 0.83]  # time-ordered
+    assert all(p["node"] == "tpu-node-0" for p in points)
+    assert transport.requests[0].url.endswith("/timeSeries")
+
+
+def test_dashboard_serves_cloud_metrics():
+    """The provider slots in behind DashboardApp's MetricsService seam —
+    the factory-selected Stackdriver path of the reference."""
+    api = FakeApiServer()
+    svc, _ = _service()
+    app = DashboardApp(api, metrics_service=svc)
+    client = TestClient(
+        app,
+        headers={
+            "x-goog-authenticated-user-email":
+                "accounts.google.com:alice@x.co"
+        },
+    )
+    resp = client.get("/api/metrics/tpuduty?window=15")
+    assert resp.status == 200
+    assert [p["value"] for p in resp.json()] == [0.79, 0.83]
