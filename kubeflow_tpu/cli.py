@@ -81,6 +81,8 @@ def _phase(res: Resource) -> str:
 
 def cmd_get(client: HttpApiClient, args) -> int:
     kind = resolve_kind(args.kind)
+    if args.watch:
+        return _watch_kind(client, kind, args)
     if args.name:
         res = client.get(kind, args.name, args.namespace or "default",
                          version=args.api_version)
@@ -105,6 +107,71 @@ def cmd_get(client: HttpApiClient, args) -> int:
     for row in rows:
         print(fmt.format(*row))
     return 0
+
+
+def _watch_kind(client: HttpApiClient, kind: str, args) -> int:
+    """`kubectl get -w` analog over the facade's watch stream: print the
+    current table, then one row per event. With a NAME, the table and
+    the stream are filtered to that object (kubectl's single-object
+    watch). 410 Gone past the journal horizon recovers the informer way:
+    re-list, reprint, resume from the list's resourceVersion."""
+    import urllib.parse as _up
+
+    params: dict[str, str] = {}
+    if args.name:
+        # Watching one object: scope the namespace the way a named get
+        # does (default namespace unless -n).
+        params["namespace"] = (
+            args.namespace if args.namespace is not None else "default"
+        ) or "_"
+    elif args.namespace is not None:
+        params["namespace"] = args.namespace or "_"
+
+    def wanted(res: Resource) -> bool:
+        return not args.name or res.metadata.name == args.name
+
+    fmt = "{:<10}  {:<12}  {:<24}  {}"
+
+    def relist() -> int:
+        query = f"?{_up.urlencode(params)}" if params else ""
+        data = client._call("GET", f"/apis/{kind}{query}")
+        for item in data["items"]:
+            res = Resource.from_dict(item)
+            if wanted(res):
+                print(fmt.format("-", res.metadata.namespace,
+                                 res.metadata.name, _phase(res)),
+                      flush=True)
+        return data.get("resourceVersion", 0)
+
+    print(fmt.format("EVENT", "NAMESPACE", "NAME", "STATUS"))
+    rv = relist()
+    from kubeflow_tpu.testing.fake_apiserver import Gone
+
+    while True:
+        # Long-poll shorter than the client's socket timeout — a quiet
+        # interval must yield an empty batch, not a socket error.
+        poll = max(1, int(client.timeout) - 2)
+        watch_params = dict(
+            params, watch="true", resourceVersion=rv, timeoutSeconds=poll
+        )
+        try:
+            batch = client._call(
+                "GET", f"/apis/{kind}?{_up.urlencode(watch_params)}"
+            )
+        except Gone:
+            rv = relist()  # horizon passed us — fresh table, new bookmark
+            continue
+        except KeyboardInterrupt:
+            return 0
+        rv = batch["resourceVersion"]
+        for event in batch["events"]:
+            res = Resource.from_dict(event["object"])
+            if wanted(res):
+                print(
+                    fmt.format(event["type"], res.metadata.namespace,
+                               res.metadata.name, _phase(res)),
+                    flush=True,
+                )
 
 
 def cmd_apply(client: HttpApiClient, args) -> int:
@@ -185,6 +252,9 @@ def main(argv: list[str] | None = None) -> int:
     get.add_argument("-o", "--output", choices=("yaml", "json"))
     get.add_argument("--api-version", dest="api_version",
                      help="read at a served CRD version (e.g. v1alpha1)")
+    get.add_argument("-w", "--watch", action="store_true",
+                     help="print the table, then stream change events "
+                     "(kubectl get -w analog; Ctrl-C to stop)")
     get.set_defaults(fn=cmd_get)
 
     apply_p = sub.add_parser("apply", help="create-or-update from YAML")

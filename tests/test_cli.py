@@ -122,3 +122,87 @@ def test_cluster_scoped_kinds_listed_by_default(server):
     # -n narrows to a namespace (and so hides cluster-scoped objects).
     rc, out, _ = run(url, "get", "nodes", "-n", "team")
     assert rc == 0 and "tpu-0" not in out
+
+
+def test_get_watch_streams_events(server):
+    """`get -w` (kubectl analog): initial table, then one row per event
+    from the facade's watch stream — run as a real subprocess so the
+    stream is actually consumed across the process boundary."""
+    import os
+    import signal
+    import subprocess
+    import time
+
+    api, url = server
+    api.create(new_resource("TpuJob", "pre", "ml", spec={"replicas": 1}))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.cli", "--server", url,
+         "get", "tpujobs", "-w"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ},
+    )
+    try:
+        header = proc.stdout.readline()
+        assert "EVENT" in header
+        assert "pre" in proc.stdout.readline()
+        # Live events stream in as they happen.
+        api.create(new_resource("TpuJob", "live", "ml",
+                                spec={"replicas": 1}))
+        line = proc.stdout.readline()
+        assert "ADDED" in line and "live" in line, line
+        api.delete("TpuJob", "live", "ml")
+        deadline = time.time() + 10
+        seen_delete = False
+        while time.time() < deadline and not seen_delete:
+            line = proc.stdout.readline()
+            seen_delete = "DELETED" in line and "live" in line
+        assert seen_delete
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_get_watch_single_object_filters(server):
+    """`get <kind> <name> -w` streams only the named object (kubectl's
+    single-object watch), and survives quiet intervals longer than the
+    client socket timeout (the long-poll must be shorter)."""
+    import os
+    import signal
+    import subprocess
+    import time
+
+    api, url = server
+    api.create(new_resource("TpuJob", "keep", "default",
+                            spec={"replicas": 1}))
+    api.create(new_resource("TpuJob", "noise", "default",
+                            spec={"replicas": 1}))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.cli", "--server", url,
+         "get", "tpujobs", "keep", "-w"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ},
+    )
+    try:
+        assert "EVENT" in proc.stdout.readline()
+        first = proc.stdout.readline()
+        assert "keep" in first and "noise" not in first
+        # Quiet for longer than the 10s socket timeout: the stream must
+        # survive (empty long-polls), then deliver only 'keep' events.
+        time.sleep(11)
+        assert proc.poll() is None, "watch died during a quiet interval"
+        api.create(new_resource("TpuJob", "noise2", "default",
+                                spec={"replicas": 1}))
+        fresh = api.get("TpuJob", "keep", "default")
+        fresh.status["phase"] = "Running"
+        api.update_status(fresh)
+        line = proc.stdout.readline()
+        assert "MODIFIED" in line and "keep" in line, line
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
