@@ -111,6 +111,7 @@ class DashboardApp(App):
         self.before_request(authn or HeaderAuthn())
         self.add_route("/api/namespaces", self.get_namespaces)
         self.add_route("/api/activities/<ns>", self.get_activities)
+        self.add_route("/api/workloads/<ns>", self.get_workloads)
         self.add_route("/api/metrics/<metric>", self.get_metrics)
         self.add_route("/api/dashboard-links", self.get_links)
         self.add_route("/api/workgroup/exists", self.workgroup_exists)
@@ -150,6 +151,50 @@ class DashboardApp(App):
         ]
         events.sort(key=lambda e: e["timestamp"] or 0, reverse=True)
         return json_response(events)
+
+    def get_workloads(self, req: Request) -> Response:
+        """The namespace's accelerator workloads in one table — TpuJobs,
+        Studies, Workflows with phase + chip ask. The reference's home
+        page surfaces only Events; on a TPU platform the first question
+        is 'what is holding chips right now'."""
+        ns = req.path_params["ns"]
+        # Per-resource SAR, like every other multi-read handler: the
+        # table contains only the kinds this user may list.
+        from kubeflow_tpu.api.rbac import subject_access_review
+
+        allowed = [
+            (kind, resource)
+            for kind, resource in (
+                ("TpuJob", "tpujobs"),
+                ("Study", "studies"),
+                ("Workflow", "workflows"),
+            )
+            if subject_access_review(self.api, req.user, "list",
+                                     resource, ns)
+        ]
+        if not allowed:
+            ensure_authorized(self.api, req.user, "list", "tpujobs", ns)
+        rows = []
+        for kind, _ in allowed:
+            for res in self.api.list(kind, ns):
+                spec = res.spec or {}
+                chips = (
+                    spec.get("tpu", {}).get("chipsPerWorker", 0)
+                    * spec.get("replicas", 1)
+                    if kind == "TpuJob"
+                    else None
+                )
+                rows.append(
+                    {
+                        "kind": kind,
+                        "name": res.metadata.name,
+                        "phase": res.status.get("phase", "Pending"),
+                        "chips": chips,
+                        "created": res.metadata.creation_timestamp,
+                    }
+                )
+        rows.sort(key=lambda r: r["created"] or 0, reverse=True)
+        return json_response(rows)
 
     def get_metrics(self, req: Request) -> Response:
         try:

@@ -139,3 +139,69 @@ def test_all_namespaces_admin_only(world):
         "/api/workgroup/get-all-namespaces"
     ).json()
     assert ["alice", "alice@x.co"] in rows
+
+
+def test_workloads_table(world):
+    """The home page's 'what is holding chips' table: TpuJobs, Studies,
+    Workflows with phase + chip ask."""
+    api, ctl, app = world
+    c = client(app, "alice@x.co")
+    c.post("/api/workgroup/create", body={})
+    ctl.controller.run_until_idle()
+    from kubeflow_tpu.api import make_tpujob
+
+    job = make_tpujob("train", namespace="alice", replicas=4,
+                      tpu_chips_per_worker=4, command=("python",))
+    job.status = {}
+    api.create(job)
+    api.create(new_resource("Workflow", "ci", "alice",
+                            spec={"steps": []}))
+    rows = c.get("/api/workloads/alice").json()
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["train"]["kind"] == "TpuJob"
+    assert by_name["train"]["chips"] == 16
+    assert by_name["train"]["phase"] == "Pending"
+    assert by_name["ci"]["chips"] is None
+
+
+def test_workloads_table_filters_by_per_kind_authorization(world):
+    """A user who may list tpujobs but not workflows sees only the kinds
+    they are authorized for; a user with no workload grants gets 403."""
+    api, ctl, app = world
+    from kubeflow_tpu.api import make_tpujob
+
+    c = client(app, "alice@x.co")
+    c.post("/api/workgroup/create", body={})
+    ctl.controller.run_until_idle()
+    api.create(make_tpujob("train", namespace="alice", replicas=1,
+                           tpu_chips_per_worker=0, command=("python",)))
+    api.create(new_resource("Workflow", "ci", "alice",
+                            spec={"steps": []}))
+
+    # Namespace admin sees everything.
+    kinds = {r["kind"] for r in c.get("/api/workloads/alice").json()}
+    assert kinds == {"TpuJob", "Workflow"}
+
+    # Grant bob list on tpujobs only (a narrow Role, not a ClusterRole).
+    api.create(new_resource(
+        "Role", "jobs-only", "alice",
+        spec={"rules": [{"verbs": ["list"], "resources": ["tpujobs"]}]},
+    ))
+    api.create(new_resource(
+        "RoleBinding", "bob-jobs", "alice",
+        spec={"roleRef": {"kind": "Role", "name": "jobs-only"},
+              "subjects": [{"kind": "User", "name": "bob@x.co"}]},
+    ))
+    # Bob must also pass the mesh gate.
+    api.create(new_resource(
+        "AuthorizationPolicy", "bob-ap", "alice",
+        spec={"action": "ALLOW",
+              "rules": [{"from": [{"source": {"principals": [
+                  "bob@x.co"]}}]}]},
+    ))
+    bob = client(app, "bob@x.co")
+    rows = bob.get("/api/workloads/alice").json()
+    assert {r["kind"] for r in rows} == {"TpuJob"}
+
+    mallory = client(app, "mallory@x.co")
+    assert mallory.get("/api/workloads/alice").status == 403
