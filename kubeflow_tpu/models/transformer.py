@@ -135,6 +135,19 @@ def _attend(q, k, v, mesh: Mesh | None, impl: str):
             "or 'dense'"
         )
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # Ring (sequence-parallel) path. On TPU with flash-tileable local
+        # chunks, every ring hop runs the Pallas kernel (ring flash:
+        # per-device attention memory O(C·D), not O(C²)) — the
+        # long-context composition; otherwise the dense-hop ring.
+        chunk = q.shape[1] // mesh.shape["sp"]
+        if (
+            impl in ("auto", "flash")
+            and jax.default_backend() == "tpu"
+            and flash_usable(chunk, chunk)
+        ):
+            from kubeflow_tpu.ops.flash import ring_flash_attention
+
+            return ring_flash_attention(q, k, v, mesh, causal=True)
         return ring_attention(q, k, v, mesh, causal=True)
     use_flash = impl == "flash" or (
         impl == "auto"

@@ -59,23 +59,41 @@ def _ring_body(q, k, v, *, axis: str, causal: bool):
     k_cur, v_cur = k, v
     for i in range(n):
         src = (my - i) % n  # ring position this K/V chunk originated from
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32)
-        ) * scale
+
+        def accumulate(o, m, l, k_blk=k_cur, v_blk=v_cur, src_=src):
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+            ) * scale
+            if causal:
+                k_pos = src_ * c + lax.broadcasted_iota(
+                    jnp.int32, (c, c), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            m_blk = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            # Rows with no unmasked key yet keep m=-inf; exp(-inf - -inf)
+            # is nan, so guard the correction factor.
+            corr = jnp.where(m == -jnp.inf, 0.0, jnp.exp(m - m_new))
+            p = jnp.exp(s - m_new[..., None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            l_new = l * corr + jnp.sum(p, axis=-1)
+            pv = jnp.einsum(
+                "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+            )
+            o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+            return o_new, m_new, l_new
+
         if causal:
-            k_pos = src * c + lax.broadcasted_iota(jnp.int32, (c, c), 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m_blk = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        # Rows with no unmasked key yet keep m=-inf; exp(-inf - -inf) is
-        # nan, so guard the correction factor.
-        corr = jnp.where(m == -jnp.inf, 0.0, jnp.exp(m - m_new))
-        p = jnp.exp(s - m_new[..., None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        l = l * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
-        o = o * corr.transpose(0, 2, 1)[..., None] + pv
-        m = m_new
+            # A K/V chunk from a LATER ring position is entirely masked
+            # for this device's queries — skip both einsums (half the
+            # ring's attention FLOPs on average). Devices legitimately
+            # diverge here: the cond body has no collectives, the
+            # rotation below is unconditional.
+            o, m, l = lax.cond(
+                src <= my, accumulate, lambda o, m, l: (o, m, l), o, m, l
+            )
+        else:
+            o, m, l = accumulate(o, m, l)
         if i + 1 < n:
             k_cur = _rotate(k_cur, axis, n)
             v_cur = _rotate(v_cur, axis, n)

@@ -412,3 +412,229 @@ def flash_usable(seq_q: int, seq_k: int, block_q: int = 1024,
     except ValueError:
         return False
     return True
+
+
+# -- ring flash: sequence-parallel flash attention --------------------------
+#
+# The long-context composition the platform's sp axis exists for: each
+# device holds a sequence chunk, K/V chunks rotate around the ring
+# (`ops/attention.ring_attention` topology), and every hop runs the
+# Pallas kernel instead of materializing the [C, C] score matrix —
+# blockwise-parallel ring attention. Per-hop (o_i, lse_i) pairs merge
+# with the standard log-sum-exp algebra; the backward re-walks the ring
+# passing the GLOBAL (o, lse) into the kernel's bwd (whose
+# p = exp(s - lse) and delta = rowsum(do*o) are then the global softmax
+# weights — see _dq_kernel), accumulating dk/dv in the rotating frame and
+# delivering them home with one final rotation.
+
+
+def _flat_heads(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unflat_heads(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _hop_branches(qf, kf, vf, bq, bk, interpret):
+    """(full, diagonal, skip) branch thunks for one ring hop — the hop
+    kind is data-dependent (axis_index), the kernel's causal flag is
+    static, so lax.switch picks among three static traces."""
+    bh, c, d = qf.shape
+
+    def full_blk():
+        return _flash_fwd_impl(qf, kf, vf, False, bq, bk, interpret)
+
+    def diag_blk():
+        return _flash_fwd_impl(qf, kf, vf, True, bq, bk, interpret)
+
+    def skip_blk():
+        return (
+            jnp.zeros((bh, c, d), qf.dtype),
+            jnp.full((bh, c, _LANES), _NEG_INF, jnp.float32),
+        )
+
+    return (full_blk, diag_blk, skip_blk)
+
+
+def _hop_index(src, my):
+    # 0 = full (earlier chunk), 1 = diagonal (own chunk), 2 = skip
+    # (later chunk — fully masked under causality).
+    return jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+
+
+def _ring_rotate(x, axis: str, n: int):
+    # One helper for both attention modules: the dense-hop ring and the
+    # flash-hop ring MUST share the same permutation direction.
+    from kubeflow_tpu.ops.attention import _rotate
+
+    return _rotate(x, axis, n)
+
+
+def _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret):
+    b, c, h, d = q.shape
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    qf = _flat_heads(q)
+    bh = b * h
+
+    acc = jnp.zeros((bh, c, d), jnp.float32)
+    m = jnp.full((bh, c, _LANES), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, c, _LANES), jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(n):
+        src = (my - i) % n
+        branches = _hop_branches(
+            qf, _flat_heads(k_cur), _flat_heads(v_cur), bq, bk, interpret
+        )
+        if causal:
+            o_i, lse_i = lax.switch(_hop_index(src, my), branches)
+        else:
+            o_i, lse_i = branches[0]()
+        # Log-sum-exp merge of the hop's normalized output into the
+        # running global softmax (same algebra as the kernel's own
+        # online accumulation, one level up).
+        m_new = jnp.maximum(m, lse_i)
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
+        w = jnp.where(lse_i == _NEG_INF, 0.0, jnp.exp(lse_i - m_new))
+        acc = acc * corr[:, :, :1] + w[:, :, :1] * o_i.astype(jnp.float32)
+        l = l * corr + w
+        m = m_new
+        if i + 1 < n:
+            k_cur = _ring_rotate(k_cur, axis, n)
+            v_cur = _ring_rotate(v_cur, axis, n)
+
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o = (acc / safe_l[:, :, :1]).astype(q.dtype)
+    lse_tot = m + jnp.log(safe_l)
+    return _unflat_heads(o, b, h), lse_tot
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash_body(q, k, v, axis, causal, bq, bk, interpret):
+    o, _ = _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret)
+    return o
+
+
+def _ring_flash_body_fwd(q, k, v, axis, causal, bq, bk, interpret):
+    o, lse = _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_body_bwd(axis, causal, bq, bk, interpret, residuals, do):
+    q, k, v, o, lse = residuals
+    b, c, h, d = q.shape
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    qf, of, dof = _flat_heads(q), _flat_heads(o), _flat_heads(do)
+    bh = b * h
+
+    dq = jnp.zeros((bh, c, d), jnp.float32)
+    # dk/dv accumulate in the ROTATING frame: each hop adds its
+    # contribution to the chunk currently held, and the accumulators
+    # travel with the chunk.
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros((bh, c, d), jnp.float32)
+    dv_cur = jnp.zeros((bh, c, d), jnp.float32)
+    for i in range(n):
+        src = (my - i) % n
+        kf, vf = _flat_heads(k_cur), _flat_heads(v_cur)
+
+        def full_blk():
+            return _flash_bwd_impl(
+                qf, kf, vf, of, lse, dof, False, bq, bk, interpret
+            )
+
+        def diag_blk():
+            return _flash_bwd_impl(
+                qf, kf, vf, of, lse, dof, True, bq, bk, interpret
+            )
+
+        def skip_blk():
+            z = jnp.zeros((bh, c, d), q.dtype)
+            return z, z, z
+
+        if causal:
+            dq_i, dk_i, dv_i = lax.switch(
+                _hop_index(src, my), (full_blk, diag_blk, skip_blk)
+            )
+        else:
+            dq_i, dk_i, dv_i = full_blk()
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_cur = dk_cur + dk_i.astype(jnp.float32)
+        dv_cur = dv_cur + dv_i.astype(jnp.float32)
+        if i + 1 < n:
+            k_cur = _ring_rotate(k_cur, axis, n)
+            v_cur = _ring_rotate(v_cur, axis, n)
+            dk_cur = _ring_rotate(dk_cur, axis, n)
+            dv_cur = _ring_rotate(dv_cur, axis, n)
+    # After n-1 rotations the chunk (and its gradient) sits one hop
+    # short of home — one final rotation delivers dk/dv to their owners.
+    dk_home = _ring_rotate(dk_cur, axis, n)
+    dv_home = _ring_rotate(dv_cur, axis, n)
+    return (
+        _unflat_heads(dq, b, h).astype(q.dtype),
+        _unflat_heads(dk_home, b, h).astype(k.dtype),
+        _unflat_heads(dv_home, b, h).astype(v.dtype),
+    )
+
+
+_ring_flash_body.defvjp(_ring_flash_body_fwd, _ring_flash_body_bwd)
+
+
+def ring_flash_attention(
+    q,
+    k,
+    v,
+    mesh,
+    *,
+    causal: bool = True,
+    sp_axis: str = "sp",
+    heads_axis: str | None = "tp",
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+):
+    """Sequence-parallel flash attention over `mesh`'s sp ring.
+
+    q, k, v: GLOBAL [B, S, H, D]; S divides by the ring, H by tp. Each
+    hop runs the Pallas kernel on the local [C, C] tile (C = S/ring), so
+    per-device attention memory is O(C·D) instead of O(C²) — the
+    composition that takes the single-chip S=16k flash ceiling to
+    ring-size × 16k. Differentiable end-to-end (custom VJP re-walks the
+    ring with global statistics). Falls back to single-device flash when
+    the ring is trivial."""
+    if mesh.shape.get(sp_axis, 1) == 1:
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from kubeflow_tpu.parallel.sharding import batch_axes
+
+    ring = mesh.shape[sp_axis]
+    if q.shape[1] % ring:
+        raise ValueError(
+            f"ring flash attention: sequence length {q.shape[1]} does "
+            f"not divide the {sp_axis!r} ring size {ring}"
+        )
+    spec = P(batch_axes(mesh), sp_axis, heads_axis, None)
+    interp = _auto_interpret(interpret)
+
+    def body(q_, k_, v_):
+        # nondiff custom_vjp args must be positional, so no partial().
+        return _ring_flash_body(
+            q_, k_, v_, sp_axis, causal, block_q, block_k, interp
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )(q, k, v)
