@@ -78,6 +78,12 @@ class PlatformSpec:
     name: str
     project: str = "local"
     zone: str = "local-a"
+    # Cloud provider for the PLATFORM phase: "fake" materializes Nodes
+    # in-process (platform-in-a-box/CI); "gke" constructs real
+    # container-v1 payloads through `deploy.gke.GkeCloud`'s Transport
+    # seam (GKE materializes the nodes). The reference's KfDef carried
+    # the same choice as its platform plugin list.
+    provider: str = "fake"
     node_pools: list[NodePool] = dataclasses.field(default_factory=list)
     applications: list[str] = dataclasses.field(default_factory=list)
     email: str | None = None  # platform admin (IAM seed)
@@ -94,6 +100,7 @@ class PlatformSpec:
             "spec": {
                 "project": self.project,
                 "zone": self.zone,
+                "provider": self.provider,
                 "email": self.email,
                 "nodePools": [p.to_dict() for p in self.node_pools],
                 "applications": list(self.applications),
@@ -108,6 +115,7 @@ class PlatformSpec:
             name=d.get("metadata", {}).get("name", "kubeflow-tpu"),
             project=spec.get("project", "local"),
             zone=spec.get("zone", "local-a"),
+            provider=spec.get("provider", "fake"),
             email=spec.get("email"),
             node_pools=[
                 NodePool.from_dict(p) for p in spec.get("nodePools", [])

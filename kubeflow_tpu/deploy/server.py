@@ -36,21 +36,25 @@ class _Worker:
     """Per-deployment serializer: one queue, one thread — concurrent
     applies for the same deployment cannot interleave."""
 
-    def __init__(self, api: FakeApiServer, cloud: CloudProvider):
+    def __init__(self, api: FakeApiServer):
         self.api = api
-        self.cloud = cloud
-        self.queue: "queue.Queue[PlatformSpec | None]" = queue.Queue()
+        # Items are (spec, cloud): the provider is chosen per spec, so a
+        # deployment can move between fake and gke across re-applies.
+        self.queue: "queue.Queue[tuple[PlatformSpec, CloudProvider] | None]" = (
+            queue.Queue()
+        )
         self.last_applied: float = 0.0
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
 
     def _run(self) -> None:
         while True:
-            spec = self.queue.get()
-            if spec is None:
+            item = self.queue.get()
+            if item is None:
                 return
+            spec, cloud = item
             try:
-                apply_platform(spec, self.api, self.cloud)
+                apply_platform(spec, self.api, cloud)
             except Exception:
                 log.exception("deploy %s failed", spec.name)
             finally:
@@ -62,10 +66,24 @@ class _Worker:
 
 
 class DeployServer(App):
-    def __init__(self, api: FakeApiServer, cloud: CloudProvider):
+    def __init__(
+        self,
+        api: FakeApiServer,
+        cloud: CloudProvider,
+        gke_transport=None,
+    ):
         super().__init__("deploy-server")
         self.api = api
         self.cloud = cloud
+        # Specs selecting provider "gke" get a GkeCloud over this
+        # transport (default: recording — request construction is
+        # observable without a cloud; production injects a token-bearing
+        # HTTP transport, the kfctlServer.go:179-201 TokenSource slot).
+        if gke_transport is None:
+            from kubeflow_tpu.deploy.gke import RecordingTransport
+
+            gke_transport = RecordingTransport()
+        self.gke_transport = gke_transport
         self._workers: dict[str, _Worker] = {}
         self._specs: dict[str, PlatformSpec] = {}
         self._lock = threading.Lock()
@@ -79,8 +97,19 @@ class DeployServer(App):
         with self._lock:
             worker = self._workers.get(name)
             if worker is None:
-                worker = self._workers[name] = _Worker(self.api, self.cloud)
+                worker = self._workers[name] = _Worker(self.api)
             return worker
+
+    def _cloud_for(self, spec: PlatformSpec) -> CloudProvider:
+        if spec.provider == "fake":
+            return self.cloud
+        if spec.provider == "gke":
+            from kubeflow_tpu.deploy.gke import GkeCloud
+
+            return GkeCloud(self.gke_transport)
+        raise HttpError(
+            400, f"unknown provider {spec.provider!r} (fake | gke)"
+        )
 
     def create(self, req: Request) -> Response:
         body = req.json()
@@ -89,9 +118,10 @@ class DeployServer(App):
         if not body.get("metadata", {}).get("name"):
             raise HttpError(400, "spec needs metadata.name")
         spec = PlatformSpec.from_dict(body)
+        cloud = self._cloud_for(spec)  # validates provider before queueing
         with self._lock:
             self._specs[spec.name] = spec
-        self._worker_for(spec.name).queue.put(spec)
+        self._worker_for(spec.name).queue.put((spec, cloud))
         return success_response("name", spec.name)
 
     def status(self, req: Request) -> Response:
@@ -114,7 +144,7 @@ class DeployServer(App):
         if worker:
             worker.queue.join()  # drain in-flight applies first
             worker.stop()
-        delete_platform(spec, self.api, self.cloud)
+        delete_platform(spec, self.api, self._cloud_for(spec))
         return success_response()
 
     # -- gc mode -----------------------------------------------------------
@@ -142,7 +172,10 @@ class DeployServer(App):
             if worker:
                 worker.stop()
             if spec is not None:
-                delete_platform(spec, self.api, self.cloud)
+                # Same provider the spec deployed with — gc of a gke
+                # deployment must send the node-pool deletes on the gke
+                # transport, or real (billed) TPU pools leak.
+                delete_platform(spec, self.api, self._cloud_for(spec))
         return doomed
 
     def wait_idle(self) -> None:

@@ -18,6 +18,7 @@ from kubeflow_tpu.deploy.gke import (
     node_pool_delete_request,
 )
 from kubeflow_tpu.deploy.kfdef import NodePool, PlatformSpec
+from kubeflow_tpu.deploy.provisioner import FakeCloud
 from kubeflow_tpu.testing import FakeApiServer
 
 SPEC = PlatformSpec(
@@ -154,3 +155,64 @@ def test_dry_run_cli_prints_payloads(tmp_path):
     assert "container.googleapis.com" in out.stdout
     assert "K8S phase would apply" in out.stdout
     assert dry_run_requests(SPEC)[0].body["cluster"]["name"] == "kf-prod"
+
+
+def test_deploy_server_gke_provider_end_to_end():
+    """spec.provider='gke' drives the deploy server's two-phase apply
+    through GkeCloud: the PLATFORM phase emits real container-v1
+    payloads on the transport (GKE materializes the nodes in
+    production), the K8S phase applies bundles in-process."""
+    import time as _time
+
+    from kubeflow_tpu.deploy.server import DeployServer
+    from kubeflow_tpu.web.wsgi import TestClient
+
+    api = FakeApiServer()
+    transport = RecordingTransport(responses={"/nodePools": {"nodePools": []}})
+    server = DeployServer(api, FakeCloud(api), gke_transport=transport)
+    client = TestClient(server)
+    spec = PlatformSpec(
+        name="kf-gke", project="my-proj", zone="us-central2-b",
+        provider="gke",
+        node_pools=[NodePool(name="pool0", topology="4x4")],
+        applications=["tpujob-operator"] if "tpujob-operator" in _bundles()
+        else [],
+    )
+    resp = client.post("/kfctl/apps/v1/create", body=spec.to_dict())
+    assert resp.status == 200, resp.body
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        status = client.get("/kfctl/apps/v1/status/kf-gke")
+        if status.status == 200 and status.json()["status"].get(
+            "phase"
+        ) in ("Ready", "Failed"):
+            break
+        _time.sleep(0.1)
+    assert status.json()["status"]["phase"] == "Ready", status.json()
+    creates = [r for r in transport.requests if r.method == "POST"]
+    assert creates and creates[0].body["nodePool"]["name"] == "pool0"
+    # No Nodes materialized in-process — that's GKE's job.
+    assert api.list("Node", "") == []
+
+
+def test_deploy_server_rejects_unknown_provider():
+    from kubeflow_tpu.deploy.server import DeployServer
+    from kubeflow_tpu.web.wsgi import TestClient
+
+    api = FakeApiServer()
+    client = TestClient(DeployServer(api, FakeCloud(api)))
+    spec = PlatformSpec(name="x", provider="azure")
+    assert client.post(
+        "/kfctl/apps/v1/create", body=spec.to_dict()
+    ).status == 400
+
+
+def _bundles():
+    from kubeflow_tpu.deploy.bundles import BUNDLES
+
+    return BUNDLES
+
+
+def test_provider_round_trips_spec():
+    spec = PlatformSpec(name="p", provider="gke")
+    assert PlatformSpec.from_dict(spec.to_dict()).provider == "gke"
