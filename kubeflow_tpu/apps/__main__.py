@@ -24,6 +24,7 @@ from kubeflow_tpu.apps.jupyter import JupyterApp
 from kubeflow_tpu.apps.kfam import KfamApp
 from kubeflow_tpu.apps.tensorboards import TensorboardsApp
 from kubeflow_tpu.controllers import poddefault
+from kubeflow_tpu.controllers.cronworkflow import CronWorkflowController
 from kubeflow_tpu.controllers.nodehealth import NodeHealthController
 from kubeflow_tpu.controllers.notebook import NotebookController
 from kubeflow_tpu.controllers.profile import ProfileController
@@ -99,6 +100,7 @@ def main() -> None:
         NodeHealthController(api),
         StudyController(api),
         WorkflowController(api),
+        CronWorkflowController(api),
     ):
         manager.add(ctl.controller)
     poddefault.register(api)

@@ -45,6 +45,8 @@ ALIASES = {
     "tb": "Tensorboard",
     "study": "Study", "studies": "Study",
     "workflow": "Workflow", "workflows": "Workflow", "wf": "Workflow",
+    "cronworkflow": "CronWorkflow", "cronworkflows": "CronWorkflow",
+    "cwf": "CronWorkflow",
     "pod": "Pod", "pods": "Pod",
     "node": "Node", "nodes": "Node",
     "pvc": "PersistentVolumeClaim", "pvcs": "PersistentVolumeClaim",

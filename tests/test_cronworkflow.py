@@ -1,0 +1,280 @@
+"""CronWorkflow: schedule parsing + the scheduling controller
+(the Prow-periodics / Argo-CronWorkflow analog)."""
+
+import pytest
+
+from kubeflow_tpu.api.cron import (
+    KIND,
+    CronSchedule,
+    CronWorkflowSpec,
+)
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.workflow import KIND as WF_KIND
+from kubeflow_tpu.controllers.cronworkflow import (
+    LABEL_CRON,
+    CronWorkflowController,
+)
+from kubeflow_tpu.testing import FakeApiServer
+
+T0 = float(1_700_000_000 // 60 * 60)  # on a minute boundary
+
+WF_SPEC = {"steps": [{"name": "tick", "command": ["/bin/echo", "ok"]}]}
+
+
+# -- schedule parsing ------------------------------------------------------
+
+
+def test_cron_parse_star_and_steps():
+    s = CronSchedule.parse("*/15 * * * *")
+    assert s.minute == frozenset({0, 15, 30, 45})
+    assert s.hour == frozenset(range(24))
+
+
+def test_cron_parse_ranges_and_lists():
+    s = CronSchedule.parse("0 9-17 * * 1-5")
+    assert s.minute == frozenset({0})
+    assert s.hour == frozenset(range(9, 18))
+    assert s.dow == frozenset(range(1, 6))
+    s2 = CronSchedule.parse("5,35 0,12 1 1,6 *")
+    assert s2.minute == frozenset({5, 35})
+    assert s2.month == frozenset({1, 6})
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["* * * *", "61 * * * *", "a * * * *", "* * * * 8", "*/0 * * * *",
+     "5-2 * * * *"],
+)
+def test_cron_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        CronSchedule.parse(bad)
+
+
+def test_next_after_every_minute():
+    s = CronSchedule.parse("* * * * *")
+    assert s.next_after(T0) == T0 + 60
+    assert s.next_after(T0 + 1) == T0 + 60  # rounds to the next minute
+
+
+def test_next_after_quarter_hours():
+    s = CronSchedule.parse("*/15 * * * *")
+    nxt = s.next_after(T0)
+    assert nxt > T0 and s.matches(nxt)
+    import time as _time
+
+    assert _time.localtime(nxt).tm_min % 15 == 0
+
+
+def test_spec_validation():
+    CronWorkflowSpec(schedule="* * * * *", workflow_spec=WF_SPEC).validate()
+    with pytest.raises(ValueError):
+        CronWorkflowSpec(schedule="* * * * *", workflow_spec={}).validate()
+    with pytest.raises(ValueError):
+        CronWorkflowSpec(
+            schedule="* * * * *", workflow_spec=WF_SPEC,
+            concurrency_policy="Sometimes",
+        ).validate()
+
+
+# -- controller ------------------------------------------------------------
+
+
+class Clock:
+    def __init__(self, t):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _world(policy="Allow", suspend=False, history=3):
+    api = FakeApiServer()
+    clock = Clock(T0 + 1)
+    ctl = CronWorkflowController(api, now=clock)
+    spec = CronWorkflowSpec(
+        schedule="* * * * *",
+        workflow_spec=WF_SPEC,
+        concurrency_policy=policy,
+        suspend=suspend,
+        history_limit=history,
+    )
+    api.create(new_resource(KIND, "nightly", "ci", spec=spec.to_dict()))
+    ctl.controller.run_until_idle()
+    return api, clock, ctl
+
+
+def _tick(api, clock, ctl, dt=61):
+    clock.t += dt
+    ctl.controller.enqueue(("ci", "nightly"))
+    ctl.controller.run_until_idle()
+
+
+def spawned(api):
+    return api.list(WF_KIND, "ci", label_selector={LABEL_CRON: "nightly"})
+
+
+def test_first_reconcile_anchors_without_spawning():
+    api, clock, ctl = _world()
+    assert spawned(api) == []
+    status = api.get(KIND, "nightly", "ci").status
+    assert status["lastScheduleTime"] == clock.t
+
+
+def test_tick_spawns_owned_workflow():
+    api, clock, ctl = _world()
+    _tick(api, clock, ctl)
+    [wf] = spawned(api)
+    assert wf.spec["steps"][0]["name"] == "tick"
+    cw = api.get(KIND, "nightly", "ci")
+    assert wf.metadata.owner_references[0]["uid"] == cw.metadata.uid
+    reasons = [e.spec["reason"] for e in api.list("Event", "ci")]
+    assert "WorkflowSpawned" in reasons
+
+
+def test_many_missed_ticks_spawn_once():
+    """A controller that was down must not burst a backfill: one
+    catch-up run, anchored at the most recent missed tick."""
+    api, clock, ctl = _world()
+    _tick(api, clock, ctl, dt=3600)  # an hour of missed minutes
+    assert len(spawned(api)) == 1
+    status = api.get(KIND, "nightly", "ci").status
+    assert clock.t - status["lastScheduleTime"] < 120
+
+
+def test_forbid_skips_while_previous_runs():
+    api, clock, ctl = _world(policy="Forbid")
+    _tick(api, clock, ctl)
+    assert len(spawned(api)) == 1
+    _tick(api, clock, ctl)  # previous still non-terminal
+    assert len(spawned(api)) == 1
+    reasons = [e.spec["reason"] for e in api.list("Event", "ci")]
+    assert "RunSkipped" in reasons
+    # Finish the run → next tick fires again.
+    wf = spawned(api)[0]
+    wf.status["phase"] = "Succeeded"
+    api.update_status(wf)
+    _tick(api, clock, ctl)
+    assert len(spawned(api)) == 2
+
+
+def test_replace_deletes_running_run():
+    api, clock, ctl = _world(policy="Replace")
+    _tick(api, clock, ctl)
+    first = spawned(api)[0].metadata.name
+    _tick(api, clock, ctl)
+    names = [w.metadata.name for w in spawned(api)]
+    assert first not in names and len(names) == 1
+
+
+def test_suspend_holds_fire():
+    api, clock, ctl = _world(suspend=True)
+    _tick(api, clock, ctl, dt=3600)
+    assert spawned(api) == []
+
+
+def test_history_gc():
+    api, clock, ctl = _world(history=1)
+    for _ in range(3):
+        _tick(api, clock, ctl)
+        for wf in spawned(api):
+            if wf.status.get("phase") != "Succeeded":
+                wf.status["phase"] = "Succeeded"
+                api.update_status(wf)
+    ctl.controller.enqueue(("ci", "nightly"))
+    ctl.controller.run_until_idle()
+    assert len(spawned(api)) == 1  # older finished runs collected
+
+
+def test_invalid_spec_surfaces():
+    api = FakeApiServer()
+    ctl = CronWorkflowController(api, now=Clock(T0))
+    api.create(
+        new_resource(KIND, "bad", "ci",
+                     spec={"schedule": "nope", "workflowSpec": WF_SPEC})
+    )
+    ctl.controller.run_until_idle()
+    assert "error" in api.get(KIND, "bad", "ci").status
+    reasons = [e.spec["reason"] for e in api.list("Event", "ci")]
+    assert "InvalidSpec" in reasons
+
+
+def test_spawned_workflow_actually_runs(tmp_path):
+    """Integration: the cron tick materializes a Workflow the workflow
+    controller drives to completion with real step processes."""
+    import sys
+    import time as _time
+
+    from kubeflow_tpu.controllers.workflow import WorkflowController
+    from kubeflow_tpu.runtime import LocalPodRunner
+
+    api = FakeApiServer()
+    clock = Clock(T0 + 1)
+    cron_ctl = CronWorkflowController(api, now=clock)
+    wf_ctl = WorkflowController(api)
+    runner = LocalPodRunner(api, capture_dir=str(tmp_path))
+    spec = CronWorkflowSpec(
+        schedule="* * * * *",
+        workflow_spec={
+            "steps": [
+                {
+                    "name": "tick",
+                    "command": [sys.executable, "-c", "print('tick ok')"],
+                }
+            ]
+        },
+    )
+    api.create(new_resource(KIND, "nightly", "ci", spec=spec.to_dict()))
+    cron_ctl.controller.run_until_idle()
+    clock.t += 61
+    cron_ctl.controller.enqueue(("ci", "nightly"))
+    deadline = _time.time() + 60
+    try:
+        while _time.time() < deadline:
+            cron_ctl.controller.run_until_idle()
+            wf_ctl.controller.run_until_idle()
+            runner.step()
+            runs = spawned(api)
+            if runs and runs[0].status.get("phase") == "Succeeded":
+                break
+            _time.sleep(0.1)
+    finally:
+        runner.shutdown()
+    [wf] = spawned(api)
+    assert wf.status["phase"] == "Succeeded", wf.status
+
+
+def test_dow_seven_is_sunday():
+    assert CronSchedule.parse("0 6 * * 7").dow == frozenset({0})
+    assert CronSchedule.parse("0 6 * * 0,7").dow == frozenset({0})
+
+
+def test_unsatisfiable_schedule_is_invalid_spec():
+    """Field-valid but never-firing (Feb 31): terminal InvalidSpec, not
+    a crash-loop in requeue backoff."""
+    api = FakeApiServer()
+    ctl = CronWorkflowController(api, now=Clock(T0))
+    api.create(
+        new_resource(
+            KIND, "never", "ci",
+            spec={"schedule": "0 0 31 2 *", "workflowSpec": WF_SPEC},
+        )
+    )
+    ctl.controller.run_until_idle()
+    status = api.get(KIND, "never", "ci").status
+    assert "no matching time" in status["error"]
+
+
+def test_spawn_adopts_existing_run_after_crash():
+    """AlreadyExists on the recomputed run name (crash between create
+    and the status write) is adoption, not an error loop."""
+    api, clock, ctl = _world()
+    _tick(api, clock, ctl)
+    [wf] = spawned(api)
+    # Simulate the crash: rewind lastScheduleTime so the same fire time
+    # (and run name) is recomputed.
+    cw = api.get(KIND, "nightly", "ci")
+    cw.status["lastScheduleTime"] = cw.status["lastScheduleTime"] - 60
+    api.update_status(cw)
+    ctl.controller.enqueue(("ci", "nightly"))
+    ctl.controller.run_until_idle()  # must not raise / hot-loop
+    assert len(spawned(api)) == 1
