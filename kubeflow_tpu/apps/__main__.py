@@ -13,7 +13,10 @@ with a custom base, point the CLI via KFTPU_SERVER/--server).
 from __future__ import annotations
 
 import argparse
+import atexit
 import logging
+import shutil
+import tempfile
 import threading
 import time
 
@@ -110,7 +113,11 @@ def main() -> None:
     # Pending forever. Locally, pods run as subprocesses; server-shaped
     # workloads (notebook StatefulSets, tensorboard Deployments) are
     # materialized as already-Running pods so UIs reach "ready".
-    runner = LocalPodRunner(api)
+    # Capture pod stdout so `kubeflow_tpu.cli logs` works against the
+    # facade's kubelet-log-endpoint analog; removed on shutdown.
+    log_dir = tempfile.mkdtemp(prefix="kftpu-pod-logs-")
+    atexit.register(shutil.rmtree, log_dir, True)
+    runner = LocalPodRunner(api, capture_dir=log_dir)
     materializer = WorkloadMaterializer(api)
     runner_stop = threading.Event()
 
@@ -139,7 +146,8 @@ def main() -> None:
         # The raw apiserver facade (base+4): the kubectl-analog CLI's
         # target (`python -m kubeflow_tpu.cli --server ...`) and the
         # /debug/traces drain. In-cluster trust domain — local use only.
-        ApiServerApp(api),
+        # log_root gates /log serving to the runner's capture dir.
+        ApiServerApp(api, log_root=log_dir),
     ]
     servers = []
     for offset, app in enumerate(apps):

@@ -219,6 +219,42 @@ def cmd_delete(client: HttpApiClient, args) -> int:
     return 0
 
 
+def cmd_logs(client: HttpApiClient, args) -> int:
+    """kubectl-logs analog: the pod's captured stdout via the facade's
+    kubelet-log-endpoint route. `--job` prints every worker of a TpuJob
+    gang (rank-ordered), the multi-worker case kubectl has no one-shot
+    answer for."""
+    from kubeflow_tpu.testing.fake_apiserver import NotFound
+
+    names = [args.name]
+    if args.job:
+        pods = client.list(
+            "Pod", args.namespace,
+            label_selector={"kubeflow-tpu.org/job": args.name},
+        )
+        pods.sort(
+            key=lambda p: int(
+                p.metadata.labels.get("kubeflow-tpu.org/worker-index", "0")
+            )
+        )
+        names = [p.metadata.name for p in pods]
+        if not names:
+            print(f"error: no pods for job {args.name!r}", file=sys.stderr)
+            return 1
+    rc = 0
+    for name in names:
+        if len(names) > 1:
+            print(f"==> {name} <==")
+        try:
+            sys.stdout.write(
+                client.pod_log(name, args.namespace or "default")
+            )
+        except NotFound as e:
+            print(f"error: {name}: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def cmd_traces(client: HttpApiClient, args) -> int:
     data = client._call("GET", "/debug/traces")
     for span in data.get("spans", []):
@@ -269,6 +305,16 @@ def main(argv: list[str] | None = None) -> int:
     delete.add_argument("name")
     delete.add_argument("-n", "--namespace", default="default")
     delete.set_defaults(fn=cmd_delete)
+
+    logs = sub.add_parser("logs", help="print a pod's captured stdout")
+    logs.add_argument("name", help="pod name (or job name with --job)")
+    logs.add_argument("-n", "--namespace", default="default")
+    logs.add_argument(
+        "--job", action="store_true",
+        help="treat NAME as a TpuJob and print every worker's log in "
+        "rank order",
+    )
+    logs.set_defaults(fn=cmd_logs)
 
     traces = sub.add_parser("traces", help="drain control-plane trace spans")
     traces.set_defaults(fn=cmd_traces)

@@ -112,11 +112,13 @@ class LocalPodRunner:
             self._set_phase(pod, "Failed")
             return
         stdout = None
+        log_path = None
         if self.capture_dir:
             os.makedirs(self.capture_dir, exist_ok=True)
-            stdout = open(
-                os.path.join(self.capture_dir, f"{pod.metadata.name}.log"), "w"
+            log_path = os.path.abspath(
+                os.path.join(self.capture_dir, f"{pod.metadata.name}.log")
             )
+            stdout = open(log_path, "w")
         log.info("starting pod %s: %s", pod.metadata.name, " ".join(cmd))
         try:
             proc = subprocess.Popen(
@@ -137,7 +139,24 @@ class LocalPodRunner:
                 stdout.close()
         with self._lock:
             self._procs[key] = proc
-        self._set_phase(pod, "Running")
+        # One status write: Running phase plus (when capturing) where the
+        # pod's stdout lands, so the apiserver facade can serve `kubectl
+        # logs` (`/apis/Pod/<ns>/<name>/log`, the kubelet log-endpoint
+        # analog). A separate logPath write would double the MODIFIED
+        # events every watcher sees per pod start.
+        try:
+            fresh = self.api.get(
+                "Pod", pod.metadata.name, pod.metadata.namespace
+            )
+        except NotFound:
+            return
+        changed = fresh.status.get("phase") != "Running"
+        fresh.status["phase"] = "Running"
+        if log_path and fresh.status.get("logPath") != log_path:
+            fresh.status["logPath"] = log_path
+            changed = True
+        if changed:
+            self.api.update_status(fresh)
 
     def _set_phase(self, pod: Resource, phase: str) -> None:
         try:

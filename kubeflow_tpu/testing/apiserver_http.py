@@ -70,9 +70,18 @@ class ApiServerApp(App):
     (the reference controllers talk to the apiserver with pod
     serviceaccounts; web-tier authn/authz stays in the web apps)."""
 
-    def __init__(self, api: FakeApiServer):
+    def __init__(self, api: FakeApiServer, log_root: str | None = None):
         super().__init__("apiserver")
         self.api = api
+        # Containment root for /log: only files under the runner's
+        # capture dir are served. status is client-writable, so serving
+        # status.logPath unconstrained would be an arbitrary-file-read
+        # primitive. None disables log serving entirely.
+        import pathlib
+
+        self.log_root = (
+            pathlib.Path(log_root).resolve() if log_root else None
+        )
         self.add_route("/apis/<kind>", self.list_kind)
         self.add_route("/apis/<kind>", self.create, ("POST",))
         self.add_route("/apis/<kind>/<ns>/<name>", self.get)
@@ -81,6 +90,9 @@ class ApiServerApp(App):
         self.add_route(
             "/apis/<kind>/<ns>/<name>/status", self.update_status, ("PUT",)
         )
+        # kubelet log-endpoint analog: serves the pod's captured stdout
+        # (LocalPodRunner publishes status.logPath). Pod-only.
+        self.add_route("/apis/Pod/<ns>/<name>/log", self.pod_log)
         # In-process trace collector drain (the platform's jaeger-query
         # stand-in): returns and clears all finished spans.
         self.add_route("/debug/traces", self.drain_traces)
@@ -212,6 +224,36 @@ class ApiServerApp(App):
         )
         return json_response({"deleted": True})
 
+    def pod_log(self, req: Request) -> Response:
+        import pathlib
+
+        if self.log_root is None:
+            raise HttpError(
+                404, "log serving not configured (no capture directory)"
+            )
+        pod = self.api.get(
+            "Pod", req.path_params["name"], _seg_ns(req.path_params["ns"])
+        )
+        log_path = pod.status.get("logPath")
+        if not log_path:
+            raise HttpError(
+                404,
+                f"pod {pod.metadata.name!r} has no captured logs (the "
+                "local runtime publishes status.logPath when capture is "
+                "on)",
+            )
+        path = pathlib.Path(log_path).resolve()
+        # status is client-writable: refuse anything outside the capture
+        # root (resolve() collapses ../ and symlinks first).
+        if not path.is_relative_to(self.log_root):
+            raise HttpError(
+                404, f"log path for {pod.metadata.name!r} is outside the "
+                "capture directory",
+            )
+        if not path.is_file():
+            raise HttpError(404, f"log file {log_path!r} is gone")
+        return Response(path.read_bytes(), content_type="text/plain")
+
 
 class HttpApiClient:
     """Remote twin of FakeApiServer's CRUD + watch surface.
@@ -329,6 +371,26 @@ class HttpApiClient:
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         self._call("DELETE", f"/apis/{kind}/{_ns_seg(namespace)}/{name}")
+
+    def pod_log(self, name: str, namespace: str = "default") -> str:
+        """The pod's captured stdout (raw text; same tracing header and
+        error mapping as every other call)."""
+        req = urllib.request.Request(
+            f"{self.base_url}/apis/Pod/{_ns_seg(namespace)}/{name}/log",
+            headers=tracing.trace_header(),
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode(errors="replace")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("log", detail)
+            except ValueError:
+                pass
+            if e.code == 404:
+                raise NotFound(detail)
+            raise
 
     def apply(self, obj: Resource) -> Resource:
         """Create-or-update, evaluated server-side (the store's compare is

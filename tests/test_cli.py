@@ -206,3 +206,62 @@ def test_get_watch_single_object_filters(server):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_logs_command(tmp_path):
+    """kubectl-logs analog: pod stdout served through the facade's
+    log endpoint, including --job rank-ordered gang output. The facade
+    serves only files under its configured log_root."""
+    import time
+
+    from kubeflow_tpu.api import make_tpujob
+    from kubeflow_tpu.controllers.tpujob import TpuJobController
+    from kubeflow_tpu.runtime import LocalPodRunner
+
+    api = FakeApiServer()
+    httpd, _ = serve(ApiServerApp(api, log_root=str(tmp_path)),
+                     host="127.0.0.1", port=0)
+    url = f"http://127.0.0.1:{httpd.server_port}"
+    ctl = TpuJobController(api)
+    runner = LocalPodRunner(api, capture_dir=str(tmp_path))
+    api.create(
+        make_tpujob(
+            "talk", replicas=2, tpu_chips_per_worker=0,
+            command=(sys.executable, "-c",
+                     "import os; print('hello from', os.environ['TPU_WORKER_ID'])"),
+        )
+    )
+    deadline = time.time() + 60
+    try:
+        while time.time() < deadline:
+            ctl.controller.run_until_idle()
+            runner.step()
+            job = api.get("TpuJob", "talk")
+            if job.status.get("phase") in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+    finally:
+        runner.shutdown()
+    assert api.get("TpuJob", "talk").status["phase"] == "Succeeded"
+
+    rc, out, err = run(url, "logs", "talk-worker-0")
+    assert rc == 0, err
+    assert "hello from 0" in out
+
+    rc, out, err = run(url, "logs", "talk", "--job")
+    assert rc == 0, err
+    assert out.index("hello from 0") < out.index("hello from 1")
+    assert "==> talk-worker-1 <==" in out
+
+    rc, _, err = run(url, "logs", "no-such-pod")
+    assert rc == 1 and "not found" in err
+
+    # Containment: a client-written logPath outside the capture root is
+    # refused — status is client-writable, so this would otherwise be an
+    # arbitrary-file-read primitive.
+    victim = api.get("Pod", "talk-worker-0")
+    victim.status["logPath"] = "/etc/hostname"
+    api.update_status(victim)
+    rc, _, err = run(url, "logs", "talk-worker-0")
+    assert rc == 1 and "outside" in err
+    httpd.shutdown()
