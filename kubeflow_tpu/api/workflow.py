@@ -26,7 +26,14 @@ class StepSpec:
     load time (`<name>-<i>`, with `${item}` substituted in command/args/
     env) — the Argo `withItems` surface. `when` is a conditional guard
     evaluated after templating, once dependencies are satisfied: false →
-    the step is Skipped, and (Argo DAG semantics) dependents still run."""
+    the step is Skipped, and (Argo DAG semantics) dependents still run.
+
+    `tpu_job` makes the step a SLICE step: instead of one pod, the
+    controller materializes a TpuJob (a whole gang on TPU hardware) and
+    maps its phase onto the step; the job's reported observation becomes
+    the step's output. This is how a CI DAG gates on real training — the
+    reference ran its training smoke tests as Argo steps shelling out to
+    kubectl (`kfctl_go_test.jsonnet`); here the operator is native."""
 
     name: str
     command: tuple[str, ...] = ()
@@ -37,12 +44,50 @@ class StepSpec:
     retries: int = 0
     with_items: tuple[str, ...] = ()
     when: str = ""
+    # TpuJobSpec dict — mutually exclusive with command.
+    tpu_job: dict[str, Any] | None = None
 
     def validate(self) -> None:
         if not self.name:
             raise ValueError("step needs a name")
-        if not self.command:
-            raise ValueError(f"step {self.name!r} needs a command")
+        if self.tpu_job is not None:
+            # The job spec carries its own command/args/env/image; pod-
+            # level fields on a slice step would be silently ignored —
+            # reject them instead.
+            ignored = [
+                field
+                for field, is_set in (
+                    ("command", bool(self.command)),
+                    ("args", bool(self.args)),
+                    ("env", bool(self.env)),
+                    ("image",
+                     self.image != "kubeflow-tpu/ci-runner:latest"),
+                )
+                if is_set
+            ]
+            if ignored:
+                raise ValueError(
+                    f"step {self.name!r}: tpuJob and "
+                    f"{'/'.join(ignored)} are mutually exclusive (set "
+                    "them inside the tpuJob spec)"
+                )
+            # Admission-time job validation — a typo'd TpuJob must not
+            # burn the step's whole retry budget on identical runtime
+            # InvalidSpec failures. Skipped when the spec contains
+            # template tokens (final values unknown until render).
+            if not any(
+                "${" in s for s in _iter_strings(self.tpu_job)
+            ):
+                from kubeflow_tpu.api.tpujob import TpuJobSpec
+
+                try:
+                    TpuJobSpec.from_dict(self.tpu_job)
+                except Exception as e:
+                    raise ValueError(
+                        f"step {self.name!r}: invalid tpuJob: {e}"
+                    ) from e
+        elif not self.command:
+            raise ValueError(f"step {self.name!r} needs a command or tpuJob")
         if self.retries < 0:
             raise ValueError(f"step {self.name!r}: retries must be >= 0")
 
@@ -60,6 +105,8 @@ class StepSpec:
             d["withItems"] = list(self.with_items)
         if self.when:
             d["when"] = self.when
+        if self.tpu_job is not None:
+            d["tpuJob"] = dict(self.tpu_job)
         return d
 
     @classmethod
@@ -76,6 +123,9 @@ class StepSpec:
             retries=int(d.get("retries", 0)),
             with_items=tuple(str(i) for i in d.get("withItems") or ()),
             when=str(d.get("when", "")),
+            tpu_job=(
+                dict(d["tpuJob"]) if d.get("tpuJob") is not None else None
+            ),
         )
 
 
@@ -148,8 +198,7 @@ class WorkflowSpec:
 
         for s in self.steps:
             reachable = closure(s.name)
-            for value in (*s.command, *s.args, *(v for _, v in s.env),
-                          s.when):
+            for value in _step_strings(s):
                 for match in _TOKEN_RE.finditer(value):
                     ref = match.group(2)
                     if ref is not None and ref not in reachable:
@@ -218,8 +267,7 @@ class WorkflowSpec:
                 [spec.on_exit] if spec.on_exit else []
             )
             for s in every:
-                for value in (*s.command, *s.args,
-                              *(v for _, v in s.env), s.when):
+                for value in _step_strings(s):
                     for match in _TOKEN_RE.finditer(value):
                         if match.group(2) in fanned:
                             raise ValueError(
@@ -240,6 +288,38 @@ _TOKEN_RE = re.compile(
 )
 
 
+def _map_strings(node: Any, fn) -> Any:
+    """Apply fn to every string in a nested dict/list structure — THE
+    tree walker for all step templating (render, ${item} expansion);
+    validators iterate the same shape via _iter_strings so the two can
+    never disagree about what is templatable."""
+    if isinstance(node, str):
+        return fn(node)
+    if isinstance(node, dict):
+        return {k: _map_strings(v, fn) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return [_map_strings(v, fn) for v in node]
+    return node
+
+
+def _iter_strings(node: Any):
+    if isinstance(node, str):
+        yield node
+    elif isinstance(node, dict):
+        for v in node.values():
+            yield from _iter_strings(v)
+    elif isinstance(node, (list, tuple)):
+        for v in node:
+            yield from _iter_strings(v)
+
+
+def _step_strings(s: "StepSpec"):
+    """Every templatable string a step carries (incl. nested tpuJob)."""
+    yield from (*s.command, *s.args, *(v for _, v in s.env), s.when)
+    if s.tpu_job is not None:
+        yield from _iter_strings(s.tpu_job)
+
+
 def _expand_with_items(
     steps: tuple[StepSpec, ...],
 ) -> tuple[tuple[StepSpec, ...], dict[str, tuple[str, ...]]]:
@@ -255,16 +335,20 @@ def _expand_with_items(
             continue
         names = []
         for i, item in enumerate(s.with_items):
+            sub = lambda text, item=item: text.replace("${item}", item)
             inst = dataclasses.replace(
                 s,
                 name=f"{s.name}-{i}",
-                command=tuple(c.replace("${item}", item) for c in s.command),
-                args=tuple(a.replace("${item}", item) for a in s.args),
-                env=tuple(
-                    (k, v.replace("${item}", item)) for k, v in s.env
-                ),
-                when=s.when.replace("${item}", item),
+                command=tuple(sub(c) for c in s.command),
+                args=tuple(sub(a) for a in s.args),
+                env=tuple((k, sub(v)) for k, v in s.env),
+                when=sub(s.when),
                 with_items=(),
+                tpu_job=(
+                    _map_strings(s.tpu_job, sub)
+                    if s.tpu_job is not None
+                    else None
+                ),
             )
             names.append(inst.name)
             expanded.append(inst)
@@ -354,23 +438,24 @@ def render_step(
     *,
     partial: bool = False,
 ) -> StepSpec:
-    """The step with all templating applied to command/args/env values.
+    """The step with all templating applied to command/args/env values
+    (and, for slice steps, every string inside the tpuJob spec).
 
     `outputs` maps step name → that step's reported output; the
     controller only creates a step after its dependencies succeeded, so
     every `${steps.<dep>.output}` a well-formed DAG references exists."""
+
+    def render(text: str) -> str:
+        return render_value(text, parameters, outputs, partial=partial)
+
     return dataclasses.replace(
         step,
-        command=tuple(
-            render_value(c, parameters, outputs, partial=partial)
-            for c in step.command
-        ),
-        args=tuple(
-            render_value(a, parameters, outputs, partial=partial)
-            for a in step.args
-        ),
-        env=tuple(
-            (k, render_value(v, parameters, outputs, partial=partial))
-            for k, v in step.env
+        command=tuple(render(c) for c in step.command),
+        args=tuple(render(a) for a in step.args),
+        env=tuple((k, render(v)) for k, v in step.env),
+        tpu_job=(
+            _map_strings(step.tpu_job, render)
+            if step.tpu_job is not None
+            else None
         ),
     )

@@ -20,6 +20,7 @@ import logging
 
 from kubeflow_tpu.api import workflow as wf_api
 from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.api.tpujob import KIND as TPUJOB_KIND
 from kubeflow_tpu.controllers.runtime import Controller, Key, Result
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
 from kubeflow_tpu.utils.metrics import MetricsRegistry
@@ -46,6 +47,22 @@ def report_step_output(api, pod_name: str, namespace: str, output) -> None:
     pod = api.get("Pod", pod_name, namespace)
     pod.status["output"] = str(output)
     api.update_status(pod)
+
+
+def _attempt_output(res: Resource) -> str | None:
+    """A pod attempt's reported output, or — for a slice step's TpuJob —
+    the gang's observation as JSON (the launcher's report_observation
+    contract), so downstream steps can template on training results."""
+    output = res.status.get("output")
+    if output is not None:
+        return str(output)
+    if res.kind == TPUJOB_KIND:
+        observation = res.status.get("observation")
+        if observation is not None:
+            import json
+
+            return json.dumps(observation, sort_keys=True)
+    return None
 
 
 def next_attempt(attempts: list[Resource]) -> int:
@@ -77,7 +94,9 @@ class WorkflowController:
             api,
             wf_api.KIND,
             self.reconcile,
-            owns=("Pod",),
+            # Slice steps materialize TpuJobs instead of Pods; both kinds
+            # drive the DAG via ownership watches.
+            owns=("Pod", TPUJOB_KIND),
             name="workflow-controller",
             metrics=metrics,
         )
@@ -91,6 +110,25 @@ class WorkflowController:
         step: wf_api.StepSpec,
         attempt: int,
     ) -> None:
+        if step.tpu_job is not None:
+            # Slice step: a whole TpuJob gang instead of one pod — the
+            # TpuJob operator takes it from here (placement, env
+            # contract, whole-gang restart); the DAG reads its phase.
+            job = new_resource(
+                TPUJOB_KIND,
+                step_pod_name(workflow.metadata.name, step.name, attempt),
+                workflow.metadata.namespace,
+                spec=dict(step.tpu_job),
+                labels={
+                    LABEL_WORKFLOW: workflow.metadata.name,
+                    LABEL_STEP: step.name,
+                    LABEL_ATTEMPT: str(attempt),
+                },
+            )
+            job.metadata.owner_references = [owner_ref(workflow)]
+            self.api.create(job)
+            self.steps_total.inc(workflow=workflow.metadata.name)
+            return
         env = dict(step.env)
         env["WORKFLOW_NAME"] = workflow.metadata.name
         env["STEP_NAME"] = step.name
@@ -150,7 +188,11 @@ class WorkflowController:
             api.record_event(wf, "InvalidSpec", str(e), type_="Warning")
             return self._set_status(api, wf, "Failed", reason=str(e))
 
-        pods = api.list("Pod", ns, label_selector={LABEL_WORKFLOW: name})
+        pods = api.list(
+            "Pod", ns, label_selector={LABEL_WORKFLOW: name}
+        ) + api.list(
+            TPUJOB_KIND, ns, label_selector={LABEL_WORKFLOW: name}
+        )
         by_step: dict[str, list[Resource]] = {}
         for p in pods:
             by_step.setdefault(p.metadata.labels.get(LABEL_STEP, ""), []).append(p)
@@ -192,7 +234,11 @@ class WorkflowController:
                 state = "Skipped"
             elif render_error:
                 state = "Failed"
-            elif any(ph in ("Pending", "Running") for ph in phases):
+            elif any(ph not in ("Succeeded", "Failed") for ph in phases):
+                # Anything non-terminal is in flight — slice steps'
+                # TpuJobs have phases beyond Pending/Running (e.g.
+                # Restarting mid-gang-recovery); treating those as "not
+                # running" would materialize a duplicate concurrent gang.
                 state = "Running"
                 active += 1
             elif attempts or failed_attempts:
@@ -207,7 +253,7 @@ class WorkflowController:
             if state == "Succeeded" and output is None:
                 for p in attempts:
                     if p.status.get("phase") == "Succeeded":
-                        output = p.status.get("output")
+                        output = _attempt_output(p)
                         if output is not None:
                             break
             steps_status[step.name] = {
@@ -315,7 +361,9 @@ class WorkflowController:
             elif not exit_attempts and not exit_failed:
                 self._create_step_pod(wf, spec, exit_step, 0)
                 exit_state = "Running"
-            elif any(ph in ("Pending", "Running") for ph in exit_phases):
+            elif any(
+                ph not in ("Succeeded", "Failed") for ph in exit_phases
+            ):
                 exit_state = "Running"
             elif len(exit_failed) > spec.on_exit.retries:
                 exit_state = "Failed"

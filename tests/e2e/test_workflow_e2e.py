@@ -198,3 +198,82 @@ def test_conditional_step_skipped_end_to_end(tmp_path):
     assert status["steps"]["remediate"]["state"] == "Skipped"
     assert not (artifacts / "remediated.txt").exists()
     assert (artifacts / "report.txt").exists()
+
+
+def test_slice_step_runs_real_gang(tmp_path):
+    """A CI DAG whose 'train' step is a TpuJob: the workflow controller
+    materializes the gang, the TpuJob operator runs it as real
+    processes, the worker reports its observation over the facade, and
+    the downstream step receives it via ${steps.train.output}."""
+    import os
+
+    from kubeflow_tpu.controllers.tpujob import TpuJobController
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+    from kubeflow_tpu.web.wsgi import serve
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    artifacts = tmp_path / "artifacts"
+    artifacts.mkdir()
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    wf_ctl = WorkflowController(api)
+    job_ctl = TpuJobController(api)
+    runner = LocalPodRunner(
+        api,
+        extra_env={
+            "KFTPU_REPO": repo,
+            "KFTPU_APISERVER": f"http://127.0.0.1:{server.server_port}",
+        },
+        capture_dir=str(tmp_path / "logs"),
+    )
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(
+                name="train",
+                tpu_job={
+                    "replicas": 1,
+                    "image": "local",
+                    "command": [
+                        sys.executable,
+                        os.path.join(repo, "tests", "e2e",
+                                     "trial_worker.py"),
+                        "--lr", "0.05",
+                    ],
+                    "tpu": {"chipsPerWorker": 0},
+                    "maxRestarts": 0,
+                },
+            ),
+            StepSpec(
+                name="report",
+                command=(
+                    sys.executable, "-c",
+                    "import os,pathlib;"
+                    "pathlib.Path(os.environ['STEP_ARTIFACTS'],"
+                    "'result.json').write_text(os.environ['TRAIN_RESULT'])",
+                ),
+                env=(("TRAIN_RESULT", "${steps.train.output}"),),
+                dependencies=("train",),
+            ),
+        ),
+        artifacts_dir=str(artifacts),
+    )
+    api.create(new_resource(KIND, "ci-train", "ci", spec=spec.to_dict()))
+    deadline = time.time() + 150
+    try:
+        while time.time() < deadline:
+            wf_ctl.controller.run_until_idle()
+            job_ctl.controller.run_until_idle()
+            runner.step()
+            phase = api.get(KIND, "ci-train", "ci").status.get("phase")
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+    finally:
+        runner.shutdown()
+        server.shutdown()
+
+    status = api.get(KIND, "ci-train", "ci").status
+    assert status["phase"] == "Succeeded", status
+    result = (artifacts / "result.json").read_text()
+    assert '"loss"' in result and "0.0" in result, result

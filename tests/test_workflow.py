@@ -688,3 +688,167 @@ def test_when_output_reference_requires_dependency():
                 ]
             }
         )
+
+
+# -- slice steps (tpuJob) ---------------------------------------------------
+
+
+def test_tpu_job_step_lifecycle():
+    """A tpuJob step materializes a TpuJob gang (not a pod), maps its
+    phase onto the DAG, and exposes the gang's observation as the step
+    output for downstream templating."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(
+                name="train",
+                tpu_job={
+                    "replicas": 2,
+                    "image": "local",
+                    "command": ["python", "train.py"],
+                    "tpu": {"chipsPerWorker": 4},
+                },
+            ),
+            StepSpec(
+                name="report",
+                command=("publish", "${steps.train.output}"),
+                dependencies=("train",),
+            ),
+        )
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    [job] = api.list("TpuJob", "ci")
+    assert job.spec["replicas"] == 2
+    assert job.metadata.labels[LABEL_STEP] == "train"
+    assert api.list("Pod", "ci") == []  # no bare step pod for slice steps
+
+    # Gang finishes with an observation (launcher contract).
+    job.status = {"phase": "Succeeded",
+                  "observation": {"loss": 0.25, "accuracy": 0.9}}
+    api.update_status(job)
+    ctl.controller.run_until_idle()
+    [report] = pods_for(api, "report")
+    cmd = report.spec["containers"][0]["command"]
+    assert cmd[0] == "publish" and '"loss": 0.25' in cmd[1]
+    finish(api, report)
+    ctl.controller.run_until_idle()
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["phase"] == "Succeeded"
+    assert '"loss": 0.25' in wf.status["steps"]["train"]["output"]
+
+
+def test_tpu_job_step_failure_fails_dag_and_retries():
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(
+                name="train", retries=1,
+                tpu_job={"replicas": 1, "image": "local",
+                         "command": ["python"], "tpu": {"chipsPerWorker": 0}},
+            ),
+        )
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    [job] = api.list("TpuJob", "ci")
+    job.status = {"phase": "Failed"}
+    api.update_status(job)
+    ctl.controller.run_until_idle()
+    jobs = api.list("TpuJob", "ci")
+    assert len(jobs) == 2  # retry attempt materialized
+    for j in jobs:
+        if j.status.get("phase") != "Failed":
+            j.status = {"phase": "Failed"}
+            api.update_status(j)
+    ctl.controller.run_until_idle()
+    assert api.get(KIND, "wf", "ci").status["phase"] == "Failed"
+
+
+def test_tpu_job_step_validation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        StepSpec(name="x", command=("a",), tpu_job={"replicas": 1}).validate()
+    with pytest.raises(ValueError, match="command or tpuJob"):
+        StepSpec(name="x").validate()
+
+
+def test_tpu_job_step_templating_and_fanout():
+    """Workflow parameters render inside the job spec, and withItems
+    fans slice steps out like any other step."""
+    spec = WorkflowSpec.from_dict(
+        {
+            "parameters": {"image": "gcr.io/x/train:v3"},
+            "steps": [
+                {
+                    "name": "sweep",
+                    "withItems": ["1e-3", "1e-4"],
+                    "tpuJob": {
+                        "replicas": 1,
+                        "image": "${workflow.parameters.image}",
+                        "command": ["python", "--lr", "${item}"],
+                        "tpu": {"chipsPerWorker": 4},
+                    },
+                }
+            ],
+        }
+    )
+    from kubeflow_tpu.api.workflow import render_step
+
+    s0 = spec.step("sweep-0")
+    assert s0.tpu_job["command"] == ["python", "--lr", "1e-3"]
+    rendered = render_step(s0, spec.parameters, {})
+    assert rendered.tpu_job["image"] == "gcr.io/x/train:v3"
+
+
+def test_restarting_gang_is_in_flight_not_retried():
+    """TpuJob phases beyond Pending/Running (Restarting during gang
+    recovery) are in flight — the DAG must not materialize a duplicate
+    concurrent gang."""
+    api = FakeApiServer()
+    ctl = WorkflowController(api)
+    spec = WorkflowSpec(
+        steps=(
+            StepSpec(
+                name="train", retries=2,
+                tpu_job={"replicas": 1, "image": "local",
+                         "command": ["python"],
+                         "tpu": {"chipsPerWorker": 0}},
+            ),
+        )
+    )
+    make_workflow(api, spec)
+    ctl.controller.run_until_idle()
+    [job] = api.list("TpuJob", "ci")
+    job.status = {"phase": "Restarting", "restarts": 1}
+    api.update_status(job)
+    ctl.controller.run_until_idle()
+    assert len(api.list("TpuJob", "ci")) == 1  # no duplicate gang
+    wf = api.get(KIND, "wf", "ci")
+    assert wf.status["steps"]["train"]["state"] == "Running"
+
+
+def test_tpu_job_step_admission_validation():
+    """A typo'd tpuJob fails at workflow admission, not by burning the
+    retry budget on runtime InvalidSpec failures; templated specs are
+    exempt (final values unknown until render)."""
+    with pytest.raises(ValueError, match="invalid tpuJob"):
+        StepSpec(name="x", tpu_job={"replicas": 0}).validate()
+    # Template token → admission skips the job validation.
+    StepSpec(
+        name="x",
+        tpu_job={"replicas": 1, "command": ["r", "${item}"],
+                 "tpu": {"chipsPerWorker": 0}},
+    ).validate()
+
+
+def test_tpu_job_step_rejects_pod_level_fields():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        StepSpec(name="x", env=(("A", "1"),),
+                 tpu_job={"replicas": 1, "command": ["r"],
+                          "tpu": {"chipsPerWorker": 0}}).validate()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        StepSpec(name="x", image="custom:latest",
+                 tpu_job={"replicas": 1, "command": ["r"],
+                          "tpu": {"chipsPerWorker": 0}}).validate()
