@@ -147,5 +147,8 @@ def test_http_client_propagates_active_trace():
             want = outer.trace_id
     finally:
         server.shutdown()
+    # Membership, not last-element: an in-flight long-poll from a prior
+    # test's daemon watch thread may drop a stray http span on the global
+    # tracer while this test runs.
     http = [s for s in tracing.tracer.export() if s["name"] == "http"]
-    assert http and http[-1]["traceId"] == want
+    assert want in {s["traceId"] for s in http}
