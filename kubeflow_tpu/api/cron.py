@@ -61,6 +61,13 @@ class CronSchedule:
     dom: frozenset[int]
     month: frozenset[int]
     dow: frozenset[int]
+    # Vixie day semantics need to know whether the day fields were
+    # written as '*' (a `*/n` form counts as star, matching Vixie's
+    # DOM_STAR/DOW_STAR flags): when BOTH dom and dow are restricted, a
+    # day matches if EITHER does — '0 0 1,15 * 1' fires on the 1st, the
+    # 15th, AND every Monday.
+    dom_star: bool = True
+    dow_star: bool = True
 
     @classmethod
     def parse(cls, expr: str) -> "CronSchedule":
@@ -78,31 +85,74 @@ class CronSchedule:
         dow = fields[4]
         if 7 in dow:
             dow = (dow - {7}) | {0}
-        return cls(*fields[:4], frozenset(dow))
+        return cls(
+            *fields[:4],
+            frozenset(dow),
+            dom_star=parts[2].startswith("*"),
+            dow_star=parts[4].startswith("*"),
+        )
+
+    def _day_matches(self, tm: time.struct_time) -> bool:
+        dow = (tm.tm_wday + 1) % 7  # tm_wday: 0=Mon → cron: 0=Sun
+        dom_ok = tm.tm_mday in self.dom
+        dow_ok = dow in self.dow
+        if self.dom_star or self.dow_star:
+            return dom_ok and dow_ok
+        return dom_ok or dow_ok  # Vixie OR when both are restricted
 
     def matches(self, t: float) -> bool:
         tm = time.localtime(t)
-        dow = (tm.tm_wday + 1) % 7  # tm_wday: 0=Mon → cron: 0=Sun
         return (
             tm.tm_min in self.minute
             and tm.tm_hour in self.hour
-            and tm.tm_mday in self.dom
             and tm.tm_mon in self.month
-            and dow in self.dow
+            and self._day_matches(tm)
         )
 
     def next_after(self, t: float, horizon_days: int = 1500) -> float:
-        """First matching minute strictly after `t` (minute scan — cron
-        is minute-resolution). The horizon spans a full leap cycle so a
-        Feb-29 schedule resolves from any anchor; a schedule with NO
+        """First matching minute strictly after `t`.
+
+        Field arithmetic, not a minute scan: walk candidate DAYS (mktime
+        normalizes day overflow, so DST days keep their civil dates) and
+        only enumerate the schedule's own hour×minute sets inside a
+        matching day — a sparse-but-valid schedule like '0 0 29 2 *'
+        costs ~1500 cheap day probes, not 2.1M minute probes (reconciles
+        call this on every pass). The horizon spans a full leap cycle so
+        a Feb-29 schedule resolves from any anchor; a schedule with NO
         match inside it (e.g. Feb 31) raises — callers surface that as
         an invalid spec, never a retry loop."""
-        # Round down to the minute, then step.
-        base = int(t // 60) * 60
-        for i in range(1, horizon_days * 24 * 60):
-            candidate = base + i * 60
-            if self.matches(candidate):
-                return float(candidate)
+        hours = sorted(self.hour)
+        minutes = sorted(self.minute)
+        base_tm = time.localtime(t)
+        for d in range(horizon_days + 1):
+            # Noon probe sidesteps DST boundary ambiguity when resolving
+            # the candidate day's civil date.
+            probe = time.mktime(
+                (base_tm.tm_year, base_tm.tm_mon, base_tm.tm_mday + d,
+                 12, 0, 0, 0, 0, -1)
+            )
+            ptm = time.localtime(probe)
+            if ptm.tm_mon not in self.month or not self._day_matches(ptm):
+                continue
+            # Try BOTH isdst hints and keep the earliest valid epoch: on
+            # the fall-back day a wall time inside the repeated hour has
+            # two epochs, and isdst=-1 would pick the later (standard-
+            # time) one — firing an hour late. matches() re-guards each
+            # candidate, so a spring-forward-skipped or hint-shifted wall
+            # clock outside the sets is dropped.
+            best: float | None = None
+            for h in hours:
+                for m in minutes:
+                    for isdst in (1, 0):
+                        cand = time.mktime(
+                            (ptm.tm_year, ptm.tm_mon, ptm.tm_mday, h, m,
+                             0, 0, 0, isdst)
+                        )
+                        if cand > t and self.matches(cand):
+                            if best is None or cand < best:
+                                best = cand
+            if best is not None:
+                return float(best)
         raise ValueError("no matching time within the horizon")
 
 

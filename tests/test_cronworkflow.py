@@ -243,6 +243,37 @@ def test_spawned_workflow_actually_runs(tmp_path):
     assert wf.status["phase"] == "Succeeded", wf.status
 
 
+def test_dom_dow_both_restricted_is_vixie_or():
+    """'0 0 1,15 * 1' fires on the 1st, the 15th, AND every Monday
+    (standard Vixie/Argo semantics: when both day fields are restricted,
+    a match on either is a day match)."""
+    import time as _time
+
+    s = CronSchedule.parse("0 0 1,15 * 1")
+    wed_first = _time.mktime((2026, 7, 1, 0, 0, 0, 0, 0, -1))  # Wed Jul 1
+    monday = _time.mktime((2026, 7, 6, 0, 0, 0, 0, 0, -1))  # Mon Jul 6
+    tue_20 = _time.mktime((2026, 7, 21, 0, 0, 0, 0, 0, -1))  # Tue Jul 21
+    assert s.matches(wed_first)
+    assert s.matches(monday)
+    assert not s.matches(tue_20)
+    # With dom='*', the classic AND applies: Mondays only.
+    weekly = CronSchedule.parse("0 0 * * 1")
+    assert weekly.matches(monday) and not weekly.matches(wed_first)
+
+
+def test_next_after_sparse_schedule_is_cheap():
+    """'0 0 29 2 *' (every 4th year) must resolve by day arithmetic, not
+    a multi-million minute scan — reconciles call next_after every pass."""
+    import time as _time
+
+    s = CronSchedule.parse("0 0 29 2 *")
+    start = _time.perf_counter()
+    nxt = s.next_after(T0)
+    assert _time.perf_counter() - start < 0.5
+    tm = _time.localtime(nxt)
+    assert (tm.tm_mon, tm.tm_mday, tm.tm_hour, tm.tm_min) == (2, 29, 0, 0)
+
+
 def test_dow_seven_is_sunday():
     assert CronSchedule.parse("0 6 * * 7").dow == frozenset({0})
     assert CronSchedule.parse("0 6 * * 0,7").dow == frozenset({0})
@@ -278,3 +309,37 @@ def test_spawn_adopts_existing_run_after_crash():
     ctl.controller.enqueue(("ci", "nightly"))
     ctl.controller.run_until_idle()  # must not raise / hot-loop
     assert len(spawned(api)) == 1
+
+
+def test_next_after_dst_edges_match_minute_scan():
+    """Fall-back (ambiguous wall time → FIRST epoch) and spring-forward
+    (skipped wall time → next real occurrence) agree with a brute-force
+    minute scan."""
+    import os
+    import time as _time
+
+    if not hasattr(_time, "tzset"):
+        pytest.skip("no tzset on this platform")
+    old = os.environ.get("TZ")
+    os.environ["TZ"] = "America/New_York"
+    _time.tzset()
+    try:
+        def brute(s, t):
+            base = int(t // 60) * 60
+            return float(next(
+                base + i * 60 for i in range(1, 200_000)
+                if s.matches(base + i * 60)
+            ))
+
+        fall = CronSchedule.parse("30 1 * * *")
+        t1 = _time.mktime((2026, 10, 31, 23, 0, 0, 0, 0, -1))
+        assert fall.next_after(t1) == brute(fall, t1)
+        spring = CronSchedule.parse("30 2 * * *")
+        t2 = _time.mktime((2026, 3, 7, 23, 0, 0, 0, 0, -1))
+        assert spring.next_after(t2) == brute(spring, t2)
+    finally:
+        if old is None:
+            os.environ.pop("TZ", None)
+        else:
+            os.environ["TZ"] = old
+        _time.tzset()
