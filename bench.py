@@ -211,8 +211,11 @@ def bench_lm(args) -> None:
     # Model MFU (MaxText-style accounting): 6 FLOPs per param per token
     # over the matmul params (embedding lookup is free; the tied head's
     # 6*d*V is counted once via the embedding entry below) plus
-    # 12*S*d_attn per layer per token for the S x S attention —
-    # recompute from remat is NOT counted (that's the point of MFU).
+    # 6*S*d_attn per layer per token for CAUSAL attention — the model is
+    # causal and the flash kernel computes only the lower triangle, so
+    # counting the full S x S cost (12*S*d_attn) would overstate MFU by
+    # the attention share. Recompute from remat is NOT counted (that's
+    # the point of MFU).
     d_attn = cfg.n_heads * cfg.head_dim
     layer_params = cfg.n_layers * (
         4 * cfg.d_model * d_attn + 3 * cfg.d_model * cfg.d_ff
@@ -220,7 +223,7 @@ def bench_lm(args) -> None:
     head_params = cfg.vocab_size * cfg.d_model  # tied head matmul
     flops_per_token = (
         6 * (layer_params + head_params)
-        + 12 * cfg.n_layers * args.seq_len * d_attn
+        + 6 * cfg.n_layers * args.seq_len * d_attn
     )
     V5E_PEAK_BF16 = 197e12
     mfu = per_chip * flops_per_token / V5E_PEAK_BF16

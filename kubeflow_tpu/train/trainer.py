@@ -65,6 +65,17 @@ class TrainConfig:
     # "loss" returns the objective only. Eval always computes both.
     train_metrics: str = "full"
 
+    def __post_init__(self) -> None:
+        # A typo ("Full", "all") would silently behave as "loss" and drop
+        # per-step accuracy; fail loudly instead.
+        if self.train_metrics not in ("full", "loss"):
+            raise ValueError(
+                f"train_metrics must be 'full' or 'loss', got "
+                f"{self.train_metrics!r}"
+            )
+        if self.optimizer not in ("sgd", "adamw"):
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+
 
 def decay_mask(params) -> Any:
     """Weight decay applies to matrices/filters only — never to the 1-D
