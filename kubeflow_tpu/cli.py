@@ -86,8 +86,8 @@ def cmd_get(client: HttpApiClient, args) -> int:
     if args.watch:
         return _watch_kind(client, kind, args)
     if args.name:
-        res = client.get(kind, args.name, args.namespace or "default",
-                         version=args.api_version)
+        res = _get_scoped(client, kind, args.name, args.namespace,
+                          version=args.api_version)
         _emit(res.to_dict(), args.output or "yaml")
         return 0
     # Lists default to ALL namespaces (the table shows the namespace
@@ -174,6 +174,101 @@ def _watch_kind(client: HttpApiClient, kind: str, args) -> int:
                                res.metadata.name, _phase(res)),
                     flush=True,
                 )
+
+
+def _get_scoped(client: HttpApiClient, kind, name, namespace, version=None):
+    """Fetch honoring scope: an explicit -n (including -n '') is taken
+    verbatim; with no -n, try the default namespace then fall back to
+    cluster scope, so `describe node tpu-node-0` works without the user
+    spelling the empty namespace (kubectl ignores -n for cluster-scoped
+    kinds; we have no client-side kind registry to know scope upfront)."""
+    from kubeflow_tpu.testing.fake_apiserver import NotFound
+
+    if namespace is not None:
+        return client.get(kind, name, namespace, version=version)
+    try:
+        return client.get(kind, name, "default", version=version)
+    except NotFound:
+        return client.get(kind, name, "", version=version)
+
+
+def cmd_describe(client: HttpApiClient, args) -> int:
+    """kubectl-describe analog: the object, its conditions, and its
+    mirrored Event timeline in one view (controllers emit Events the way
+    `notebook_controller.go:87-103` mirrors them; the store keeps them as
+    Event objects with spec.involvedObject back-references)."""
+    kind = resolve_kind(args.kind)
+    res = _get_scoped(client, kind, args.name, args.namespace)
+    ns = res.metadata.namespace
+    meta = res.metadata
+
+    def emit_block(title: str, payload: dict) -> None:
+        if not payload:
+            return
+        print(f"{title}:")
+        text = yaml.safe_dump(payload, sort_keys=False, default_flow_style=False)
+        for line in text.rstrip("\n").split("\n"):
+            print(f"  {line}")
+
+    print(f"Name:         {meta.name}")
+    print(f"Namespace:    {meta.namespace}")
+    print(f"Kind:         {res.kind}")
+    print(f"API Version:  {res.api_version}")
+    if meta.labels:
+        print("Labels:       " + ",".join(
+            f"{k}={v}" for k, v in sorted(meta.labels.items())
+        ))
+    if meta.creation_timestamp is not None:
+        import datetime
+
+        created = datetime.datetime.fromtimestamp(
+            meta.creation_timestamp, datetime.timezone.utc
+        )
+        print(f"Created:      {created.strftime('%Y-%m-%dT%H:%M:%SZ')}")
+    emit_block("Spec", res.spec or {})
+    status = dict(res.status or {})
+    conditions = status.pop("conditions", None)
+    emit_block("Status", status)
+    if conditions:
+        print("Conditions:")
+        widths = (24, 8)
+        print(f"  {'Type':<{widths[0]}}{'Status':<{widths[1]}}Reason")
+        for c in conditions:
+            print(
+                f"  {str(c.get('type', '')):<{widths[0]}}"
+                f"{str(c.get('status', 'True')):<{widths[1]}}"
+                f"{c.get('reason', '')}"
+            )
+
+    events = [
+        e for e in client.list("Event", namespace=ns)
+        if e.spec.get("involvedObject", {}).get("name") == meta.name
+        and e.spec.get("involvedObject", {}).get("kind") == res.kind
+        and (
+            not e.spec["involvedObject"].get("uid")
+            or not meta.uid
+            or e.spec["involvedObject"]["uid"] == meta.uid
+        )
+    ]
+    events.sort(key=lambda e: e.metadata.creation_timestamp or 0)
+    print("Events:")
+    if not events:
+        print("  <none>")
+        return 0
+    rows = [
+        (
+            e.spec.get("type", "Normal"),
+            e.spec.get("reason", ""),
+            e.spec.get("message", ""),
+        )
+        for e in events
+    ]
+    w0 = max(len("Type"), max(len(r[0]) for r in rows))
+    w1 = max(len("Reason"), max(len(r[1]) for r in rows))
+    print(f"  {'Type':<{w0}}  {'Reason':<{w1}}  Message")
+    for t, r, m in rows:
+        print(f"  {t:<{w0}}  {r:<{w1}}  {m}")
+    return 0
 
 
 def cmd_apply(client: HttpApiClient, args) -> int:
@@ -294,6 +389,15 @@ def main(argv: list[str] | None = None) -> int:
                      help="print the table, then stream change events "
                      "(kubectl get -w analog; Ctrl-C to stop)")
     get.set_defaults(fn=cmd_get)
+
+    describe = sub.add_parser(
+        "describe",
+        help="object + conditions + events timeline (kubectl describe)",
+    )
+    describe.add_argument("kind")
+    describe.add_argument("name")
+    describe.add_argument("-n", "--namespace", default=None)
+    describe.set_defaults(fn=cmd_describe)
 
     apply_p = sub.add_parser("apply", help="create-or-update from YAML")
     apply_p.add_argument("-f", "--filename", required=True,
