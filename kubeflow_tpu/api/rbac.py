@@ -26,14 +26,29 @@ VIEW_ROLE = "kubeflow-view"
 _VIEW_VERBS = ["get", "list", "watch"]
 _EDIT_VERBS = _VIEW_VERBS + ["create", "update", "patch", "delete"]
 
+# Privilege-escalation guard: a `resources: ["*"]` wildcard never matches
+# the RBAC objects themselves — for ANY verb, reads included; access to
+# them must be granted by NAME. Writes are the actual escalation vector
+# (an edit-bound identity POSTing a ClusterRoleBinding onto
+# cluster-admin); reads are excluded too because the real K8s built-in
+# view/edit roles enumerate resources and omit RBAC kinds entirely, and
+# policy objects shouldn't leak to every wildcard reader.
+RBAC_RESOURCES = frozenset(
+    {"roles", "rolebindings", "clusterroles", "clusterrolebindings"}
+)
+
 
 def seed_cluster_roles(api: FakeApiServer) -> None:
     """Install the platform ClusterRoles the controllers bind against
     (the reference ships these as kustomize RBAC manifests under
     `*/config/rbac/`; profile-controller binds `kubeflow-admin` at
-    `profile_controller.go:218-239`)."""
+    `profile_controller.go:218-239`). Only admin carries the explicit
+    RBAC-resource rule (see RBAC_RESOURCES)."""
     roles = [
-        (CLUSTER_ADMIN_ROLE, [{"verbs": ["*"], "resources": ["*"]}]),
+        (CLUSTER_ADMIN_ROLE, [
+            {"verbs": ["*"], "resources": ["*"]},
+            {"verbs": ["*"], "resources": sorted(RBAC_RESOURCES)},
+        ]),
         (EDIT_ROLE, [{"verbs": _EDIT_VERBS, "resources": ["*"]}]),
         (VIEW_ROLE, [{"verbs": _VIEW_VERBS, "resources": ["*"]}]),
     ]
@@ -44,6 +59,26 @@ def seed_cluster_roles(api: FakeApiServer) -> None:
             api.create(
                 new_resource("ClusterRole", name, "", spec={"rules": rules})
             )
+
+
+def resource_for_kind(kind: str) -> str:
+    """The RBAC resource string for a stored kind — lowercase plural, the
+    way the reference's rules name resources (`notebooks`, `profiles`;
+    e.g. `notebook-controller/config/rbac/role.yaml`). English
+    pluralization: consonant+y → ies (`Study` → `studies`), vowel+y → +s
+    (`Gateway` → `gateways`), trailing s → +es."""
+    lower = kind.lower()
+    if lower.endswith("y") and lower[-2:-1] not in "aeiou":
+        return lower[:-1] + "ies"
+    if lower.endswith("s"):
+        return lower + "es"
+    return lower + "s"
+
+
+def make_cluster_role(name: str, rules: list[dict]) -> Resource:
+    """A ClusterRole from raw rules (`{"verbs": [...], "resources":
+    [...]}` — the shape `seed_cluster_roles` installs)."""
+    return new_resource("ClusterRole", name, "", spec={"rules": rules})
 
 
 def make_cluster_role_binding(name: str, role: str, user: str) -> Resource:
@@ -61,9 +96,15 @@ def make_cluster_role_binding(name: str, role: str, user: str) -> Resource:
 def _rule_allows(rule: dict, verb: str, resource: str) -> bool:
     verbs = rule.get("verbs", [])
     resources = rule.get("resources", [])
-    return ("*" in verbs or verb in verbs) and (
-        "*" in resources or resource in resources
-    )
+    if "*" not in verbs and verb not in verbs:
+        return False
+    if resource in resources:
+        return True
+    # The wildcard does not reach RBAC objects (escalation guard) —
+    # matched on the BASE resource so subresources (clusterroles/status)
+    # don't slip through.
+    base = resource.split("/", 1)[0]
+    return "*" in resources and base not in RBAC_RESOURCES
 
 
 def _role_allows(role: Resource | None, verb: str, resource: str) -> bool:

@@ -1,0 +1,85 @@
+"""Bearer-token identities for the apiserver facade.
+
+The reference never exposes an open apiserver: controllers authenticate
+with serviceaccount tokens via client-go/kubeconfig, web backends do
+per-request SubjectAccessReview (`crud_backend/authz.py:46-80`), and even
+controller `/metrics` sits behind kube-rbac-proxy
+(`notebook-controller/config/default/manager_auth_proxy_patch.yaml`).
+This module is the token side of that trust model: a registry mapping
+opaque bearer tokens onto user identities, with the kube-apiserver
+`--token-auth-file` persistence format (`token,user` CSV lines) so
+separate processes — e2e workers, out-of-process controllers, the CLI —
+can be handed least-privilege credentials through a file or env var.
+
+Authorization stays in `api/rbac.py` (SubjectAccessReview over the
+stored (Cluster)Roles/Bindings); this module only answers "who is
+calling?".
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+
+
+def service_account(namespace: str, name: str) -> str:
+    """The K8s serviceaccount username convention
+    (`system:serviceaccount:<ns>:<name>`) — what RBAC subjects name."""
+    return f"system:serviceaccount:{namespace}:{name}"
+
+
+class TokenRegistry:
+    """token → user identity map (the serviceaccount-token analog)."""
+
+    def __init__(self) -> None:
+        self._tokens: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def issue(self, user: str) -> str:
+        """Mint a fresh opaque token for `user` and return it."""
+        token = secrets.token_urlsafe(24)
+        with self._lock:
+            self._tokens[token] = user
+        return token
+
+    def add(self, token: str, user: str) -> None:
+        """Register a caller-chosen token (static-token-file entries)."""
+        with self._lock:
+            self._tokens[token] = user
+
+    def revoke(self, token: str) -> None:
+        with self._lock:
+            self._tokens.pop(token, None)
+
+    def authenticate(self, token: str) -> str | None:
+        """The identity behind `token`, or None for an unknown token."""
+        with self._lock:
+            return self._tokens.get(token)
+
+    # -- persistence (kube-apiserver --token-auth-file format) -------------
+
+    def save(self, path: str) -> None:
+        import os
+
+        with self._lock:
+            lines = [f"{t},{u}\n" for t, u in sorted(self._tokens.items())]
+        # Credentials: owner-only, like kube-apiserver expects of its
+        # token-auth file. fchmod as well as the create mode — O_CREAT's
+        # mode argument is ignored when the file already exists.
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        os.fchmod(fd, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.writelines(lines)
+
+    @classmethod
+    def load(cls, path: str) -> "TokenRegistry":
+        reg = cls()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                token, _, user = line.partition(",")
+                if token and user:
+                    reg.add(token, user)
+        return reg

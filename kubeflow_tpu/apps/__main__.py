@@ -20,8 +20,11 @@ import tempfile
 import threading
 import time
 
+import os
+
 from kubeflow_tpu.api.objects import new_resource
 from kubeflow_tpu.api.rbac import make_cluster_role_binding, seed_cluster_roles
+from kubeflow_tpu.api.tokens import TokenRegistry
 from kubeflow_tpu.apps.dashboard import DashboardApp
 from kubeflow_tpu.apps.jupyter import JupyterApp
 from kubeflow_tpu.apps.kfam import KfamApp
@@ -55,6 +58,13 @@ def main() -> None:
     )
     parser.add_argument(
         "--admin", default=None, help="grant this user cluster-admin"
+    )
+    parser.add_argument(
+        "--insecure-apiserver",
+        action="store_true",
+        help="serve the facade without bearer-token auth (dev only; the "
+        "kube-apiserver insecure-port analog). Default: secure — an "
+        "admin token is minted, printed, and saved to a token file",
     )
     parser.add_argument(
         "--nodes",
@@ -138,6 +148,27 @@ def main() -> None:
     threading.Thread(target=_run_pods, name="pod-runner", daemon=True).start()
 
     authn = HeaderAuthn(anonymous=args.anonymous)
+    # Facade auth: mint a cluster-admin identity + token and persist the
+    # token file (kube-apiserver --token-auth-file analog) so the CLI can
+    # be pointed at it: `--token $(cut -d, -f1 <file>)` or KFTPU_TOKEN.
+    tokens = None
+    if not args.insecure_apiserver:
+        tokens = TokenRegistry()
+        admin_token = tokens.issue("system:admin")
+        api.create(
+            make_cluster_role_binding(
+                "system-admin", "kubeflow-admin", "system:admin"
+            )
+        )
+        # NOT under log_dir: that directory is the facade's pod-log
+        # containment root, and status.logPath is client-writable — a
+        # secret inside it would be readable via GET .../log.
+        token_dir = tempfile.mkdtemp(prefix="kftpu-apiserver-")
+        atexit.register(shutil.rmtree, token_dir, True)
+        token_file = os.path.join(token_dir, "tokens")
+        tokens.save(token_file)
+        print(f"apiserver admin token: {admin_token}")
+        print(f"apiserver token file:  {token_file}")
     apps = [
         DashboardApp(api, authn=authn),
         KfamApp(api, authn=authn),
@@ -145,9 +176,9 @@ def main() -> None:
         TensorboardsApp(api, authn=authn),
         # The raw apiserver facade (base+4): the kubectl-analog CLI's
         # target (`python -m kubeflow_tpu.cli --server ...`) and the
-        # /debug/traces drain. In-cluster trust domain — local use only.
+        # /debug/traces drain. Secure by default (bearer tokens + RBAC);
         # log_root gates /log serving to the runner's capture dir.
-        ApiServerApp(api, log_root=log_dir),
+        ApiServerApp(api, log_root=log_dir, tokens=tokens),
     ]
     servers = []
     for offset, app in enumerate(apps):

@@ -373,6 +373,12 @@ def main(argv: list[str] | None = None) -> int:
         default=os.environ.get("KFTPU_SERVER", DEFAULT_SERVER),
         help="apiserver facade URL (env KFTPU_SERVER)",
     )
+    parser.add_argument(
+        "--token",
+        default=None,
+        help="bearer token for a secure facade (env KFTPU_TOKEN; the "
+        "platform launcher prints/saves an admin token at boot)",
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     get = sub.add_parser("get", help="list a kind or fetch one object")
@@ -424,9 +430,12 @@ def main(argv: list[str] | None = None) -> int:
     traces.set_defaults(fn=cmd_traces)
 
     args = parser.parse_args(argv)
-    client = HttpApiClient(args.server)
+    client = HttpApiClient(args.server, token=args.token)
     try:
         return args.fn(client, args)
+    except PermissionError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     except ApiError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -41,12 +41,17 @@ import urllib.request
 import logging
 import threading
 
+import os
+
 from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid
+from kubeflow_tpu.api.rbac import resource_for_kind, subject_access_review
+from kubeflow_tpu.api.tokens import TokenRegistry
 from kubeflow_tpu.utils import tracing
 from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
     Conflict,
     FakeApiServer,
+    Forbidden,
     Gone,
     Invalid,
     NotFound,
@@ -66,13 +71,34 @@ def _seg_ns(seg: str) -> str:
 
 
 class ApiServerApp(App):
-    """REST facade. Unauthenticated — this is the in-cluster trust domain
-    (the reference controllers talk to the apiserver with pod
-    serviceaccounts; web-tier authn/authz stays in the web apps)."""
+    """REST facade.
 
-    def __init__(self, api: FakeApiServer, log_root: str | None = None):
+    With `tokens`, every request must carry `Authorization: Bearer
+    <token>` naming a registered identity, and every operation is gated
+    by a SubjectAccessReview over the stored RBAC objects — the trust
+    model the reference runs under (controllers authenticate with pod
+    serviceaccount tokens, `notebook_controller.go:516` manager config;
+    web backends SAR every request, `crud_backend/authz.py:46-80`; even
+    /metrics sits behind kube-rbac-proxy,
+    `notebook-controller/config/default/manager_auth_proxy_patch.yaml`).
+    Status is a distinct RBAC subresource (`<resource>/status`), so only
+    the owning runtime identity can be granted status writes.
+
+    Without `tokens` the facade is open — the in-process test seam only
+    (the kube-apiserver insecure-localhost-port analog); the platform
+    launcher and e2e harnesses always pass a registry."""
+
+    def __init__(
+        self,
+        api: FakeApiServer,
+        log_root: str | None = None,
+        tokens: TokenRegistry | None = None,
+    ):
         super().__init__("apiserver")
         self.api = api
+        self.tokens = tokens
+        if tokens is not None:
+            self.before_request(self._authenticate)
         # Containment root for /log: only files under the runner's
         # capture dir are served. status is client-writable, so serving
         # status.logPath unconstrained would be an arbitrary-file-read
@@ -97,9 +123,70 @@ class ApiServerApp(App):
         # stand-in): returns and clears all finished spans.
         self.add_route("/debug/traces", self.drain_traces)
 
+    # -- authn/authz -------------------------------------------------------
+
+    def _authenticate(self, req: Request) -> Response | None:
+        """Before-request hook (secure mode): resolve the bearer token to
+        an identity or 401. /healthz stays open for probes."""
+        if req.path == "/healthz":
+            return None
+        header = req.headers.get("authorization", "")
+        scheme, _, token = header.partition(" ")
+        user = (
+            self.tokens.authenticate(token.strip())
+            if scheme.lower() == "bearer" and token.strip()
+            else None
+        )
+        if user is None:
+            from kubeflow_tpu.web.wsgi import error_response
+
+            return error_response(
+                401,
+                "no valid bearer token (secure facade: every request "
+                "needs 'Authorization: Bearer <token>')",
+            )
+        req.user = user
+        return None
+
+    def _authorize(
+        self, req: Request, verb: str, resource: str, namespace: str
+    ) -> None:
+        """SAR gate for one operation; no-op in open mode. 403 carries the
+        crud_backend-style readable denial (`authz.py:46-80`)."""
+        if self.tokens is None:
+            return
+        if not subject_access_review(
+            self.api, req.user, verb, resource, namespace
+        ):
+            scope = (
+                f"in namespace {namespace!r}" if namespace else "cluster-wide"
+            )
+            raise HttpError(
+                403,
+                f"user {req.user!r} is not allowed to {verb} {resource} "
+                f"{scope}",
+            )
+
+    def _may_watch(self, user: str, obj: Resource, cache: dict) -> bool:
+        """Per-event watch filter for the multiplexed `_` stream: deliver
+        only objects whose (kind, namespace) the identity may watch, so a
+        least-privilege controller can hold one stream without cluster-wide
+        read (the apiserver's per-resource watch authorization, folded
+        into our single-stream transport)."""
+        key = (obj.kind, obj.metadata.namespace or "")
+        if key not in cache:
+            cache[key] = subject_access_review(
+                self.api, user, "watch", resource_for_kind(obj.kind), key[1]
+            )
+        return cache[key]
+
     def drain_traces(self, req: Request) -> Response:
         from kubeflow_tpu.utils import tracing
 
+        # Draining is destructive (export clears the buffer): gate it
+        # behind the write verb so a view-bound identity can't wipe the
+        # shared tracer.
+        self._authorize(req, "delete", "traces", "")
         return json_response(
             {
                 "spans": tracing.tracer.export(),
@@ -118,6 +205,12 @@ class ApiServerApp(App):
                 if "=" in part
             )
         namespace = req.query.get("namespace")
+        self._authorize(
+            req,
+            "list",
+            resource_for_kind(req.path_params["kind"]),
+            _seg_ns(namespace) if namespace is not None else "",
+        )
         # The list's rv is the watch bookmark (informer list-then-watch).
         # Read it BEFORE listing: an object committed between the two
         # reads is then re-delivered by the watch (at-least-once), whereas
@@ -148,6 +241,15 @@ class ApiServerApp(App):
         timeout = min(float(req.query.get("timeoutSeconds", "10")), 60.0)
         kind = req.path_params["kind"]
         namespace = req.query.get("namespace")
+        if kind != "_":
+            # Concrete-kind stream: authorize eagerly (403 beats silently
+            # delivering nothing). The `_` stream filters per event below.
+            self._authorize(
+                req,
+                "watch",
+                resource_for_kind(kind),
+                _seg_ns(namespace) if namespace is not None else "",
+            )
         try:
             events, rv = self.api.wait_events(
                 since,
@@ -157,6 +259,13 @@ class ApiServerApp(App):
             )
         except Gone as e:
             raise HttpError(410, str(e))
+        if self.tokens is not None and kind == "_":
+            cache: dict = {}
+            events = [
+                (ev_rv, ev, obj)
+                for ev_rv, ev, obj in events
+                if self._may_watch(req.user, obj, cache)
+            ]
         return json_response(
             {
                 "events": [
@@ -176,6 +285,12 @@ class ApiServerApp(App):
         return self.api.convert_to(obj, version)
 
     def get(self, req: Request) -> Response:
+        self._authorize(
+            req,
+            "get",
+            resource_for_kind(req.path_params["kind"]),
+            _seg_ns(req.path_params["ns"]),
+        )
         obj = self.api.get(
             req.path_params["kind"],
             req.path_params["name"],
@@ -187,11 +302,31 @@ class ApiServerApp(App):
         obj = Resource.from_dict(req.json())
         if obj.kind != req.path_params["kind"]:
             raise HttpError(400, "kind mismatch between path and body")
+        resource = resource_for_kind(obj.kind)
+        namespace = obj.metadata.namespace or ""
+        if self.tokens is not None and obj.status:
+            # Status-subresource integrity on create: a body arriving with
+            # status would otherwise persist it (the store honors it;
+            # update() already doesn't), letting a create-only identity
+            # forge e.g. phase=Succeeded. Like the real apiserver we drop
+            # it — unless the identity holds the status grant anyway, so
+            # runtimes that materialize already-Running objects (the
+            # WorkloadMaterializer pattern) keep working remotely.
+            if not subject_access_review(
+                self.api, req.user, "update", resource + "/status", namespace
+            ):
+                obj.status = {}
         if req.query.get("apply") in ("true", "1"):
+            # Server-side apply is create-or-update: the identity needs
+            # both (the reference's SSA patch demands `patch`; our edit
+            # role carries create+update+patch together).
+            self._authorize(req, "create", resource, namespace)
+            self._authorize(req, "update", resource, namespace)
             # Server-side apply: create-or-update with the store's own
             # no-op detection (post-admission, post-conversion compare) so
             # remote reconcilers don't re-trigger their own watches.
             return json_response(self.api.apply(obj).to_dict())
+        self._authorize(req, "create", resource, namespace)
         return json_response(self.api.create(obj).to_dict(), status=201)
 
     def _body_matching_path(self, req: Request) -> Resource:
@@ -207,16 +342,38 @@ class ApiServerApp(App):
         return obj
 
     def update(self, req: Request) -> Response:
+        self._authorize(
+            req,
+            "update",
+            resource_for_kind(req.path_params["kind"]),
+            _seg_ns(req.path_params["ns"]),
+        )
         return json_response(
             self.api.update(self._body_matching_path(req)).to_dict()
         )
 
     def update_status(self, req: Request) -> Response:
+        # Distinct subresource: granting `tpujobs` update does NOT grant
+        # `tpujobs/status` — only the owning runtime identity's role
+        # carries the status rule (the reference's controllers get
+        # `.../status` verbs in their RBAC manifests; web apps never do).
+        self._authorize(
+            req,
+            "update",
+            resource_for_kind(req.path_params["kind"]) + "/status",
+            _seg_ns(req.path_params["ns"]),
+        )
         return json_response(
             self.api.update_status(self._body_matching_path(req)).to_dict()
         )
 
     def delete(self, req: Request) -> Response:
+        self._authorize(
+            req,
+            "delete",
+            resource_for_kind(req.path_params["kind"]),
+            _seg_ns(req.path_params["ns"]),
+        )
         self.api.delete(
             req.path_params["kind"],
             req.path_params["name"],
@@ -227,6 +384,10 @@ class ApiServerApp(App):
     def pod_log(self, req: Request) -> Response:
         import pathlib
 
+        # The kubelet log endpoint's RBAC resource (`pods/log`, verb get).
+        self._authorize(
+            req, "get", "pods/log", _seg_ns(req.path_params["ns"])
+        )
         if self.log_root is None:
             raise HttpError(
                 404, "log serving not configured (no capture directory)"
@@ -271,8 +432,15 @@ class HttpApiClient:
         timeout: float = 10.0,
         watch_poll_timeout: float = 5.0,
         watch_retry: float = 0.5,
+        token: str | None = None,
     ):
         self.base_url = base_url.rstrip("/")
+        # The identity credential (serviceaccount-token analog). Falls
+        # back to KFTPU_TOKEN so gang workers spawned with the launcher
+        # env contract inherit their pod's credential without plumbing.
+        self.token = token if token is not None else os.environ.get(
+            "KFTPU_TOKEN"
+        )
         self.timeout = timeout
         self.watch_poll_timeout = watch_poll_timeout
         self.watch_retry = watch_retry
@@ -290,6 +458,7 @@ class HttpApiClient:
             # apiserver calls land in the same trace (`utils.tracing`).
             headers={
                 "Content-Type": "application/json",
+                **self._auth_header(),
                 **tracing.trace_header(),
             },
         )
@@ -298,6 +467,8 @@ class HttpApiClient:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
+            if e.code in (401, 403):
+                raise Forbidden(detail)
             if e.code == 404:
                 raise NotFound(detail)
             if e.code == 409:
@@ -377,7 +548,7 @@ class HttpApiClient:
         error mapping as every other call)."""
         req = urllib.request.Request(
             f"{self.base_url}/apis/Pod/{_ns_seg(namespace)}/{name}/log",
-            headers=tracing.trace_header(),
+            headers={**self._auth_header(), **tracing.trace_header()},
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -388,9 +559,16 @@ class HttpApiClient:
                 detail = json.loads(detail).get("log", detail)
             except ValueError:
                 pass
+            if e.code in (401, 403):
+                raise Forbidden(detail)
             if e.code == 404:
                 raise NotFound(detail)
             raise
+
+    def _auth_header(self) -> dict[str, str]:
+        return (
+            {"Authorization": f"Bearer {self.token}"} if self.token else {}
+        )
 
     def apply(self, obj: Resource) -> Resource:
         """Create-or-update, evaluated server-side (the store's compare is
@@ -509,6 +687,16 @@ class HttpApiClient:
                 data = self._call("GET", f"/apis/_?{params}")
             except Gone:
                 rv = None  # journal horizon passed us — relist
+                continue
+            except PermissionError as e:
+                if self._closed.is_set():
+                    return
+                # Not a network blip: a missing/revoked/under-privileged
+                # token will never heal by hot-retrying. Surface loudly
+                # and back off hard (the operator may re-grant RBAC, so
+                # the stream stays up rather than dying silently).
+                log.error("watch stream unauthorized (%s); backing off", e)
+                self._closed.wait(max(self.watch_retry, 5.0))
                 continue
             except Exception:
                 if self._closed.is_set():

@@ -51,6 +51,12 @@ class Invalid(ApiError):
     pass
 
 
+class Forbidden(ApiError, PermissionError):
+    """A 401/403 from the secure facade. ApiError so per-object error
+    handling (e.g. the CLI's multi-doc apply) reports it and continues;
+    PermissionError so callers can treat auth failures as a class."""
+
+
 class Gone(ApiError):
     """The requested resourceVersion predates the journal's oldest entry
     (the real apiserver's HTTP 410 on an expired watch bookmark). Clients

@@ -64,17 +64,36 @@ def test_distributed_training_end_to_end(tmp_path):
     mesh (gloo collectives), and rank 0's reported observation flows back
     onto the job — training results, not just liveness, cross the
     process boundary."""
+    from kubeflow_tpu.api.rbac import (
+        make_cluster_role,
+        make_cluster_role_binding,
+    )
+    from kubeflow_tpu.api.tokens import TokenRegistry, service_account
     from kubeflow_tpu.testing.apiserver_http import ApiServerApp
     from kubeflow_tpu.web.wsgi import serve
 
     api = FakeApiServer()
-    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    # Secure facade: rank 0 reports its observation with a least-privilege
+    # worker token (read the job + write its status — nothing else).
+    tokens = TokenRegistry()
+    worker_user = service_account("default", "train-worker")
+    api.create(make_cluster_role("train-worker", [
+        {"verbs": ["get"], "resources": ["tpujobs"]},
+        {"verbs": ["update"], "resources": ["tpujobs/status"]},
+    ]))
+    api.create(
+        make_cluster_role_binding("train-worker", "train-worker", worker_user)
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+    )
     ctl = TpuJobController(api)
     runner = LocalPodRunner(
         api,
         extra_env={
             "KFTPU_REPO": REPO,
             "KFTPU_APISERVER": f"http://127.0.0.1:{server.server_port}",
+            "KFTPU_TOKEN": tokens.issue(worker_user),
         },
         capture_dir=str(tmp_path / "logs"),
     )

@@ -14,11 +14,16 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 from kubeflow_tpu.api import make_tpujob
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import make_cluster_role, make_cluster_role_binding
+from kubeflow_tpu.api.tokens import TokenRegistry, service_account
 from kubeflow_tpu.api.tpujob import KIND
 from kubeflow_tpu.runtime import LocalPodRunner
 from kubeflow_tpu.testing import FakeApiServer
-from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
 from kubeflow_tpu.web.wsgi import serve
 
 REPO = os.path.dirname(
@@ -28,10 +33,39 @@ CONTROLLER = os.path.join(REPO, "tests", "e2e", "controller_worker.py")
 GANG_WORKER = os.path.join(REPO, "tests", "e2e", "gang_worker.py")
 
 
+# Exactly what TpuJobController's reconcile touches — nothing more (the
+# least-privilege RBAC the reference grants its controllers via
+# `config/rbac/role.yaml` manifests; status is a distinct subresource).
+CONTROLLER_RULES = [
+    {"verbs": ["get", "list", "watch"], "resources": ["tpujobs"]},
+    {"verbs": ["update"], "resources": ["tpujobs/status"]},
+    {"verbs": ["get", "list", "watch", "create", "delete"],
+     "resources": ["pods"]},
+    {"verbs": ["get", "list", "watch", "create"], "resources": ["services"]},
+    {"verbs": ["list"], "resources": ["nodes"]},
+    {"verbs": ["create"], "resources": ["events"]},
+]
+
+
 def test_out_of_process_controller_runs_gang(tmp_path):
     api = FakeApiServer()
-    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    tokens = TokenRegistry()
+    ctl_user = service_account("kubeflow", "tpujob-controller")
+    api.create(make_cluster_role("tpujob-controller", CONTROLLER_RULES))
+    api.create(
+        make_cluster_role_binding("tpujob-controller", "tpujob-controller",
+                                  ctl_user)
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+    )
     base_url = f"http://127.0.0.1:{server.server_port}"
+
+    # The secure boundary actually holds: no token → no write.
+    with pytest.raises(PermissionError):
+        HttpApiClient(base_url, token="").create(
+            new_resource("ConfigMap", "x", "default", spec={})
+        )
 
     proc = subprocess.Popen(
         [sys.executable, CONTROLLER],
@@ -39,6 +73,9 @@ def test_out_of_process_controller_runs_gang(tmp_path):
             **os.environ,
             "KFTPU_REPO": REPO,
             "KFTPU_APISERVER": base_url,
+            # Least-privilege credential: the controller runs with its own
+            # serviceaccount token, not cluster-admin.
+            "KFTPU_TOKEN": tokens.issue(ctl_user),
         },
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,

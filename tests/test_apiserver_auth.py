@@ -1,0 +1,323 @@
+"""Facade authentication + authorization.
+
+The reference never exposes an open apiserver: controllers carry
+serviceaccount tokens, web backends SubjectAccessReview every request
+(`crud_backend/authz.py:46-80`), and /metrics sits behind kube-rbac-proxy
+(`notebook-controller/config/default/manager_auth_proxy_patch.yaml`).
+These tests pin the same boundary on `ApiServerApp(tokens=...)`: no
+token → 401, token without RBAC → 403, status is a distinct subresource,
+and the multiplexed watch stream only delivers what the identity may
+watch.
+"""
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import (
+    make_cluster_role,
+    make_cluster_role_binding,
+    resource_for_kind,
+    seed_cluster_roles,
+)
+from kubeflow_tpu.api.tokens import TokenRegistry, service_account
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.wsgi import TestClient, serve
+
+
+def secure_app(api=None):
+    api = api or FakeApiServer()
+    seed_cluster_roles(api)
+    tokens = TokenRegistry()
+    return api, tokens, ApiServerApp(api, tokens=tokens)
+
+
+def bearer(token):
+    return {"Authorization": f"Bearer {token}"}
+
+
+def grant(api, name, role, user):
+    api.create(make_cluster_role_binding(name, role, user))
+
+
+CM = {"kind": "ConfigMap", "apiVersion": "kubeflow-tpu.org/v1",
+      "metadata": {"name": "cm1", "namespace": "default"}, "spec": {"a": 1},
+      "status": {}}
+
+
+def test_resource_for_kind_pluralization():
+    assert resource_for_kind("Notebook") == "notebooks"
+    assert resource_for_kind("Study") == "studies"
+    assert resource_for_kind("Pod") == "pods"
+    assert resource_for_kind("TpuJob") == "tpujobs"
+    # vowel+y pluralizes with +s (K8s convention: gateways, not gatewaies)
+    assert resource_for_kind("Gateway") == "gateways"
+
+
+def test_edit_role_cannot_escalate_via_rbac_writes():
+    """The `resources: ['*']` wildcard must not reach RBAC objects: an
+    edit-bound identity POSTing a ClusterRoleBinding to cluster-admin
+    would otherwise self-escalate (real K8s `edit` excludes RBAC
+    resources for the same reason)."""
+    api, tokens, app = secure_app()
+    grant(api, "edit", "kubeflow-edit", "mallory")
+    client = TestClient(app, headers=bearer(tokens.issue("mallory")))
+    crb = make_cluster_role_binding("evil", "kubeflow-admin", "mallory")
+    resp = client.post("/apis/ClusterRoleBinding", crb.to_dict())
+    assert resp.status == 403, resp.body
+    # ...and can't read or rewrite roles either via the wildcard.
+    assert client.get("/apis/ClusterRole").status == 403
+    # ...nor via RBAC-kind SUBRESOURCES (the guard matches on the base
+    # resource, so /status of a ClusterRole is covered too).
+    role = client.get("/apis/ClusterRole/_/kubeflow-admin")
+    assert role.status == 403
+    put = client.request(
+        "PUT", "/apis/ClusterRole/_/kubeflow-admin/status",
+        {"kind": "ClusterRole", "apiVersion": "kubeflow-tpu.org/v1",
+         "metadata": {"name": "kubeflow-admin", "namespace": ""},
+         "spec": {}, "status": {"pwned": True}})
+    assert put.status == 403, put.body
+    # Admin's explicit RBAC rule still grants it.
+    grant(api, "adm", "kubeflow-admin", "system:admin")
+    admin = TestClient(app, headers=bearer(tokens.issue("system:admin")))
+    assert admin.post("/apis/ClusterRoleBinding", crb.to_dict()).status == 201
+
+
+def test_unauthenticated_request_rejected():
+    _, _, app = secure_app()
+    client = TestClient(app)
+    assert client.post("/apis/ConfigMap", CM).status == 401
+    assert client.get("/apis/ConfigMap").status == 401
+    # Probes stay open (kubelet has no identity header).
+    assert client.get("/healthz").status == 200
+
+
+def test_unknown_token_rejected():
+    _, _, app = secure_app()
+    client = TestClient(app, headers=bearer("not-a-real-token"))
+    assert client.get("/apis/ConfigMap").status == 401
+
+
+def test_admin_full_access():
+    api, tokens, app = secure_app()
+    grant(api, "admin", "kubeflow-admin", "system:admin")
+    client = TestClient(app, headers=bearer(tokens.issue("system:admin")))
+    assert client.post("/apis/ConfigMap", CM).status == 201
+    assert client.get("/apis/ConfigMap/default/cm1").status == 200
+    assert client.get("/debug/traces").status == 200
+    assert client.delete("/apis/ConfigMap/default/cm1").status == 200
+
+
+def test_viewer_reads_but_cannot_write():
+    api, tokens, app = secure_app()
+    grant(api, "view", "kubeflow-view", "alice")
+    client = TestClient(app, headers=bearer(tokens.issue("alice")))
+    assert client.get("/apis/ConfigMap").status == 200
+    resp = client.post("/apis/ConfigMap", CM)
+    assert resp.status == 403
+    assert "not allowed to create configmaps" in resp.json()["log"]
+    assert client.delete("/apis/ConfigMap/default/x").status == 403
+    # The traces drain clears the shared buffer — a write verb, so a
+    # read-only identity must not reach it.
+    assert client.get("/debug/traces").status == 403
+
+
+def test_status_is_a_distinct_subresource():
+    """Granting `tpujobs` update does NOT grant `tpujobs/status`; only the
+    owning runtime identity's role carries the status rule (reference
+    controllers get `.../status` verbs in their RBAC manifests)."""
+    api, tokens, app = secure_app()
+    api.create(make_cluster_role("editor", [
+        {"verbs": ["get", "create", "update"], "resources": ["tpujobs"]},
+    ]))
+    api.create(make_cluster_role("tpujob-runtime", [
+        {"verbs": ["get"], "resources": ["tpujobs"]},
+        {"verbs": ["update"], "resources": ["tpujobs/status"]},
+    ]))
+    grant(api, "ed", "editor", "editor-user")
+    ctl_user = service_account("kubeflow", "tpujob-controller")
+    grant(api, "ctl", "tpujob-runtime", ctl_user)
+
+    job = {"kind": "TpuJob", "apiVersion": "kubeflow-tpu.org/v1",
+           "metadata": {"name": "j1", "namespace": "default"},
+           "spec": {"replicas": 1,
+                    "template": {"spec": {"containers": [
+                        {"name": "w", "command": ["true"]}]}}},
+           "status": {}}
+    editor = TestClient(app, headers=bearer(tokens.issue("editor-user")))
+    controller = TestClient(app, headers=bearer(tokens.issue(ctl_user)))
+    assert editor.post("/apis/TpuJob", job).status == 201
+
+    fetched = editor.get("/apis/TpuJob/default/j1").json()
+    fetched["status"]["phase"] = "Running"
+    put = "/apis/TpuJob/default/j1/status"
+    assert editor.request("PUT", put, fetched).status == 403
+    assert controller.request("PUT", put, fetched).status == 200
+    # ...and the runtime identity cannot touch spec.
+    assert controller.request(
+        "PUT", "/apis/TpuJob/default/j1", fetched
+    ).status == 403
+
+
+def test_concrete_watch_requires_permission():
+    api, tokens, app = secure_app()
+    api.create(make_cluster_role("nb-only", [
+        {"verbs": ["list", "watch"], "resources": ["notebooks"]},
+    ]))
+    grant(api, "nb", "nb-only", "bob")
+    client = TestClient(app, headers=bearer(tokens.issue("bob")))
+    ok = client.get(
+        "/apis/Notebook?watch=true&resourceVersion=0&timeoutSeconds=0.05"
+    )
+    assert ok.status == 200
+    denied = client.get(
+        "/apis/Pod?watch=true&resourceVersion=0&timeoutSeconds=0.05"
+    )
+    assert denied.status == 403
+
+
+def test_multiplexed_watch_filters_by_permission():
+    """One `_` stream per client, but events only for kinds the identity
+    may watch — a least-privilege controller needs no cluster-wide read."""
+    api, tokens, app = secure_app()
+    api.create(make_cluster_role("nb-only", [
+        {"verbs": ["list", "watch"], "resources": ["notebooks"]},
+    ]))
+    grant(api, "nb", "nb-only", "bob")
+    client = TestClient(app, headers=bearer(tokens.issue("bob")))
+
+    api.create(new_resource("Notebook", "n1", "default",
+                            spec={"template": {"spec": {"containers": [
+                                {"name": "nb", "image": "img"}]}}}))
+    api.create(new_resource("Secretish", "s1", "default", spec={"x": 1}))
+    resp = client.get(
+        "/apis/_?watch=true&resourceVersion=0&timeoutSeconds=0.05"
+    )
+    assert resp.status == 200
+    kinds = {ev["object"]["kind"] for ev in resp.json()["events"]}
+    assert kinds == {"Notebook"}
+
+
+def test_pod_log_scoped_to_role(tmp_path):
+    log = tmp_path / "p.log"
+    log.write_text("hello from pod\n")
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    tokens = TokenRegistry()
+    app = ApiServerApp(api, log_root=str(tmp_path), tokens=tokens)
+    pod = new_resource("Pod", "p", "default", spec={})
+    pod.status["logPath"] = str(log)
+    api.create(pod)
+    api.create(make_cluster_role("no-logs", [
+        {"verbs": ["get", "list"], "resources": ["pods"]},
+    ]))
+    grant(api, "nl", "no-logs", "carol")
+    grant(api, "adm", "kubeflow-admin", "system:admin")
+
+    carol = TestClient(app, headers=bearer(tokens.issue("carol")))
+    admin = TestClient(app, headers=bearer(tokens.issue("system:admin")))
+    assert carol.get("/apis/Pod/default/p").status == 200
+    assert carol.get("/apis/Pod/default/p/log").status == 403
+    assert admin.get("/apis/Pod/default/p/log").body == b"hello from pod\n"
+
+
+def test_traces_require_cluster_scope():
+    api, tokens, app = secure_app()
+    api.create(new_resource("Role", "ns-admin", "team",
+                            spec={"rules": [{"verbs": ["*"],
+                                             "resources": ["*"]}]}))
+    api.create(new_resource(
+        "RoleBinding", "ns-admin", "team",
+        spec={"roleRef": {"kind": "Role", "name": "ns-admin"},
+              "subjects": [{"kind": "User", "name": "dave"}]}))
+    client = TestClient(app, headers=bearer(tokens.issue("dave")))
+    assert client.get("/debug/traces").status == 403
+
+
+def test_namespaced_rolebinding_scopes_access():
+    api, tokens, app = secure_app()
+    api.create(new_resource(
+        "RoleBinding", "edit", "team",
+        spec={"roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+              "subjects": [{"kind": "User", "name": "erin"}]}))
+    client = TestClient(app, headers=bearer(tokens.issue("erin")))
+    body = {"kind": "ConfigMap", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "c", "namespace": "team"},
+            "spec": {}, "status": {}}
+    assert client.post("/apis/ConfigMap", body).status == 201
+    other = dict(body, metadata={"name": "c", "namespace": "prod"})
+    assert client.post("/apis/ConfigMap", other).status == 403
+    # Namespaced list OK in the granted namespace; all-namespaces denied.
+    assert client.get("/apis/ConfigMap?namespace=team").status == 200
+    assert client.get("/apis/ConfigMap").status == 403
+
+
+def test_token_registry_roundtrip(tmp_path):
+    reg = TokenRegistry()
+    t1 = reg.issue("alice")
+    reg.add("static-token", service_account("kubeflow", "ctl"))
+    path = str(tmp_path / "tokens")
+    reg.save(path)
+    import os
+    import stat
+
+    # Credential file is owner-only (kube-apiserver token-auth-file).
+    assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+    loaded = TokenRegistry.load(path)
+    assert loaded.authenticate(t1) == "alice"
+    assert loaded.authenticate("static-token") == (
+        "system:serviceaccount:kubeflow:ctl"
+    )
+    loaded.revoke(t1)
+    assert loaded.authenticate(t1) is None
+
+
+def test_http_client_token_end_to_end():
+    """Over a real socket: admin token works, no token → PermissionError."""
+    api, tokens, app = secure_app()
+    grant(api, "admin", "kubeflow-admin", "system:admin")
+    server, _ = serve(app, host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        admin = HttpApiClient(base, token=tokens.issue("system:admin"))
+        created = admin.create(
+            new_resource("ConfigMap", "cm", "default", spec={"k": "v"})
+        )
+        assert created.metadata.name == "cm"
+        anon = HttpApiClient(base, token="")
+        with pytest.raises(PermissionError):
+            anon.create(new_resource("ConfigMap", "cm2", "default", spec={}))
+        with pytest.raises(PermissionError):
+            anon.get("ConfigMap", "cm", "default")
+    finally:
+        server.shutdown()
+
+
+def test_create_cannot_forge_status():
+    """POST with a pre-filled status must not persist it unless the
+    identity also holds the `<resource>/status` grant — otherwise a
+    create-only identity forges phase=Succeeded (the real apiserver drops
+    status on create for subresource-enabled kinds)."""
+    api, tokens, app = secure_app()
+    api.create(make_cluster_role("creator", [
+        {"verbs": ["get", "create"], "resources": ["tpujobs"]},
+    ]))
+    api.create(make_cluster_role("runtime", [
+        {"verbs": ["get", "create"], "resources": ["tpujobs"]},
+        {"verbs": ["update"], "resources": ["tpujobs/status"]},
+    ]))
+    grant(api, "cr", "creator", "creator-user")
+    grant(api, "rt", "runtime", "runtime-user")
+    body = {"kind": "TpuJob", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "forged", "namespace": "default"},
+            "spec": {"replicas": 1},
+            "status": {"phase": "Succeeded"}}
+    creator = TestClient(app, headers=bearer(tokens.issue("creator-user")))
+    assert creator.post("/apis/TpuJob", body).status == 201
+    assert api.get("TpuJob", "forged").status == {}
+    # The owning runtime identity's status rides through (the remote
+    # WorkloadMaterializer pattern: create already-Running objects).
+    body2 = dict(body, metadata={"name": "ok", "namespace": "default"})
+    runtime = TestClient(app, headers=bearer(tokens.issue("runtime-user")))
+    assert runtime.post("/apis/TpuJob", body2).status == 201
+    assert api.get("TpuJob", "ok").status == {"phase": "Succeeded"}

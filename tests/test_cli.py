@@ -265,3 +265,128 @@ def test_logs_command(tmp_path):
     rc, _, err = run(url, "logs", "talk-worker-0")
     assert rc == 1 and "outside" in err
     httpd.shutdown()
+
+
+def test_cli_token_against_secure_facade():
+    """--token authenticates against a secure facade; without it the CLI
+    reports the 401 as a readable error instead of a traceback."""
+    from kubeflow_tpu.api.rbac import (
+        make_cluster_role_binding,
+        seed_cluster_roles,
+    )
+    from kubeflow_tpu.api.tokens import TokenRegistry
+
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    api.create(
+        make_cluster_role_binding("adm", "kubeflow-admin", "system:admin")
+    )
+    tokens = TokenRegistry()
+    token = tokens.issue("system:admin")
+    httpd, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+    )
+    url = f"http://127.0.0.1:{httpd.server_port}"
+    api.create(new_resource("Notebook", "nb1", "team", spec={}))
+    try:
+        rc, out, _ = run(url, "--token", token, "get", "notebooks", "-n", "team")
+        assert rc == 0 and "nb1" in out
+        rc, _, err = run(url, "get", "notebooks", "-n", "team")
+        assert rc == 1 and "bearer token" in err
+    finally:
+        httpd.shutdown()
+
+
+def test_describe_golden(server):
+    """kubectl-describe analog: object + conditions + events in one view."""
+    api, url = server
+    job = new_resource(
+        "TpuJob", "train", "ml",
+        spec={"replicas": 2}, labels={"team": "research"},
+    )
+    created = api.create(job)
+    created.status = {
+        "phase": "Running",
+        "conditions": [{"type": "Created"}, {"type": "Running"}],
+    }
+    api.update_status(created)
+    api.record_event(created, "GangCreated", "created 2 worker pods")
+    api.record_event(
+        created, "Unschedulable", "no capacity", type_="Warning"
+    )
+
+    rc, out, _ = run(url, "describe", "tpujob", "train", "-n", "ml")
+    assert rc == 0
+    lines = out.splitlines()
+    assert "Name:         train" in lines
+    assert "Namespace:    ml" in lines
+    assert "Labels:       team=research" in lines
+    assert any(l.startswith("  replicas: 2") for l in lines), out
+    assert any(l.startswith("  phase: Running") for l in lines), out
+    # Conditions table lists both transitions in order.
+    ci = lines.index("Conditions:")
+    assert "Created" in lines[ci + 2] and "Running" in lines[ci + 3], out
+    # Events timeline, oldest first, with type and reason columns.
+    ei = lines.index("Events:")
+    assert "GangCreated" in lines[ei + 2], out
+    assert "Warning" in lines[ei + 3] and "no capacity" in lines[ei + 3], out
+
+
+def test_describe_no_events(server):
+    api, url = server
+    api.create(new_resource("Notebook", "nb", "team", spec={}))
+    rc, out, _ = run(url, "describe", "notebook", "nb", "-n", "team")
+    assert rc == 0 and "  <none>" in out.splitlines()
+
+
+def test_describe_cluster_scoped(server):
+    """`describe node tpu-node-0` must reach cluster scope (namespace "")
+    without the user spelling an empty -n."""
+    api, url = server
+    node = new_resource("Node", "tpu-node-0", "", spec={"chips": 4})
+    created = api.create(node)
+    api.record_event(created, "NodeReady", "kubelet posted ready")
+    rc, out, _ = run(url, "describe", "node", "tpu-node-0")
+    assert rc == 0, out
+    assert "Name:         tpu-node-0" in out
+    assert "NodeReady" in out
+    rc2, out2, _ = run(url, "get", "node", "tpu-node-0")
+    assert rc2 == 0 and "chips: 4" in out2
+
+
+def test_apply_continues_past_forbidden_doc():
+    """One forbidden doc in a multi-doc apply is reported per-doc and the
+    rest still apply (Forbidden is an ApiError, like 409/422/404)."""
+    from kubeflow_tpu.api.rbac import make_cluster_role, make_cluster_role_binding
+    from kubeflow_tpu.api.tokens import TokenRegistry
+
+    api = FakeApiServer()
+    api.create(make_cluster_role("nb-create", [
+        {"verbs": ["create"], "resources": ["notebooks"]},
+    ]))
+    api.create(make_cluster_role_binding("nb", "nb-create", "frank"))
+    tokens = TokenRegistry()
+    httpd, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+    )
+    url = f"http://127.0.0.1:{httpd.server_port}"
+    docs = (
+        "apiVersion: kubeflow-tpu.org/v1\n"
+        "kind: TpuJob\nmetadata: {name: denied, namespace: default}\n"
+        "spec: {replicas: 1}\n"
+        "---\n"
+        "apiVersion: kubeflow-tpu.org/v1\n"
+        "kind: Notebook\nmetadata: {name: allowed, namespace: default}\n"
+        "spec: {}\n"
+    )
+    try:
+        rc, out, err = run(
+            url, "--token", tokens.issue("frank"), "apply", "-f", "-",
+            stdin=docs,
+        )
+    finally:
+        httpd.shutdown()
+    assert rc == 1
+    assert "TpuJob/denied" in err and "not allowed" in err
+    assert "notebook/allowed created" in out
+    assert api.get("Notebook", "allowed").metadata.name == "allowed"
