@@ -34,15 +34,31 @@ STATIC = REPO / "kubeflow_tpu" / "apps" / "static"
 USER = "alice@corp.com"
 
 
-def _req(url, body=None, method=None):
+def _req(url, body=None, method=None, token=None):
     data = json.dumps(body).encode() if body is not None else None
-    r = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"} if data else {},
-    )
+    headers = {"Content-Type": "application/json"} if data else {}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    r = urllib.request.Request(url, data=data, method=method, headers=headers)
     with urllib.request.urlopen(r, timeout=20) as resp:
         raw = resp.read()
         return resp.status, json.loads(raw) if raw.strip() else {}
+
+
+def _read_admin_token(proc, timeout=30):
+    """The launcher prints the minted facade credential at boot (secure
+    by default since the bearer-token round); scrape it like an operator
+    would."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.1)
+            continue
+        m = re.match(r"apiserver admin token: (\S+)", line)
+        if m:
+            return m.group(1)
+    raise TimeoutError("launcher never printed the apiserver admin token")
 
 
 def _wait(pred, timeout=90, interval=0.5):
@@ -67,6 +83,7 @@ def test_spawn_path_over_live_servers(tmp_path):
     dash = f"http://127.0.0.1:{port}"
     jup = f"http://127.0.0.1:{port + 2}"
     try:
+        token = _read_admin_token(proc)
         _wait(lambda: _probe_up(f"{dash}/healthz"), timeout=60)
 
         # 1. Fresh user: no workgroup yet → register (dashboard flow).
@@ -120,8 +137,16 @@ def test_spawn_path_over_live_servers(tmp_path):
         #    VirtualService carries (generateVirtualService parity,
         #    notebook_controller.go:379) — read it off the facade.
         facade = f"http://127.0.0.1:{port + 4}"
+        # The facade is secure: no token → 401; the minted admin token
+        # reads the controller-created VirtualService.
+        try:
+            _req(f"{facade}/apis/VirtualService/{ns}/notebook-{ns}-my-nb")
+            raise AssertionError("facade served an unauthenticated read")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401, e.code
         _, vs = _req(
-            f"{facade}/apis/VirtualService/{ns}/notebook-{ns}-my-nb"
+            f"{facade}/apis/VirtualService/{ns}/notebook-{ns}-my-nb",
+            token=token,
         )
         assert f"/notebook/{ns}/my-nb/" in json.dumps(vs["spec"]), vs
 
