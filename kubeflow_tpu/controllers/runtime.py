@@ -248,11 +248,21 @@ class Controller:
             self._queue.add(key_s, after=result.requeue_after)
         return True
 
+    def _flush_events(self) -> None:
+        """Barrier on the store's async event dispatch (no-op for remote
+        clients, whose delivery is inherently asynchronous)."""
+        flush = getattr(self.api, "flush", None)
+        if flush is not None:
+            flush()
+
     def run_until_idle(self, *, max_passes: int = 1000) -> int:
         """Drain everything currently ready (deterministic test driver).
-        Timed requeues that are not yet due are left pending."""
+        Timed requeues that are not yet due are left pending. Each pass
+        first drains the store's dispatcher so watch events caused by the
+        previous reconcile's writes have landed in the workqueue."""
         done = 0
         for _ in range(max_passes):
+            self._flush_events()
             if not self.process_one():
                 return done
             done += 1
@@ -300,6 +310,8 @@ class ControllerManager:
         """Deterministic drain across all controllers (watch events from one
         controller's writes wake the others)."""
         for _ in range(1000):
+            for c in self.controllers:
+                c._flush_events()
             if not any(c.process_one() for c in self.controllers):
                 return
         raise RuntimeError("controllers did not settle")

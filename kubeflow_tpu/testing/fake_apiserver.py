@@ -16,11 +16,15 @@ depends on (the reference leaned on envtest for exactly this,
   served version (the reference's Notebook CRD carries three versions
   plus conversion, `notebook-controller/api/*/notebook_types.go`)
 
-Thread-safe; watch delivery is synchronous (deterministic tests).
+Thread-safe. Watch delivery is ASYNCHRONOUS on a dedicated dispatcher
+thread, off the store lock — a slow handler delays delivery, never
+writers; `flush()` is the barrier deterministic tests drain on (the
+controller runtime's run_until_idle calls it automatically).
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Iterable
@@ -29,6 +33,8 @@ from kubeflow_tpu.api import versioning
 from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid, now
 
 WatchHandler = Callable[[str, Resource], None]  # (event_type, obj)
+
+log = logging.getLogger(__name__)
 
 
 class ApiError(Exception):
@@ -82,6 +88,17 @@ class FakeApiServer:
         self._journal: list[tuple[int, str, Resource]] = []
         self._journal_size = journal_size
         self._journal_cv = threading.Condition(self._lock)
+        # In-process handler dispatch runs on a dedicated thread, OFF the
+        # store lock: a slow/blocking handler delays event delivery, not
+        # writers (the apiserver's watch cache serves watchers the same
+        # way — writers never wait for consumers). The journal append
+        # stays under the lock so journal order is rv order; the queue
+        # preserves that order for handlers (single consumer).
+        self._dispatch_cv = threading.Condition()
+        self._dispatch_q: list[tuple[str, Resource]] = []
+        self._dispatch_enqueued = 0
+        self._dispatch_done = 0
+        self._dispatcher: threading.Thread | None = None
 
     # -- admission --------------------------------------------------------
 
@@ -104,9 +121,19 @@ class FakeApiServer:
     # -- watch ------------------------------------------------------------
 
     def watch(self, handler: WatchHandler, kind: str | None = None) -> None:
-        """Subscribe to events; kind=None receives everything."""
+        """Subscribe to events; kind=None receives everything. The first
+        subscription starts the dispatcher thread (stores nobody watches
+        never pay for one)."""
         with self._lock:
             self._watchers.append((kind, handler))
+        with self._dispatch_cv:
+            if self._dispatcher is None:
+                self._dispatcher = threading.Thread(
+                    target=self._dispatch_loop,
+                    name="apiserver-dispatch",
+                    daemon=True,
+                )
+                self._dispatcher.start()
 
     def _emit(self, event: str, obj: Resource) -> None:
         # Journal under the lock (all callers hold it) so journal order is
@@ -119,9 +146,47 @@ class FakeApiServer:
             if len(self._journal) > self._journal_size:
                 del self._journal[: -self._journal_size]
             self._journal_cv.notify_all()
-        for kind, handler in list(self._watchers):
-            if kind is None or kind == obj.kind:
-                handler(event, obj.deepcopy())
+        if not self._watchers:
+            return  # nobody to deliver to (late watchers get no replay)
+        with self._dispatch_cv:
+            self._dispatch_q.append((event, obj.deepcopy()))
+            self._dispatch_enqueued += 1
+            self._dispatch_cv.notify_all()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._dispatch_cv:
+                while not self._dispatch_q:
+                    self._dispatch_cv.wait()
+                event, obj = self._dispatch_q.pop(0)
+            with self._lock:
+                watchers = list(self._watchers)
+            for kind, handler in watchers:
+                if kind is None or kind == obj.kind:
+                    try:
+                        handler(event, obj.deepcopy())
+                    except Exception:
+                        log.exception(
+                            "watch handler failed for %s %s", event, obj.key
+                        )
+            with self._dispatch_cv:
+                self._dispatch_done += 1
+                self._dispatch_cv.notify_all()
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Block until every event emitted so far has been delivered to
+        all in-process handlers — the barrier deterministic test drivers
+        (run_until_idle) sit on now that dispatch is asynchronous."""
+        deadline = time.monotonic() + timeout
+        with self._dispatch_cv:
+            while self._dispatch_done < self._dispatch_enqueued:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"event dispatch did not drain "
+                        f"({self._dispatch_done}/{self._dispatch_enqueued})"
+                    )
+                self._dispatch_cv.wait(remaining)
 
     @property
     def current_rv(self) -> int:

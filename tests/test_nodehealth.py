@@ -43,6 +43,10 @@ def _add_node(api, name, ready=True):
 
 def _drain(*controllers):
     for _ in range(50):
+        for c in controllers:
+            # Event dispatch is async (dispatcher thread); settle
+            # detection must drain it before concluding "idle".
+            c.controller._flush_events()
         if not any(c.controller.process_one() for c in controllers):
             return
     raise AssertionError("controllers did not settle")
