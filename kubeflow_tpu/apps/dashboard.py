@@ -138,7 +138,7 @@ class DashboardApp(App):
 
     def get_activities(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "list", "events", ns)
+        ensure_authorized(self.api, req.user, "list", "events", ns, request=req)
         events = [
             {
                 "reason": ev.spec.get("reason"),
@@ -173,7 +173,7 @@ class DashboardApp(App):
                                      resource, ns)
         ]
         if not allowed:
-            ensure_authorized(self.api, req.user, "list", "tpujobs", ns)
+            ensure_authorized(self.api, req.user, "list", "tpujobs", ns, request=req)
         rows = []
         for kind, _ in allowed:
             for res in self.api.list(kind, ns):

@@ -121,7 +121,7 @@ class JupyterApp(App):
 
     def list_notebooks(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "list", "notebooks", ns)
+        ensure_authorized(self.api, req.user, "list", "notebooks", ns, request=req)
         items = []
         for nb in self.api.list("Notebook", ns):
             items.append(
@@ -162,7 +162,7 @@ class JupyterApp(App):
 
     def list_pvcs(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "list", "persistentvolumeclaims", ns)
+        ensure_authorized(self.api, req.user, "list", "persistentvolumeclaims", ns, request=req)
         pvcs = [
             {
                 "name": p.metadata.name,
@@ -177,7 +177,7 @@ class JupyterApp(App):
 
     def list_poddefaults(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "list", "poddefaults", ns)
+        ensure_authorized(self.api, req.user, "list", "poddefaults", ns, request=req)
         pds = [
             {
                 "label": pd.spec.get("selector", {}).get("matchLabels", {}),
@@ -189,7 +189,7 @@ class JupyterApp(App):
         return success_response("poddefaults", pds)
 
     def list_storageclasses(self, req: Request) -> Response:
-        ensure_authorized(self.api, req.user, "list", "storageclasses", "")
+        ensure_authorized(self.api, req.user, "list", "storageclasses", "", request=req)
         return success_response(
             "storageclasses",
             [sc.metadata.name for sc in self.api.list("StorageClass", "")],
@@ -199,7 +199,7 @@ class JupyterApp(App):
 
     def post_notebook(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "create", "notebooks", ns)
+        ensure_authorized(self.api, req.user, "create", "notebooks", ns, request=req)
         body = req.json()
         name = body.get("name")
         if not name:
@@ -389,7 +389,7 @@ class JupyterApp(App):
 
     def list_snapshots(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "list", "volumesnapshots", ns)
+        ensure_authorized(self.api, req.user, "list", "volumesnapshots", ns, request=req)
         snapshots = [
             {
                 "name": s.metadata.name,
@@ -404,7 +404,7 @@ class JupyterApp(App):
 
     def post_snapshot(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "create", "volumesnapshots", ns)
+        ensure_authorized(self.api, req.user, "create", "volumesnapshots", ns, request=req)
         body = req.json()
         source = body.get("pvc")
         if not source:
@@ -434,7 +434,7 @@ class JupyterApp(App):
 
     def delete_snapshot(self, req: Request) -> Response:
         ns, name = req.path_params["ns"], req.path_params["name"]
-        ensure_authorized(self.api, req.user, "delete", "volumesnapshots", ns)
+        ensure_authorized(self.api, req.user, "delete", "volumesnapshots", ns, request=req)
         self.api.delete("VolumeSnapshot", name, ns)
         return success_response()
 
@@ -442,7 +442,7 @@ class JupyterApp(App):
 
     def patch_notebook(self, req: Request) -> Response:
         ns, name = req.path_params["ns"], req.path_params["name"]
-        ensure_authorized(self.api, req.user, "update", "notebooks", ns)
+        ensure_authorized(self.api, req.user, "update", "notebooks", ns, request=req)
         body = req.json()
         if "stopped" not in body:
             raise HttpError(400, "PATCH body needs {'stopped': bool}")
@@ -458,7 +458,7 @@ class JupyterApp(App):
 
     def delete_notebook(self, req: Request) -> Response:
         ns, name = req.path_params["ns"], req.path_params["name"]
-        ensure_authorized(self.api, req.user, "delete", "notebooks", ns)
+        ensure_authorized(self.api, req.user, "delete", "notebooks", ns, request=req)
         self.api.delete("Notebook", name, ns)
         return success_response()
 

@@ -40,6 +40,15 @@ ROLE_TO_CLUSTER_ROLE = {
     "view": "kubeflow-view",
 }
 
+# Mesh operation scope per contributor role: the RBAC ClusterRole and the
+# AuthorizationPolicy must agree, so a viewer is GET-only at BOTH gates
+# (the reference's ServiceRole rules carry the same methods constraint,
+# `servicerole_types.go:43-75`). None = all methods.
+ROLE_MESH_METHODS = {
+    "edit": None,
+    "view": ["GET"],
+}
+
 BINDING_MANAGER = "kfam"
 
 
@@ -215,15 +224,16 @@ class KfamApp(App):
         )
         rb.metadata.owner_references = [owner_ref(ns_obj, controller=False)]
         self.api.apply(rb)
+        rule: dict = {"from": [{"source": {"principals": [user]}}]}
+        methods = ROLE_MESH_METHODS[role]
+        if methods:
+            rule["to"] = [{"operation": {"methods": list(methods)}}]
         ap = new_resource(
             "AuthorizationPolicy",
             name,
             namespace,
             annotations={"manager": BINDING_MANAGER, "user": user, "role": role},
-            spec={
-                "action": "ALLOW",
-                "rules": [{"from": [{"source": {"principals": [user]}}]}],
-            },
+            spec={"action": "ALLOW", "rules": [rule]},
         )
         ap.metadata.owner_references = [owner_ref(ns_obj, controller=False)]
         self.api.apply(ap)

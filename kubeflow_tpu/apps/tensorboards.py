@@ -53,7 +53,7 @@ class TensorboardsApp(App):
 
     def list_tbs(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "list", "tensorboards", ns)
+        ensure_authorized(self.api, req.user, "list", "tensorboards", ns, request=req)
         items = [
             {
                 "name": tb.metadata.name,
@@ -70,7 +70,7 @@ class TensorboardsApp(App):
 
     def post_tb(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "create", "tensorboards", ns)
+        ensure_authorized(self.api, req.user, "create", "tensorboards", ns, request=req)
         body = req.json()
         name, logspath = body.get("name"), body.get("logspath")
         if not name or not logspath:
@@ -81,13 +81,13 @@ class TensorboardsApp(App):
 
     def delete_tb(self, req: Request) -> Response:
         ns, name = req.path_params["ns"], req.path_params["name"]
-        ensure_authorized(self.api, req.user, "delete", "tensorboards", ns)
+        ensure_authorized(self.api, req.user, "delete", "tensorboards", ns, request=req)
         self.api.delete("Tensorboard", name, ns)
         return success_response()
 
     def list_pvcs(self, req: Request) -> Response:
         ns = req.path_params["ns"]
-        ensure_authorized(self.api, req.user, "list", "persistentvolumeclaims", ns)
+        ensure_authorized(self.api, req.user, "list", "persistentvolumeclaims", ns, request=req)
         return success_response(
             "pvcs",
             [p.metadata.name for p in self.api.list("PersistentVolumeClaim", ns)],

@@ -10,12 +10,22 @@ from __future__ import annotations
 
 from kubeflow_tpu.api.rbac import subject_access_review
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
-from kubeflow_tpu.web.wsgi import HttpError
+from kubeflow_tpu.web.wsgi import HttpError, Request
 
 
 class Forbidden(HttpError):
     def __init__(self, message: str):
         super().__init__(403, message)
+
+
+# The HTTP method a mesh sidecar would see for each API verb — used when
+# the caller doesn't hand us the live request (mesh `to.operation.methods`
+# rules match HTTP methods, not K8s verbs).
+_VERB_METHODS = {
+    "get": "GET", "list": "GET", "watch": "GET",
+    "create": "POST", "update": "PUT", "patch": "PATCH",
+    "delete": "DELETE",
+}
 
 
 def ensure_authorized(
@@ -24,6 +34,7 @@ def ensure_authorized(
     verb: str,
     resource: str,
     namespace: str = "",
+    request: Request | None = None,
 ) -> None:
     if user is None:
         raise HttpError(401, "request has no authenticated user")
@@ -34,10 +45,19 @@ def ensure_authorized(
         )
     if namespace:
         # Second gate, mirroring production traffic flow: RBAC authorizes
-        # the API verb, the mesh admits the principal into the namespace
-        # (`profile_controller.go:190` owner policy + kfam contributor
-        # policies). RBAC-without-mesh-policy must fail closed here, not
-        # silently skip the mesh.
+        # the API verb, the mesh admits the principal's OPERATION into
+        # the namespace (`profile_controller.go:190` owner policy + kfam
+        # contributor policies with method constraints). RBAC-without-
+        # mesh-policy must fail closed here, not silently skip the mesh.
         from kubeflow_tpu.web.mesh import ensure_mesh_admits
 
-        ensure_mesh_admits(api, user, namespace)
+        ensure_mesh_admits(
+            api,
+            user,
+            namespace,
+            method=(
+                request.method if request is not None
+                else _VERB_METHODS.get(verb)
+            ),
+            path=request.path if request is not None else None,
+        )

@@ -3,16 +3,26 @@
 The reference puts every tenant namespace behind Istio RBAC: the profile
 controller creates the owner's ServiceRole/ServiceRoleBinding at
 namespace creation (`profile_controller.go:190`) and kfam adds
-contributor bindings (`kfam/bindings.go:76-128`). Traffic into the
-namespace's services is admitted by the sidecars, not the apps. Our
+contributor bindings (`kfam/bindings.go:76-128`). The ServiceRole rules
+carry services/methods/paths constraints with exact/prefix/suffix `*`
+matching (`istiorbac/v1alpha1/servicerole_types.go:38-75`); traffic into
+the namespace's services is admitted by the sidecars, not the apps. Our
 platform-in-a-box has no sidecars, so the web tier evaluates the same
 policy objects at the request boundary.
 
-Semantics follow Istio's ALLOW-policy rules: a namespace with no ALLOW
-policies admits everyone (policy-free namespaces stay open — hand-made
-test namespaces, system namespaces); once any ALLOW policy exists, a
-request is admitted only if some policy rule matches its principal (an
-empty `from` clause matches all sources).
+Semantics follow Istio's AuthorizationPolicy evaluation order:
+
+1. If any DENY policy has a rule matching the request → deny.
+2. If the namespace has no ALLOW policies → allow (policy-free
+   namespaces stay open: hand-made test namespaces, system namespaces).
+3. Otherwise allow iff some ALLOW policy rule matches.
+
+A rule matches when its `from` matches the principal AND its `to`
+matches the operation; an empty/missing clause matches anything — which
+makes `rules: []` the deny-all idiom (the policy flips the namespace
+into enforce mode yet admits nobody), and `rules: [{}]` allow-all.
+Principals and paths support Istio's exact, `prefix*`, and `*suffix`
+match forms; methods are exact HTTP verbs.
 """
 
 from __future__ import annotations
@@ -21,27 +31,90 @@ from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
 from kubeflow_tpu.web.wsgi import HttpError
 
 
-def mesh_admits(api: FakeApiServer, user: str, namespace: str) -> bool:
-    policies = [
-        p
-        for p in api.list("AuthorizationPolicy", namespace)
-        if p.spec.get("action", "ALLOW") == "ALLOW"
-    ]
-    if not policies:
+def _match(pattern: str, value: str) -> bool:
+    """Istio string match: exact, `foo*` prefix, `*foo` suffix, `*` any
+    (`servicerole_types.go:33-41` documents the same three forms)."""
+    if pattern == "*":
         return True
-    for policy in policies:
-        for rule in policy.spec.get("rules", []):
-            sources = rule.get("from", [])
-            if not sources:
-                return True
-            for source in sources:
-                if user in source.get("source", {}).get("principals", []):
-                    return True
+    if pattern.endswith("*"):
+        return value.startswith(pattern[:-1])
+    if pattern.startswith("*"):
+        return value.endswith(pattern[1:])
+    return pattern == value
+
+
+def _from_matches(rule: dict, user: str) -> bool:
+    sources = rule.get("from", [])
+    if not sources:
+        return True  # no source constraint = any principal
+    return any(
+        any(
+            _match(p, user)
+            for p in source.get("source", {}).get("principals", [])
+        )
+        for source in sources
+    )
+
+
+def _to_matches(rule: dict, method: str | None, path: str | None) -> bool:
+    operations = rule.get("to", [])
+    if not operations:
+        return True  # no operation constraint = any method/path
+    for to in operations:
+        op = to.get("operation", {})
+        methods = op.get("methods", [])
+        paths = op.get("paths", [])
+        method_ok = not methods or (
+            method is not None and any(_match(m, method) for m in methods)
+        )
+        path_ok = not paths or (
+            path is not None and any(_match(p, path) for p in paths)
+        )
+        if method_ok and path_ok:
+            return True
     return False
 
 
+def _rule_matches(
+    rule: dict, user: str, method: str | None, path: str | None
+) -> bool:
+    return _from_matches(rule, user) and _to_matches(rule, method, path)
+
+
+def mesh_admits(
+    api: FakeApiServer,
+    user: str,
+    namespace: str,
+    *,
+    method: str | None = None,
+    path: str | None = None,
+) -> bool:
+    policies = api.list("AuthorizationPolicy", namespace)
+    allows = [p for p in policies if p.spec.get("action", "ALLOW") == "ALLOW"]
+    denies = [p for p in policies if p.spec.get("action") == "DENY"]
+    # DENY is evaluated first and wins (Istio's order of evaluation).
+    for policy in denies:
+        if any(
+            _rule_matches(rule, user, method, path)
+            for rule in policy.spec.get("rules", [])
+        ):
+            return False
+    if not allows:
+        return True
+    return any(
+        _rule_matches(rule, user, method, path)
+        for policy in allows
+        for rule in policy.spec.get("rules", [])
+    )
+
+
 def ensure_mesh_admits(
-    api: FakeApiServer, user: str, namespace: str
+    api: FakeApiServer,
+    user: str,
+    namespace: str,
+    *,
+    method: str | None = None,
+    path: str | None = None,
 ) -> None:
     from kubeflow_tpu.api.rbac import is_cluster_admin
 
@@ -50,10 +123,12 @@ def ensure_mesh_admits(
     # kubectl; the dashboard's admin probe is `api_default.go:270`).
     if is_cluster_admin(api, user):
         return
-    if not mesh_admits(api, user, namespace):
+    if not mesh_admits(api, user, namespace, method=method, path=path):
+        what = f" {method}" if method else ""
         raise HttpError(
             403,
-            f"mesh policy denies {user!r} access to namespace "
+            f"mesh policy denies {user!r}{what} access to namespace "
             f"{namespace!r} (no AuthorizationPolicy admits this "
-            "principal — ask the profile owner for a contributor binding)",
+            "principal for this operation — ask the profile owner for a "
+            "contributor binding)",
         )

@@ -137,3 +137,127 @@ def test_cluster_admin_bypasses_mesh(api):
     api.create(_profile())
     ctl.controller.run_until_idle()
     ensure_mesh_admits(api, "root@example.com", "team-a")  # no raise
+
+
+# -- rule fidelity: methods/paths/wildcards/DENY (servicerole_types.go:38-75)
+
+
+def _policy(ns, name, rules, action="ALLOW"):
+    return new_resource(
+        "AuthorizationPolicy", name, ns,
+        spec={"action": action, "rules": rules},
+    )
+
+
+def test_mesh_method_constraint():
+    """A GET-only rule admits reads and refuses writes — the viewer
+    scoping kfam now attaches (`ROLE_MESH_METHODS`)."""
+    api = FakeApiServer()
+    api.create(_policy("team", "viewer", [{
+        "from": [{"source": {"principals": ["v@example.com"]}}],
+        "to": [{"operation": {"methods": ["GET"]}}],
+    }]))
+    assert mesh_admits(api, "v@example.com", "team", method="GET")
+    assert not mesh_admits(api, "v@example.com", "team", method="POST")
+    assert not mesh_admits(api, "other@example.com", "team", method="GET")
+
+
+def test_mesh_path_constraint_with_wildcards():
+    """Paths use Istio's exact/prefix/suffix forms
+    (servicerole_types.go:33-41 documents the same matching)."""
+    api = FakeApiServer()
+    api.create(_policy("team", "scoped", [{
+        "from": [{"source": {"principals": ["v@example.com"]}}],
+        "to": [{"operation": {"paths": ["/api/notebooks*", "*/healthz"]}}],
+    }]))
+    ok = lambda p: mesh_admits(api, "v@example.com", "team", path=p)
+    assert ok("/api/notebooks")
+    assert ok("/api/notebooks/nb1")
+    assert ok("/anything/healthz")
+    assert not ok("/api/secrets")
+
+
+def test_mesh_principal_wildcards():
+    api = FakeApiServer()
+    api.create(_policy("team", "sa", [{
+        "from": [{"source": {"principals": ["system:serviceaccount:team:*"]}}],
+    }]))
+    assert mesh_admits(api, "system:serviceaccount:team:runner", "team")
+    assert not mesh_admits(api, "system:serviceaccount:prod:runner", "team")
+
+
+def test_mesh_deny_wins_over_allow():
+    """Istio evaluation order: DENY policies are checked first and win."""
+    api = FakeApiServer()
+    api.create(_policy("team", "allow-all", [{}]))
+    api.create(_policy("team", "block-mallory", [{
+        "from": [{"source": {"principals": ["mallory@example.com"]}}],
+    }], action="DENY"))
+    assert mesh_admits(api, "alice@example.com", "team")
+    assert not mesh_admits(api, "mallory@example.com", "team")
+
+
+def test_mesh_deny_scoped_to_operation():
+    """A DENY on POST leaves GET open — maintenance-freeze idiom."""
+    api = FakeApiServer()
+    api.create(_policy("team", "freeze-writes", [{
+        "to": [{"operation": {"methods": ["POST", "PUT", "DELETE"]}}],
+    }], action="DENY"))
+    assert mesh_admits(api, "anyone@example.com", "team", method="GET")
+    assert not mesh_admits(api, "anyone@example.com", "team", method="POST")
+
+
+def test_mesh_deny_all_idiom():
+    """`rules: []` on an ALLOW policy matches nobody but flips the
+    namespace into enforce mode — Istio's deny-all idiom, now
+    representable and distinct from allow-all (`rules: [{}]`)."""
+    api = FakeApiServer()
+    api.create(_policy("locked", "deny-all", []))
+    assert not mesh_admits(api, "anyone@example.com", "locked")
+    assert not mesh_admits(api, "owner@example.com", "locked", method="GET")
+
+
+def test_viewer_post_refused_at_web_tier(api):
+    """E2E through the real apps: kfam binds dana as view; the jupyter
+    backend serves her GETs and refuses her POST — at the mesh gate with
+    a method-scoped policy, backed by the GET-only RBAC role."""
+    from kubeflow_tpu.apps.jupyter import JupyterApp
+
+    ctl = ProfileController(api)
+    api.create(_profile())
+    ctl.controller.run_until_idle()
+    owner_hdr = {
+        "x-goog-authenticated-user-email":
+            "accounts.google.com:alice@example.com"
+    }
+    kfam = TestClient(KfamApp(api), headers=owner_hdr)
+    resp = kfam.post(
+        "/kfam/v1/bindings",
+        body={
+            "user": {"kind": "User", "name": "dana@example.com"},
+            "referredNamespace": "team-a",
+            "roleRef": {"kind": "ClusterRole", "name": "view"},
+        },
+    )
+    assert resp.status == 200, resp.body
+    [ap] = [
+        p for p in api.list("AuthorizationPolicy", "team-a")
+        if p.metadata.annotations.get("user") == "dana@example.com"
+    ]
+    assert ap.spec["rules"][0]["to"] == [
+        {"operation": {"methods": ["GET"]}}
+    ]
+
+    dana = TestClient(JupyterApp(api), headers={
+        "x-goog-authenticated-user-email":
+            "accounts.google.com:dana@example.com"
+    })
+    assert dana.get("/api/namespaces/team-a/notebooks").status == 200
+    denied = dana.post(
+        "/api/namespaces/team-a/notebooks",
+        body={"name": "nb", "image": "img"},
+    )
+    assert denied.status == 403, denied.body
+    # The mesh rule alone refuses the write even for a principal whose
+    # RBAC would allow it (defense in depth, evaluated directly):
+    assert not mesh_admits(api, "dana@example.com", "team-a", method="POST")
