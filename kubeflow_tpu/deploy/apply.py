@@ -89,6 +89,13 @@ def apply_platform(
 
     # -- Phase 1: PLATFORM (cloud infra; kfctlServer.go:219) ---------------
     try:
+        # The cluster first (the reference's Deployment Manager step,
+        # kfctlServer.go:268): pools attach to it.
+        _retry(
+            lambda: cloud.ensure_cluster(spec),
+            what="ensure_cluster",
+            retries=retries,
+        )
         for pool in spec.node_pools:
             _retry(
                 lambda pool=pool: cloud.ensure_node_pool(spec, pool),

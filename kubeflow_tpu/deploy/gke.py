@@ -120,6 +120,10 @@ def cluster_create_request(
     )
 
 
+def cluster_get_request(spec: PlatformSpec, cluster: str | None = None) -> Request:
+    return Request("GET", f"{API_BASE}/{_location(spec, cluster or spec.name)}")
+
+
 def node_pool_create_request(
     spec: PlatformSpec, pool: NodePool, cluster: str | None = None
 ) -> Request:
@@ -210,25 +214,64 @@ class RecordingTransport:
 
 class GkeCloud:
     """CloudProvider over real GKE payloads. Idempotent the GKE way:
-    create returns 409 for an existing pool, which ensure treats as
-    success (second apply must no-op, `kfctl_second_apply.py`)."""
+    list-then-create, and a 409 from the create (a concurrent apply won
+    the race) is treated as success — second apply must no-op
+    (`kfctl_second_apply.py`). The ensure/create-409 contract needs a
+    transport that classifies statuses (`credentials.AuthTransport`);
+    `RecordingTransport` never raises, so dry runs just record."""
 
     def __init__(self, transport: Transport, cluster: str | None = None):
         self.transport = transport
         self.cluster = cluster
 
+    def ensure_cluster(self, spec: PlatformSpec) -> None:
+        """The cluster itself, before any pools (the reference's PLATFORM
+        phase creates it via Deployment Manager, `kfctlServer.go:268`)."""
+        from kubeflow_tpu.deploy.credentials import (
+            CloudConflict,
+            CloudNotFound,
+        )
+
+        try:
+            existing = self.transport.send(
+                cluster_get_request(spec, self.cluster)
+            )
+            # An empty body means "no such cluster" on transports that
+            # don't classify statuses (RecordingTransport returns {}): a
+            # real GET returns the cluster object with its name, so this
+            # keeps recorded traffic identical to real traffic (dry runs
+            # record the cluster create too).
+            if existing.get("name"):
+                return
+        except CloudNotFound:
+            pass
+        try:
+            self.transport.send(cluster_create_request(spec, self.cluster))
+        except CloudConflict:
+            pass  # concurrent apply created it between GET and POST
+
     def ensure_node_pool(self, spec: PlatformSpec, pool: NodePool) -> None:
+        from kubeflow_tpu.deploy.credentials import CloudConflict
+
         existing = self.list_node_pools(spec)
         if pool.name in existing:
             return
-        self.transport.send(
-            node_pool_create_request(spec, pool, self.cluster)
-        )
+        try:
+            self.transport.send(
+                node_pool_create_request(spec, pool, self.cluster)
+            )
+        except CloudConflict:
+            pass  # lost a list/create race to a concurrent apply — fine
 
     def delete_node_pool(self, spec: PlatformSpec, pool_name: str) -> None:
-        self.transport.send(
-            node_pool_delete_request(spec, pool_name, self.cluster)
-        )
+        from kubeflow_tpu.deploy.credentials import CloudNotFound
+
+        try:
+            self.transport.send(
+                node_pool_delete_request(spec, pool_name, self.cluster)
+            )
+        except CloudNotFound:
+            pass  # already gone — teardown retries/gc must be idempotent
 
     def list_node_pools(self, spec: PlatformSpec) -> list[str]:
         response = self.transport.send(

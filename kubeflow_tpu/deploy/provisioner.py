@@ -36,6 +36,8 @@ class CloudError(Exception):
 
 
 class CloudProvider(Protocol):
+    def ensure_cluster(self, spec: PlatformSpec) -> None: ...
+
     def ensure_node_pool(self, spec: PlatformSpec, pool: NodePool) -> None: ...
 
     def delete_node_pool(self, spec: PlatformSpec, pool_name: str) -> None: ...
@@ -51,6 +53,7 @@ class FakeCloud:
         self.api = api
         self._lock = threading.Lock()
         self._pools: dict[tuple[str, str], NodePool] = {}
+        self._clusters: set[str] = set()
         self.fail_next = fail_next  # injectable flakiness
         self.calls = 0
 
@@ -60,6 +63,13 @@ class FakeCloud:
             if self.fail_next > 0:
                 self.fail_next -= 1
                 raise CloudError("injected transient cloud failure")
+
+    def ensure_cluster(self, spec: PlatformSpec) -> None:
+        """In-process clusters always exist; record the ask. (Flake
+        injection targets the pool calls so existing fail_next counts in
+        tests keep their meaning.)"""
+        with self._lock:
+            self._clusters.add(spec.name)
 
     def ensure_node_pool(self, spec: PlatformSpec, pool: NodePool) -> None:
         self._maybe_fail()

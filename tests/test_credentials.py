@@ -1,0 +1,406 @@
+"""Cloud credential plumbing: TokenSource, AuthTransport, and the GKE
+ensure contracts over a fake GKE HTTP server.
+
+The table tests mirror `bootstrap/cmd/bootstrap/app/tokenSource_test.go`
+(empty-token rejection, access-check gating); the e2e mirrors the
+kfctl deploy path (`kfctlServer.go:179-201` TokenSource injection,
+`:219-294` PLATFORM apply) against a local stand-in for
+container.googleapis.com.
+"""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.deploy.credentials import (
+    AuthTransport,
+    CloudAuthError,
+    CloudConflict,
+    CloudNotFound,
+    RefreshableTokenSource,
+    StaticTokenSource,
+    Token,
+)
+from kubeflow_tpu.deploy.gke import (
+    GkeCloud,
+    Request,
+    node_pool_create_request,
+)
+from kubeflow_tpu.deploy.kfdef import NodePool, PlatformSpec
+from kubeflow_tpu.deploy.provisioner import CloudError
+
+SPEC = PlatformSpec(
+    name="kf-test",
+    project="my-proj",
+    zone="us-central2-b",
+    node_pools=[NodePool(name="pool-a", accelerator="v5e", topology="2x4")],
+)
+
+
+# -- Token ------------------------------------------------------------------
+
+
+def test_token_validity_table():
+    now = 1000.0
+    cases = [
+        (Token("t"), True),                      # static: never expires
+        (Token("t", expiry=now + 3600), True),   # fresh
+        (Token("t", expiry=now + 30), False),    # inside the 60s skew
+        (Token("t", expiry=now - 1), False),     # expired
+        (Token("", expiry=None), False),         # empty credential
+    ]
+    for token, want in cases:
+        assert token.valid_at(now) is want, token
+
+
+# -- RefreshableTokenSource (tokenSource_test.go table) ---------------------
+
+
+def test_refresh_rejects_empty_token():
+    ts = RefreshableTokenSource("my-proj")
+    with pytest.raises(ValueError):
+        ts.refresh(Token(""))
+
+
+def test_refresh_rejects_insufficient_access_and_keeps_old():
+    """A bad push must never clobber a working credential
+    (tokenSource.go:52-64: IAM check before swap)."""
+    ts = RefreshableTokenSource(
+        "my-proj", checker=lambda project, tok: tok.access_token == "good"
+    )
+    ts.refresh(Token("good"))
+    with pytest.raises(CloudAuthError):
+        ts.refresh(Token("stolen"))
+    assert ts.token().access_token == "good"
+
+
+def test_project_is_required():
+    with pytest.raises(ValueError):
+        RefreshableTokenSource("")
+
+
+def test_token_pull_refreshes_on_expiry():
+    clock = [1000.0]
+    minted = []
+
+    def refresh_fn():
+        minted.append(1)
+        return Token(f"t{len(minted)}", expiry=clock[0] + 3600)
+
+    ts = RefreshableTokenSource(
+        "my-proj", refresh_fn=refresh_fn, clock=lambda: clock[0]
+    )
+    assert ts.token().access_token == "t1"
+    assert ts.token().access_token == "t1"  # cached while valid
+    clock[0] += 3600 - 30  # into the expiry skew
+    assert ts.token().access_token == "t2"
+    assert len(minted) == 2
+
+
+def test_token_without_refresh_raises():
+    ts = RefreshableTokenSource("my-proj")
+    with pytest.raises(CloudAuthError):
+        ts.token()
+    ts.refresh(Token("pushed"))
+    assert ts.token().access_token == "pushed"
+
+
+def test_refresh_fn_returning_expired_token_raises():
+    ts = RefreshableTokenSource(
+        "my-proj",
+        refresh_fn=lambda: Token("dead", expiry=0.0),
+        clock=lambda: 1000.0,
+    )
+    with pytest.raises(CloudAuthError):
+        ts.token()
+
+
+# -- AuthTransport ----------------------------------------------------------
+
+
+def fake_sender(script):
+    """script: list of (status, body); records (method, url, headers)."""
+    calls = []
+
+    def send(method, url, headers, body):
+        calls.append((method, url, headers, body))
+        status, resp = script[min(len(calls), len(script)) - 1]
+        return status, resp
+
+    send.calls = calls
+    return send
+
+
+def test_auth_transport_stamps_bearer_and_returns_body():
+    sender = fake_sender([(200, {"ok": True})])
+    t = AuthTransport(StaticTokenSource("sekret"), sender=sender)
+    out = t.send(Request("GET", "https://container.googleapis.com/v1/x"))
+    assert out == {"ok": True}
+    _, _, headers, _ = sender.calls[0]
+    assert headers["Authorization"] == "Bearer sekret"
+
+
+@pytest.mark.parametrize(
+    "status,exc",
+    [(401, CloudAuthError), (403, CloudAuthError), (404, CloudNotFound),
+     (409, CloudConflict), (429, CloudError), (500, CloudError),
+     (503, CloudError), (400, CloudError)],
+)
+def test_auth_transport_status_mapping(status, exc):
+    t = AuthTransport(
+        StaticTokenSource("t"), sender=fake_sender([(status, {"error": "x"})])
+    )
+    with pytest.raises(exc):
+        t.send(Request("GET", "https://container.googleapis.com/v1/x"))
+
+
+def test_auth_transport_api_base_override():
+    sender = fake_sender([(200, {})])
+    t = AuthTransport(
+        StaticTokenSource("t"), sender=sender,
+        api_base="http://127.0.0.1:9999/v1",
+    )
+    t.send(Request("GET", "https://container.googleapis.com/v1/projects/p"))
+    assert sender.calls[0][1] == "http://127.0.0.1:9999/v1/projects/p"
+
+
+def test_auth_transport_surfaces_missing_credential():
+    t = AuthTransport(
+        RefreshableTokenSource("my-proj"), sender=fake_sender([(200, {})])
+    )
+    with pytest.raises(CloudAuthError):
+        t.send(Request("GET", "https://container.googleapis.com/v1/x"))
+
+
+# -- GkeCloud ensure contracts ---------------------------------------------
+
+
+def scripted_transport(script):
+    """script: {(method, url-suffix): [(status, body), ...]} consumed in
+    order; unmatched → 200 {}."""
+    sender_calls = []
+
+    class T:
+        def send(self, request):
+            sender_calls.append(request)
+            for (method, suffix), responses in script.items():
+                if request.method == method and request.url.endswith(suffix):
+                    status, body = (
+                        responses.pop(0) if responses else (200, {})
+                    )
+                    if status == 404:
+                        raise CloudNotFound(request.url)
+                    if status == 409:
+                        raise CloudConflict(request.url)
+                    if status >= 400:
+                        raise CloudError(f"{status}")
+                    return body
+            return {}
+
+    t = T()
+    t.calls = sender_calls
+    return t
+
+
+def test_ensure_node_pool_treats_create_409_as_success():
+    """The list/create race: another apply created the pool between our
+    list and create — the documented idempotency contract."""
+    t = scripted_transport({
+        ("GET", "/nodePools"): [(200, {"nodePools": []})],
+        ("POST", "/nodePools"): [(409, {})],
+    })
+    GkeCloud(t).ensure_node_pool(SPEC, SPEC.node_pools[0])  # no raise
+
+
+def test_ensure_cluster_creates_when_missing():
+    t = scripted_transport({
+        ("GET", "/clusters/kf-test"): [(404, {})],
+    })
+    GkeCloud(t).ensure_cluster(SPEC)
+    assert [r.method for r in t.calls] == ["GET", "POST"]
+    assert t.calls[1].body["cluster"]["name"] == "kf-test"
+
+
+def test_ensure_cluster_noops_when_present():
+    t = scripted_transport({
+        ("GET", "/clusters/kf-test"): [(200, {"name": "kf-test"})],
+    })
+    GkeCloud(t).ensure_cluster(SPEC)
+    assert [r.method for r in t.calls] == ["GET"]
+
+
+def test_ensure_cluster_records_create_on_recording_transport():
+    """RecordingTransport returns {} for the GET (it can't raise 404), so
+    ensure must still record the cluster create — recorded traffic stays
+    identical to what a real transport would send on a fresh project."""
+    from kubeflow_tpu.deploy.gke import RecordingTransport
+
+    t = RecordingTransport()
+    GkeCloud(t).ensure_cluster(SPEC)
+    assert [r.method for r in t.requests] == ["GET", "POST"]
+    assert t.requests[1].url.endswith("/clusters")
+
+
+def test_ensure_cluster_treats_create_409_as_success():
+    t = scripted_transport({
+        ("GET", "/clusters/kf-test"): [(404, {})],
+        ("POST", "/clusters"): [(409, {})],
+    })
+    GkeCloud(t).ensure_cluster(SPEC)  # no raise
+
+
+# -- fake GKE server e2e ----------------------------------------------------
+
+
+class FakeGke(http.server.BaseHTTPRequestHandler):
+    """A local container.googleapis.com: clusters + nodePools CRUD with
+    scriptable first-response failures (409 on cluster create, one 500 on
+    pool create) — the retry paths the reference's deploy loop depends on
+    (kfctlServer.go:290-294)."""
+
+    state = None  # set per-test: dict(clusters=set(), pools=set(), log=[], flaky_pool_creates=N)
+
+    def _reply(self, status, body):
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+    def _record(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(length)) if length else None
+        FakeGke.state["log"].append(
+            (self.command, self.path, self.headers.get("Authorization"), body)
+        )
+        return body
+
+    def do_GET(self):
+        self._record()
+        s = FakeGke.state
+        if self.path.endswith("/nodePools"):
+            return self._reply(
+                200, {"nodePools": [{"name": p} for p in sorted(s["pools"])]}
+            )
+        name = self.path.rsplit("/", 1)[-1]
+        if name in s["clusters"]:
+            return self._reply(200, {"name": name})
+        return self._reply(404, {"error": "not found"})
+
+    def do_POST(self):
+        body = self._record()
+        s = FakeGke.state
+        if self.headers.get("Authorization") != "Bearer gcp-token":
+            return self._reply(401, {"error": "bad credentials"})
+        if self.path.endswith("/clusters"):
+            name = body["cluster"]["name"]
+            if name in s["clusters"]:
+                return self._reply(409, {"error": "already exists"})
+            s["clusters"].add(name)
+            return self._reply(200, {"name": name})
+        if self.path.endswith("/nodePools"):
+            if s["flaky_pool_creates"] > 0:
+                s["flaky_pool_creates"] -= 1
+                return self._reply(500, {"error": "backend error"})
+            s["pools"].add(body["nodePool"]["name"])
+            return self._reply(200, {})
+        return self._reply(404, {"error": "no route"})
+
+
+@pytest.fixture
+def fake_gke():
+    FakeGke.state = {
+        "clusters": set(), "pools": set(), "log": [],
+        "flaky_pool_creates": 1,
+    }
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeGke)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_port}/v1", FakeGke.state
+    server.shutdown()
+
+
+def test_deploy_apply_gke_end_to_end(fake_gke):
+    """`deploy apply --provider gke` against a live (local) GKE API:
+    bearer auth on the wire, cluster created, one 500 on pool create
+    retried to success, and a second apply no-ops (list sees the pool)."""
+    from kubeflow_tpu.deploy.apply import apply_platform
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+
+    base, state = fake_gke
+    transport = AuthTransport(
+        StaticTokenSource("gcp-token"), api_base=base
+    )
+    cloud = GkeCloud(transport)
+    spec = PlatformSpec(
+        name="kf-gke", project="my-proj", zone="us-central2-b",
+        provider="gke",
+        node_pools=[
+            NodePool(name="pool-a", accelerator="v5e", topology="2x4")
+        ],
+    )
+    api = FakeApiServer()
+    result = apply_platform(spec, api, cloud)
+    assert result.succeeded, result.error
+    assert state["clusters"] == {"kf-gke"}
+    assert state["pools"] == {"pool-a"}
+    # The flaky first create was retried: two POSTs to nodePools.
+    pool_posts = [e for e in state["log"]
+                  if e[0] == "POST" and e[1].endswith("/nodePools")]
+    assert len(pool_posts) == 2
+    # Every request carried the bearer token.
+    assert all(e[2] == "Bearer gcp-token" for e in state["log"])
+
+    # Second apply: idempotent (no new creates).
+    creates_before = len([e for e in state["log"] if e[0] == "POST"])
+    result2 = apply_platform(spec, api, cloud)
+    assert result2.succeeded
+    assert len([e for e in state["log"] if e[0] == "POST"]) == creates_before
+
+
+def test_deploy_apply_gke_rejects_bad_token(fake_gke):
+    from kubeflow_tpu.deploy.apply import apply_platform
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+
+    base, state = fake_gke
+    cloud = GkeCloud(
+        AuthTransport(StaticTokenSource("wrong"), api_base=base)
+    )
+    spec = PlatformSpec(
+        name="kf-bad", project="my-proj", zone="us-central2-b",
+        provider="gke",
+        node_pools=[
+            NodePool(name="pool-a", accelerator="v5e", topology="2x4")
+        ],
+    )
+    result = apply_platform(spec, FakeApiServer(), cloud, retries=1)
+    assert not result.succeeded
+    assert "PLATFORM phase" in result.error
+    assert state["clusters"] == set()
+
+
+def test_node_pool_request_against_urllib_sender(fake_gke):
+    """The real urllib network edge works against a live HTTP server (not
+    just the fake_sender seam)."""
+    base, state = fake_gke
+    state["flaky_pool_creates"] = 0
+    state["clusters"].add("kf-test")
+    t = AuthTransport(StaticTokenSource("gcp-token"), api_base=base)
+    out = t.send(node_pool_create_request(SPEC, SPEC.node_pools[0]))
+    assert out == {}
+    assert state["pools"] == {"pool-a"}
+
+
+def test_delete_node_pool_tolerates_missing():
+    """Teardown retries and gc must be idempotent: a 404 on delete (pool
+    already gone) is success, not a stuck deployment."""
+    t = scripted_transport({
+        ("DELETE", "/nodePools/pool-a"): [(404, {})],
+    })
+    GkeCloud(t).delete_node_pool(SPEC, "pool-a")  # no raise

@@ -138,8 +138,18 @@ def test_gke_cloud_drives_platform_phase():
     )
     result = apply_platform(spec, api, GkeCloud(transport))
     assert result.succeeded
-    creates = [r for r in transport.requests if r.method == "POST"]
-    assert [r.body["nodePool"]["name"] for r in creates] == ["a", "b"]
+    # The PLATFORM phase ensures the cluster first (recorded GET + POST),
+    # then the pools.
+    pool_creates = [
+        r for r in transport.requests
+        if r.method == "POST" and r.url.endswith("/nodePools")
+    ]
+    assert [r.body["nodePool"]["name"] for r in pool_creates] == ["a", "b"]
+    cluster_creates = [
+        r for r in transport.requests
+        if r.method == "POST" and r.url.endswith("/clusters")
+    ]
+    assert len(cluster_creates) == 1
 
 
 def test_dry_run_cli_prints_payloads(tmp_path):
@@ -189,8 +199,12 @@ def test_deploy_server_gke_provider_end_to_end():
             break
         _time.sleep(0.1)
     assert status.json()["status"]["phase"] == "Ready", status.json()
-    creates = [r for r in transport.requests if r.method == "POST"]
-    assert creates and creates[0].body["nodePool"]["name"] == "pool0"
+    pool_creates = [
+        r for r in transport.requests
+        if r.method == "POST" and r.url.endswith("/nodePools")
+    ]
+    assert pool_creates
+    assert pool_creates[0].body["nodePool"]["name"] == "pool0"
     # No Nodes materialized in-process — that's GKE's job.
     assert api.list("Node", "") == []
 
