@@ -29,9 +29,21 @@ from kubeflow_tpu.web.wsgi import serve
 def main() -> int:
     parser = argparse.ArgumentParser(prog="kubeflow-tpu-deploy")
     sub = parser.add_subparsers(dest="mode", required=True)
+    def gke_flags(p):
+        # The TokenSource slot (kfctlServer.go:179-201): a bearer token
+        # read from a file + an optional API-base override (fake GKE
+        # server in tests; the real container API by default).
+        p.add_argument("--gke-token-file", default=None,
+                       help="file holding the GCP bearer token for "
+                       "provider=gke specs")
+        p.add_argument("--gke-api-base", default=None,
+                       help="override the container API base URL "
+                       "(testing against a fake GKE server)")
+
     for mode in ("apply", "delete"):
         p = sub.add_parser(mode)
         p.add_argument("-f", "--file", required=True)
+        gke_flags(p)
         if mode == "apply":
             p.add_argument(
                 "--dry-run",
@@ -44,6 +56,14 @@ def main() -> int:
     p = sub.add_parser("serve")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8085)
+    p.add_argument(
+        "--worker-mode", choices=("thread", "process"), default="process",
+        help="per-deployment worker isolation: 'process' spawns one "
+        "worker process per deployment (the router.go:275 "
+        "kfctl-pod-per-deployment analog; crash containment + respawn "
+        "recovery), 'thread' runs applies in-process",
+    )
+    gke_flags(p)
     args = parser.parse_args()
 
     if args.mode == "generate":
@@ -53,13 +73,31 @@ def main() -> int:
     api = FakeApiServer()
     cloud = FakeCloud(api)
 
+    def gke_transport():
+        from kubeflow_tpu.deploy.credentials import transport_from_flags
+
+        return transport_from_flags(args.gke_token_file, args.gke_api_base)
+
     if args.mode == "serve":
-        server, _ = serve(DeployServer(api, cloud), host=args.host, port=args.port)
+        worker_args = []
+        if args.gke_token_file:
+            worker_args += ["--gke-token-file", args.gke_token_file]
+        if args.gke_api_base:
+            worker_args += ["--gke-api-base", args.gke_api_base]
+        deploy_server = DeployServer(
+            api, cloud, gke_transport=gke_transport(),
+            worker_mode=args.worker_mode,
+            worker_args=tuple(worker_args),
+        )
+        server, _ = serve(deploy_server, host=args.host, port=args.port)
         print(f"deploy-server: http://{args.host}:{server.server_port}")
         try:
             while True:
                 time.sleep(3600)
         except KeyboardInterrupt:
+            # Workers first: orphaned per-deployment processes would poll
+            # the dead facade forever.
+            deploy_server.shutdown_workers()
             server.shutdown()
         return 0
 
@@ -76,6 +114,10 @@ def main() -> int:
             f"resources from bundles: {', '.join(spec.applications)}"
         )
         return 0
+    if spec.provider == "gke":
+        from kubeflow_tpu.deploy.gke import GkeCloud, RecordingTransport
+
+        cloud = GkeCloud(gke_transport() or RecordingTransport())
     if args.mode == "apply":
         result = apply_platform(spec, api, cloud)
         nodes = api.list("Node", "")

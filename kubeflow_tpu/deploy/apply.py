@@ -64,15 +64,59 @@ def _retry(fn, *, what: str, retries: int = RETRIES, backoff: float = 0.0):
     raise last  # type: ignore[misc]
 
 
+def retry_rmw(
+    api,
+    kind: str,
+    name: str,
+    namespace: str,
+    mutate,
+    write,
+    *,
+    factory=None,
+    attempts: int = 10,
+) -> None:
+    """Read-modify-write with optimistic-concurrency retry — THE pattern
+    for multi-writer CRs (the deploy server, its worker processes, and
+    the apply loop all race on PlatformDeployment; each must preserve
+    fields the others own). `mutate(obj)` edits in place, `write(obj)`
+    commits (update or update_status); `factory()` (optional) supplies
+    the object when it doesn't exist yet, tolerating the create/create
+    race the same way."""
+    from kubeflow_tpu.testing.fake_apiserver import AlreadyExists, Conflict
+
+    for _ in range(attempts):
+        try:
+            obj = api.get(kind, name, namespace)
+        except NotFound:
+            if factory is None:
+                raise
+            try:
+                obj = api.create(factory())
+            except AlreadyExists:
+                continue  # lost a create/create race — re-read
+        mutate(obj)
+        try:
+            write(obj)
+            return
+        except Conflict:
+            continue
+    raise Conflict(
+        f"could not write {kind} {name!r} after {attempts} attempts"
+    )
+
+
 def _set_status(
     api: FakeApiServer, name: str, phase: str, conditions: list[dict]
 ) -> None:
-    try:
-        dep = api.get("PlatformDeployment", name, "")
-    except NotFound:
-        dep = api.create(new_resource("PlatformDeployment", name, ""))
-    dep.status = {"phase": phase, "conditions": conditions}
-    api.update_status(dep)
+    def mutate(dep):
+        dep.status = {
+            **dep.status, "phase": phase, "conditions": conditions,
+        }
+
+    retry_rmw(
+        api, "PlatformDeployment", name, "", mutate, api.update_status,
+        factory=lambda: new_resource("PlatformDeployment", name, ""),
+    )
 
 
 def apply_platform(

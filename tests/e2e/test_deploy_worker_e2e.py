@@ -1,0 +1,124 @@
+"""Deploy-worker isolation e2e — the StatefulSet-per-deployment analog.
+
+The reference's router spawns one kfctl pod per deployment
+(`router.go:275`) so a crashed apply is contained and recovered by the
+pod controller. Here the DeployServer in `worker_mode="process"` spawns
+one worker PROCESS per deployment over the secure HTTP facade; these
+tests SIGKILL a worker mid-apply and assert the babysitter respawns it
+and the deployment still converges from the PlatformDeployment CR —
+crash containment WITH state recovery.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+from kubeflow_tpu.deploy.kfdef import NodePool, PlatformSpec
+from kubeflow_tpu.deploy.provisioner import FakeCloud
+from kubeflow_tpu.deploy.server import DeployServer
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.web.wsgi import TestClient
+
+
+def _spec(name="kf-proc"):
+    return PlatformSpec(
+        name=name, project="p", zone="z",
+        node_pools=[
+            NodePool(name="pool-a", accelerator="v5e", topology="2x2"),
+        ],
+    ).to_dict()
+
+
+@pytest.fixture
+def server(monkeypatch):
+    # Widen the kill window: the worker sleeps 2s before the PLATFORM
+    # phase, so a SIGKILL at +1s always lands mid-apply.
+    monkeypatch.setenv("KFTPU_WORKER_APPLY_DELAY", "2.0")
+    api = FakeApiServer()
+    srv = DeployServer(api, FakeCloud(api), worker_mode="process")
+    yield api, srv
+    srv.shutdown_workers()
+
+
+def test_sigkill_mid_apply_respawns_and_converges(server):
+    api, srv = server
+    client = TestClient(srv)
+    resp = client.post("/kfctl/apps/v1/create", _spec())
+    assert resp.status == 200, resp.body
+
+    worker = srv._workers["kf-proc"]
+    time.sleep(1.0)  # inside the apply-delay window
+    assert worker.alive()
+    os.kill(worker.proc.pid, signal.SIGKILL)
+
+    srv.wait_idle(timeout=120)
+    assert worker.respawns >= 1
+    dep = api.get("PlatformDeployment", "kf-proc", "")
+    assert dep.status["phase"] == "Ready", dep.status
+    assert dep.status["observedGeneration"] == dep.metadata.generation
+    # The platform really materialized: the pool's host Node exists.
+    nodes = api.list("Node", "")
+    assert any(n.metadata.name.startswith("kf-proc-pool-a") for n in nodes)
+
+    status = client.get("/kfctl/apps/v1/status/kf-proc").json()
+    assert status["status"]["phase"] == "Ready"
+
+
+def test_worker_crash_does_not_touch_server_or_neighbors(server, monkeypatch):
+    """Two deployments, two workers; killing one repeatedly leaves the
+    other's apply (and the server process) untouched — the containment
+    property the per-deployment split exists for."""
+    monkeypatch.setenv("KFTPU_WORKER_APPLY_DELAY", "0")
+    api, srv = server
+    client = TestClient(srv)
+    assert client.post("/kfctl/apps/v1/create", _spec("kf-a")).status == 200
+    assert client.post("/kfctl/apps/v1/create", _spec("kf-b")).status == 200
+    victim = srv._workers["kf-a"]
+    for _ in range(2):
+        if victim.alive():
+            os.kill(victim.proc.pid, signal.SIGKILL)
+        time.sleep(0.2)
+    srv.wait_idle(timeout=120)
+    for name in ("kf-a", "kf-b"):
+        dep = api.get("PlatformDeployment", name, "")
+        assert dep.status["phase"] == "Ready", (name, dep.status)
+    assert srv._workers["kf-a"].proc.pid != srv._workers["kf-b"].proc.pid
+
+
+def test_respec_bumps_generation_and_reapplies(server, monkeypatch):
+    monkeypatch.setenv("KFTPU_WORKER_APPLY_DELAY", "0")
+    api, srv = server
+    client = TestClient(srv)
+    client.post("/kfctl/apps/v1/create", _spec())
+    srv.wait_idle(timeout=120)
+    gen1 = api.get("PlatformDeployment", "kf-proc", "").metadata.generation
+
+    spec = _spec()
+    spec["spec"]["nodePools"].append(
+        {"name": "pool-b", "accelerator": "v5e", "topology": "2x2"}
+    )
+    client.post("/kfctl/apps/v1/create", spec)
+    srv.wait_idle(timeout=120)
+    dep = api.get("PlatformDeployment", "kf-proc", "")
+    assert dep.metadata.generation > gen1
+    assert dep.status["observedGeneration"] == dep.metadata.generation
+    nodes = api.list("Node", "")
+    assert any("pool-b" in n.metadata.name for n in nodes)
+
+
+def test_gc_collects_converged_process_deployments(server, monkeypatch):
+    monkeypatch.setenv("KFTPU_WORKER_APPLY_DELAY", "0")
+    api, srv = server
+    client = TestClient(srv)
+    client.post("/kfctl/apps/v1/create", _spec())
+    srv.wait_idle(timeout=120)
+    worker = srv._workers["kf-proc"]
+    assert srv.gc_older_than(3600) == []  # too fresh once observed
+    assert srv.gc_older_than(-1) == ["kf-proc"]
+    assert "kf-proc" not in srv._workers
+    time.sleep(0.1)
+    assert not worker.alive()
+    # Its platform was torn down (gc sends deletes on the spec's provider).
+    assert api.list("Node", "") == []
