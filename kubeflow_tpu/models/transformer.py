@@ -24,6 +24,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -55,6 +56,18 @@ class TransformerConfig:
     # kernel on TPU when the shapes divide into flash blocks, else the
     # XLA-fused dense reference. "flash"/"dense" force one implementation.
     attention_impl: str = "auto"
+    # Flash kernel tile sizes (clamped to the sequence). The (1024, 1024)
+    # default is short-S-tuned; long sequences want a smaller K tile so
+    # the running (o, lse) state and K/V tiles fit VMEM together — sweep
+    # via `bench.py --workload lm --flash-block-q/-k` (docs/architecture.md
+    # records the winning configs per S).
+    flash_block_q: int = 1024
+    flash_block_k: int = 1024
+    # Backward-pass tiles (None = same as forward). The bwd kernels carry
+    # two extra f32 VMEM accumulators, so wide fwd tiles can pair with
+    # safer bwd tiles.
+    flash_block_q_bwd: int | None = None
+    flash_block_k_bwd: int | None = None
     # MoE: 0 experts = dense MLP. Top-1 (switch) routing with capacity.
     num_experts: int = 0
     capacity_factor: float = 1.25
@@ -70,6 +83,19 @@ def _block_cls(cfg: "TransformerConfig"):
             Block,
             static_argnums=(),
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    if cfg.remat_policy == "attn":
+        # Long-context policy: save ONLY the attention outputs across the
+        # block checkpoint. The flash kernel is the expensive recompute
+        # (O(S²·d) with its own softmax pass) while its output is small
+        # (O(S·d)) — the classic save-what's-costly-and-small trade.
+        # Everything else (norms, MLP) recomputes as under "full".
+        return nn.remat(
+            Block,
+            static_argnums=(),
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out"
+            ),
         )
     if cfg.remat_policy != "full":
         raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
@@ -121,7 +147,7 @@ def rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
-def _attend(q, k, v, mesh: Mesh | None, impl: str):
+def _attend(q, k, v, mesh: Mesh | None, cfg: "TransformerConfig"):
     """Dispatch: ring when the sp axis is real, else flash/dense.
 
     The flash kernel is a Pallas call, which does not auto-partition under
@@ -129,6 +155,8 @@ def _attend(q, k, v, mesh: Mesh | None, impl: str):
     (embarrassingly parallel: each shard attends over its own batch rows and
     heads; the sequence axis is unsharded on this path).
     """
+    impl = cfg.attention_impl
+    bq, bk = cfg.flash_block_q, cfg.flash_block_k
     if impl not in ("auto", "flash", "dense"):
         raise ValueError(
             f"unknown attention_impl {impl!r}; expected 'auto', 'flash', "
@@ -143,16 +171,18 @@ def _attend(q, k, v, mesh: Mesh | None, impl: str):
         if (
             impl in ("auto", "flash")
             and jax.default_backend() == "tpu"
-            and flash_usable(chunk, chunk)
+            and flash_usable(chunk, chunk, bq, bk)
         ):
             from kubeflow_tpu.ops.flash import ring_flash_attention
 
-            return ring_flash_attention(q, k, v, mesh, causal=True)
+            return ring_flash_attention(
+                q, k, v, mesh, causal=True, block_q=bq, block_k=bk
+            )
         return ring_attention(q, k, v, mesh, causal=True)
     use_flash = impl == "flash" or (
         impl == "auto"
         and jax.default_backend() == "tpu"
-        and flash_usable(q.shape[1], k.shape[1])
+        and flash_usable(q.shape[1], k.shape[1], bq, bk)
     )
     if use_flash and mesh is not None:
         # The shard_map wrapper needs batch % (dp·fsdp) == 0 and
@@ -173,13 +203,21 @@ def _attend(q, k, v, mesh: Mesh | None, impl: str):
             use_flash = False
     if not use_flash:
         return dense_attention(q, k, v, causal=True)
+    bwd = {
+        "bwd_block_q": cfg.flash_block_q_bwd,
+        "bwd_block_k": cfg.flash_block_k_bwd,
+    }
     if mesh is None:
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk, **bwd
+        )
 
     heads = "tp" if mesh.shape.get("tp", 1) > 1 else None
     spec = P(batch_axes(mesh), None, heads, None)
     return shard_map(
-        functools.partial(flash_attention, causal=True),
+        functools.partial(
+            flash_attention, causal=True, block_q=bq, block_k=bk, **bwd
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -200,7 +238,9 @@ class Attention(nn.Module):
         v = _dense((h, d), ("embed", "heads", "kv"), "wv", cfg.dtype)(x)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        out = _attend(q, k, v, self.mesh, cfg.attention_impl)
+        # Named so the "attn" remat policy can pin exactly this value as
+        # the saved residual (everything else in the block recomputes).
+        out = checkpoint_name(_attend(q, k, v, self.mesh, cfg), "attn_out")
         out = nn.DenseGeneral(
             cfg.d_model,
             axis=(-2, -1),

@@ -239,11 +239,33 @@ def _pick_block(block: int, s: int) -> int:
     )
 
 
-def _qkv_specs(bq: int, bk: int, d: int):
+def _clamp_j(i, j, bq: int, bk: int, causal: bool):
+    """K-block index for grid step (i, j). Under causality, blocks
+    strictly above the diagonal are compute-skipped (`pl.when(run)`), but
+    Pallas would still DMA their K/V tiles; clamping the index to the
+    diagonal makes every skipped step re-address the block the previous
+    step already holds, so Mosaic elides the copy — the skipped half of
+    the grid costs neither FLOPs nor HBM traffic (the long-context win)."""
+    if not causal:
+        return j
+    return jnp.minimum(j, (i * bq + bq - 1) // bk)
+
+
+def _clamp_i(i, j, bq: int, bk: int, causal: bool):
+    """Q-block index for the dk/dv grid (i inner, ascending): steps below
+    the first unmasked q block are compute-skipped; clamping them onto
+    that first block elides their DMAs the same way."""
+    if not causal:
+        return i
+    return jnp.maximum(i, (j * bk) // bq)
+
+
+def _qkv_specs(bq: int, bk: int, d: int, causal: bool):
+    kv = lambda b, i, j: (b, _clamp_j(i, j, bq, bk, causal), 0)
     return [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), kv),
+        pl.BlockSpec((1, bk, d), kv),
     ]
 
 
@@ -262,7 +284,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, sq // bq, sk // bk),
-        in_specs=_qkv_specs(bq, bk, d),
+        in_specs=_qkv_specs(bq, bk, d, causal),
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
@@ -296,16 +318,18 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     bk = _pick_block(block_k, sk)
     scale = 1.0 / math.sqrt(d)
 
-    def _common_specs(order):
-        # order maps grid positions → (q_block_idx, k_block_idx)
+    def _common_specs(qidx, kidx):
+        # qidx/kidx map grid positions (x, y) → block indices, with the
+        # causal clamp folded in so compute-skipped steps re-address the
+        # previous step's block and their DMAs are elided (see _clamp_j).
         return [
-            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, order(x, y)[0], 0)),
-            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, order(x, y)[1], 0)),
-            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, order(x, y)[1], 0)),
-            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, order(x, y)[0], 0)),
-            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, order(x, y)[0], 0)),
+            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, qidx(x, y), 0)),
+            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, kidx(x, y), 0)),
+            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, kidx(x, y), 0)),
+            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, qidx(x, y), 0)),
+            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, qidx(x, y), 0)),
             pl.BlockSpec(
-                (1, bq, _LANES), lambda b, x, y: (b, order(x, y)[0], 0)
+                (1, bq, _LANES), lambda b, x, y: (b, qidx(x, y), 0)
             ),
         ]
 
@@ -314,7 +338,10 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
             _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk
         ),
         grid=(bh, sq // bq, sk // bk),
-        in_specs=_common_specs(lambda i, j: (i, j)),
+        in_specs=_common_specs(
+            lambda i, j: i,
+            lambda i, j: _clamp_j(i, j, bq, bk, causal),
+        ),
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[
@@ -329,7 +356,10 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
             _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk
         ),
         grid=(bh, sk // bk, sq // bq),
-        in_specs=_common_specs(lambda j, i: (i, j)),
+        in_specs=_common_specs(
+            lambda j, i: _clamp_i(i, j, bq, bk, causal),
+            lambda j, i: j,
+        ),
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -347,21 +377,24 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                interpret):
     o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, bwd_block_q,
+                   bwd_block_k, interpret):
     o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, do):
+def _flash_vjp_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                   interpret, residuals, do):
     q, k, v, o, lse = residuals
     return _flash_bwd_impl(
-        q, k, v, o, lse, do, causal, block_q, block_k, interpret
+        q, k, v, o, lse, do, causal, bwd_block_q, bwd_block_k, interpret
     )
 
 
@@ -376,6 +409,8 @@ def flash_attention(
     causal: bool = True,
     block_q: int = 1024,
     block_k: int = 1024,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Blockwise attention on the MXU. q, k, v: [B, S, H, D] → [B, S, H, D].
@@ -397,8 +432,12 @@ def flash_attention(
     # [B, S, H, D] → [B*H, S, D]: head-major layout keeps each grid step's
     # blocks contiguous in HBM.
     to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    # The backward kernels carry bigger VMEM footprints (two extra f32
+    # accumulators), so wide forward tiles can be paired with safer
+    # backward tiles; default = same blocks both ways.
     o = _flash_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q, block_k, interp
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q, block_k,
+        bwd_block_q or block_q, bwd_block_k or block_k, interp
     )
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
