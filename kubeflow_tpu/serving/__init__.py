@@ -11,7 +11,14 @@ compiles one program per bucket instead of one per request size, and the
 hot path is a single jitted apply on device.
 """
 
+from kubeflow_tpu.serving.batching import BatchingConfig, BatchingQueue
 from kubeflow_tpu.serving.servable import Servable
 from kubeflow_tpu.serving.server import ModelRepository, ModelServerApp
 
-__all__ = ["ModelRepository", "ModelServerApp", "Servable"]
+__all__ = [
+    "BatchingConfig",
+    "BatchingQueue",
+    "ModelRepository",
+    "ModelServerApp",
+    "Servable",
+]

@@ -28,13 +28,27 @@ def main() -> None:
         help="serve an orbax checkpoint as /v1/models/NAME (repeatable)",
     )
     parser.add_argument("--max-batch", type=int, default=64)
+    parser.add_argument(
+        "--batch-timeout-ms",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="enable cross-request dynamic batching with this flush "
+        "window (the TF-Serving batch_timeout_micros analog); "
+        "concurrent requests merge into one accelerator execution",
+    )
     args = parser.parse_args()
 
     import jax
     import numpy as np
 
     from kubeflow_tpu.models.resnet import resnet50, tiny_resnet
-    from kubeflow_tpu.serving import ModelRepository, ModelServerApp, Servable
+    from kubeflow_tpu.serving import (
+        BatchingConfig,
+        ModelRepository,
+        ModelServerApp,
+        Servable,
+    )
     from kubeflow_tpu.web.wsgi import serve
 
     servables = []
@@ -66,7 +80,14 @@ def main() -> None:
             )
         )
 
-    app = ModelServerApp(ModelRepository(servables))
+    batching = (
+        BatchingConfig(
+            max_batch=args.max_batch, timeout_ms=args.batch_timeout_ms
+        )
+        if args.batch_timeout_ms is not None
+        else None
+    )
+    app = ModelServerApp(ModelRepository(servables), batching=batching)
     server, thread = serve(app, host=args.host, port=args.port)
     logging.info(
         "model server on :%d serving %s",

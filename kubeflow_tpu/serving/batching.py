@@ -1,0 +1,218 @@
+"""Cross-request dynamic batching — the TF-Serving batcher analog.
+
+The reference deploys TF-Serving for inference (`docs_dev/tf_serving.md`,
+`testing/test_tf_serving.py`), whose signature capability is the batching
+scheduler: concurrent small requests are merged into one accelerator
+execution (`max_batch_size` + `batch_timeout_micros`) because a TPU/GPU
+step at batch 1 leaves the matrix units nearly idle — batch-64 ResNet-50
+inference measures ~24x the throughput of batch-1 on v5e
+(`bench.py --workload serving`). `BatchingQueue` is that scheduler for
+our servables:
+
+- callers block in `predict()` while their instances join the pending
+  batch;
+- a scheduler thread flushes when the batch fills (`max_batch`) or the
+  OLDEST entry has waited `timeout_ms` (latency bound, TF-Serving's
+  `batch_timeout_micros`);
+- each flush groups entries by per-instance signature (shape, dtype)
+  and runs one `Servable.predict` per group (the servable's own bucket
+  padding handles the ragged tail); each caller gets exactly its rows
+  back, and a failed execution propagates only to the callers of its
+  own group — a malformed-shape request can't fail innocent neighbors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchingConfig:
+    """TF-Serving batching knobs (batching_config.txt analog)."""
+
+    max_batch: int = 64
+    timeout_ms: float = 5.0
+    # Backpressure: pending instances beyond this reject immediately
+    # (TF-Serving's max_enqueued_batches) instead of growing the queue
+    # unboundedly under overload.
+    max_pending: int = 1024
+
+
+class _Entry:
+    __slots__ = ("instances", "event", "result", "error", "arrived")
+
+    def __init__(self, instances: np.ndarray):
+        self.instances = instances
+        self.event = threading.Event()
+        self.result: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.arrived = time.monotonic()
+
+
+class QueueFull(RuntimeError):
+    """Backpressure signal (callers map it to HTTP 429/503)."""
+
+
+class QueueClosed(RuntimeError):
+    """The queue was shut down (e.g. its servable version was reloaded);
+    a retry against a fresh queue is expected to succeed."""
+
+
+class BatchingQueue:
+    """Thread-safe dynamic batcher over one servable."""
+
+    def __init__(
+        self,
+        servable,
+        config: BatchingConfig | None = None,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.servable = servable
+        self.config = config or BatchingConfig()
+        metrics = metrics or MetricsRegistry()
+        self.batches_total = metrics.counter(
+            "serving_batches_total", "accelerator executions", ("model",)
+        )
+        self.batched_instances_total = metrics.counter(
+            "serving_batched_instances_total",
+            "instances served through the batcher",
+            ("model",),
+        )
+        self.rejected_total = metrics.counter(
+            "serving_batch_rejected_total",
+            "requests rejected by backpressure",
+            ("model",),
+        )
+        self._cv = threading.Condition()
+        self._pending: list[_Entry] = []
+        self._pending_count = 0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop,
+            name=f"batcher-{servable.name}-v{servable.version}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- caller side -------------------------------------------------------
+
+    def predict(self, instances: Sequence) -> np.ndarray:
+        batch = np.asarray(instances)
+        if batch.shape[0] == 0:
+            raise ValueError("empty instances")
+        entry = _Entry(batch)
+        with self._cv:
+            if self._closed:
+                raise QueueClosed(
+                    f"batching queue for {self.servable.name!r} is closed"
+                )
+            # Backpressure gates on what's ALREADY queued, not the new
+            # request's own size — an oversized request on an idle server
+            # must be admitted (the servable chunks it), or its retries
+            # would fail forever.
+            if self._pending_count >= self.config.max_pending:
+                self.rejected_total.inc(model=self.servable.name)
+                raise QueueFull(
+                    f"batching queue for {self.servable.name!r} is full "
+                    f"({self._pending_count} pending)"
+                )
+            self._pending.append(entry)
+            self._pending_count += batch.shape[0]
+            self._cv.notify_all()
+        entry.event.wait()
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    def close(self) -> None:
+        """Flush and stop; in-flight callers complete, later ones error."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30)
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _take_batch(self) -> list[_Entry]:
+        """Block until a flush is due; returns the entries to run (empty
+        only when closing). Flush when pending fills max_batch, or the
+        oldest entry's deadline passes, or the queue is closing (drain)."""
+        timeout = self.config.timeout_ms / 1000.0
+        with self._cv:
+            while True:
+                if self._pending and (
+                    self._closed
+                    or self._pending_count >= self.config.max_batch
+                ):
+                    return self._cut()
+                if not self._pending:
+                    if self._closed:
+                        return []
+                    self._cv.wait()
+                    continue
+                # Entries pending but batch not full: the window closes
+                # `timeout` after the OLDEST entry arrived — a steady
+                # trickle of arrivals must not extend the oldest caller's
+                # wait indefinitely.
+                remaining = self._pending[0].arrived + timeout - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    return self._cut()
+
+    def _cut(self) -> list[_Entry]:
+        take: list[_Entry] = []
+        count = 0
+        while self._pending:
+            nxt = self._pending[0]
+            n = nxt.instances.shape[0]
+            if take and count + n > self.config.max_batch:
+                break  # next entry rides the following flush
+            take.append(self._pending.pop(0))
+            count += n
+            if count >= self.config.max_batch:
+                break
+        self._pending_count -= count
+        return take
+
+    def _loop(self) -> None:
+        while True:
+            entries = self._take_batch()
+            if not entries:
+                return  # closed and drained
+            # Group by per-instance signature (shape-sans-batch, dtype):
+            # requests only merge with compatible neighbors (TF-Serving
+            # batches per signature too), so one client's odd-shaped
+            # input can neither break the concatenate nor fail innocent
+            # requests sharing the flush.
+            groups: dict = {}
+            for entry in entries:
+                key = (entry.instances.shape[1:], entry.instances.dtype.str)
+                groups.setdefault(key, []).append(entry)
+            for group in groups.values():
+                self._run_group(group)
+
+    def _run_group(self, group: list[_Entry]) -> None:
+        try:
+            merged = np.concatenate([e.instances for e in group], axis=0)
+            out = self.servable.predict(merged)
+        except BaseException as e:  # propagate to THIS group only
+            for entry in group:
+                entry.error = e
+                entry.event.set()
+            return
+        self.batches_total.inc(model=self.servable.name)
+        self.batched_instances_total.inc(
+            merged.shape[0], model=self.servable.name
+        )
+        offset = 0
+        for entry in group:
+            n = entry.instances.shape[0]
+            entry.result = out[offset:offset + n]
+            offset += n
+            entry.event.set()

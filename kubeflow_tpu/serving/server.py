@@ -13,6 +13,7 @@ import logging
 import threading
 from typing import Iterable
 
+from kubeflow_tpu.serving.batching import BatchingQueue, QueueClosed, QueueFull
 from kubeflow_tpu.serving.servable import Servable
 from kubeflow_tpu.utils.metrics import MetricsRegistry
 from kubeflow_tpu.web import (
@@ -96,9 +97,16 @@ class ModelServerApp(App):
         repository: ModelRepository,
         *,
         metrics: MetricsRegistry | None = None,
+        batching=None,
     ):
+        """`batching`: a `serving.BatchingConfig` turns on the TF-Serving
+        batching-scheduler analog — concurrent requests merge into one
+        accelerator execution per flush (`serving/batching.py`)."""
         super().__init__("model-server")
         self.repository = repository
+        self._batching = batching
+        self._batchers: dict = {}
+        self._batcher_lock = threading.Lock()
         metrics = metrics or MetricsRegistry()
         self.request_count = metrics.counter(
             "serving_requests_total", "predict requests", ("model", "outcome")
@@ -187,9 +195,19 @@ class ModelServerApp(App):
             self.request_count.inc(model=name, outcome="invalid")
             raise HttpError(400, "body must have a non-empty 'instances' list")
         try:
-            predictions = model.predict(instances)
+            try:
+                predictions = self._predictor(model)(instances)
+            except QueueClosed:
+                # Raced a version reload: the stale queue closed between
+                # lookup and predict. One retry hits the fresh queue.
+                predictions = self._predictor(model)(instances)
         except HttpError:
             raise
+        except QueueFull as e:
+            # Backpressure (TF-Serving's max_enqueued_batches): tell the
+            # client to retry rather than queueing unboundedly.
+            self.request_count.inc(model=name, outcome="overload")
+            raise HttpError(429, str(e)) from None
         except Exception as e:
             import jax
 
@@ -207,6 +225,37 @@ class ModelServerApp(App):
             raise HttpError(400, f"bad instances: {e}") from None
         self.request_count.inc(model=name, outcome="ok")
         return json_response({"predictions": predictions.tolist()})
+
+    def _predictor(self, model):
+        """model.predict, or its batching queue when batching is on
+        (lazily built per live servable; a reloaded version gets a fresh
+        queue and the stale one is drained + closed)."""
+        if self._batching is None:
+            return model.predict
+        key = (model.name, model.version)
+        stale = None
+        with self._batcher_lock:
+            queue = self._batchers.get(key)
+            if queue is None or queue.servable is not model:
+                stale = queue
+                queue = self._batchers[key] = BatchingQueue(
+                    model, self._batching, metrics=self._metrics_registry
+                )
+        if stale is not None:
+            # Drain the replaced queue off the request path — its close()
+            # joins the scheduler through the remaining device work.
+            threading.Thread(
+                target=stale.close, name="batcher-drain", daemon=True
+            ).start()
+        return queue.predict
+
+    def close_batchers(self) -> None:
+        """Drain and stop every batching queue (server shutdown)."""
+        with self._batcher_lock:
+            queues = list(self._batchers.values())
+            self._batchers.clear()
+        for queue in queues:
+            queue.close()
 
     def metrics_text(self, req: Request) -> Response:
         return Response(

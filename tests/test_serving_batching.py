@@ -1,0 +1,278 @@
+"""Dynamic batching scheduler — the TF-Serving batcher analog.
+
+The reference's serving story leans on TF-Serving, whose batching
+scheduler merges concurrent requests into one accelerator execution
+(`docs_dev/tf_serving.md` deploys it; batch-1 inference leaves the MXU
+nearly idle). These tests pin the scheduler semantics on
+`serving.BatchingQueue`: concurrent callers share one execution, each
+gets exactly its rows, the timeout bounds latency, errors stay inside
+their flush, and backpressure rejects instead of queueing unboundedly.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import (
+    BatchingConfig,
+    BatchingQueue,
+    ModelRepository,
+    ModelServerApp,
+)
+from kubeflow_tpu.serving.batching import QueueFull
+from kubeflow_tpu.web import TestClient
+
+
+class CountingServable:
+    """Identity 'model' that records every underlying execution."""
+
+    name = "ident"
+    version = 1
+
+    def __init__(self, fail_batches=()):
+        self.calls: list[int] = []
+        self.fail_batches = set(fail_batches)
+        self._lock = threading.Lock()
+
+    def predict(self, instances):
+        batch = np.asarray(instances)
+        with self._lock:
+            self.calls.append(batch.shape[0])
+            if len(self.calls) - 1 in self.fail_batches:
+                raise RuntimeError("injected device fault")
+        return batch * 2.0
+
+
+def _concurrent(queue, inputs):
+    """Submit each input from its own thread; return results in order."""
+    results = [None] * len(inputs)
+    errors = [None] * len(inputs)
+
+    def call(i):
+        try:
+            results[i] = queue.predict(inputs[i])
+        except BaseException as e:
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=call, args=(i,)) for i in range(len(inputs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return results, errors
+
+
+def test_concurrent_singles_share_one_execution():
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=8, timeout_ms=50.0)
+    )
+    try:
+        inputs = [np.full((1, 4), float(i)) for i in range(8)]
+        results, errors = _concurrent(queue, inputs)
+        assert errors == [None] * 8
+        # Everyone got exactly their own rows back.
+        for i, out in enumerate(results):
+            np.testing.assert_array_equal(out, np.full((1, 4), 2.0 * i))
+        # ...via far fewer device executions than callers (a full batch
+        # flushes as one; stragglers may ride a second flush).
+        assert len(model.calls) <= 2, model.calls
+        assert sum(model.calls) == 8
+    finally:
+        queue.close()
+
+
+def test_timeout_flushes_partial_batch():
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=64, timeout_ms=30.0)
+    )
+    try:
+        t0 = time.monotonic()
+        out = queue.predict(np.ones((2, 3)))
+        elapsed = time.monotonic() - t0
+        np.testing.assert_array_equal(out, 2 * np.ones((2, 3)))
+        # Flushed by the window, not by filling 64.
+        assert elapsed < 5.0
+        assert model.calls == [2]
+    finally:
+        queue.close()
+
+
+def test_multi_instance_requests_batch_and_split():
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=8, timeout_ms=50.0)
+    )
+    try:
+        inputs = [np.full((n, 2), float(n)) for n in (3, 2, 3)]
+        results, errors = _concurrent(queue, inputs)
+        assert errors == [None] * 3
+        for n, out in zip((3, 2, 3), results):
+            assert out.shape == (n, 2)
+            np.testing.assert_array_equal(out, np.full((n, 2), 2.0 * n))
+        assert sum(model.calls) == 8
+    finally:
+        queue.close()
+
+
+def test_error_contained_to_its_flush():
+    model = CountingServable(fail_batches={0})
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=4, timeout_ms=20.0)
+    )
+    try:
+        _, errors = _concurrent(
+            queue, [np.ones((1, 2)) for _ in range(4)]
+        )
+        assert all(isinstance(e, RuntimeError) for e in errors)
+        # The queue survives: the NEXT flush succeeds.
+        out = queue.predict(np.ones((1, 2)))
+        np.testing.assert_array_equal(out, 2 * np.ones((1, 2)))
+    finally:
+        queue.close()
+
+
+def test_backpressure_rejects_when_full():
+    gate = threading.Event()
+
+    class SlowServable(CountingServable):
+        def predict(self, instances):
+            gate.wait(10)
+            return super().predict(instances)
+
+    model = SlowServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=2, timeout_ms=1.0, max_pending=4)
+    )
+    try:
+        # Fill the in-flight flush (2) + the pending queue (4), then one
+        # more must bounce.
+        threads = []
+        for _ in range(6):
+            t = threading.Thread(
+                target=lambda: queue.predict(np.ones((1, 1)))
+            )
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 5
+        while queue._pending_count < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(QueueFull):
+            queue.predict(np.ones((1, 1)))
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        gate.set()
+        queue.close()
+
+
+def test_oversized_request_passes_through():
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=4, timeout_ms=5.0, max_pending=64)
+    )
+    try:
+        out = queue.predict(np.ones((11, 2)))
+        assert out.shape == (11, 2)
+    finally:
+        queue.close()
+
+
+def test_server_routes_predict_through_batcher():
+    """HTTP tier: concurrent posts to :predict share executions, and the
+    batcher's metrics are exposed on /metrics."""
+    model = CountingServable()
+    repo = ModelRepository([model])
+    app = ModelServerApp(
+        repo, batching=BatchingConfig(max_batch=8, timeout_ms=50.0)
+    )
+    client = TestClient(app)
+    try:
+        outs = [None] * 8
+
+        def post(i):
+            outs[i] = client.post(
+                "/v1/models/ident:predict",
+                {"instances": [[float(i), 0.0]]},
+            )
+
+        threads = [
+            threading.Thread(target=post, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for i, resp in enumerate(outs):
+            assert resp.status == 200, resp.body
+            assert resp.json()["predictions"] == [[2.0 * i, 0.0]]
+        assert len(model.calls) <= 2, model.calls
+        metrics = client.get("/metrics").body.decode()
+        assert "serving_batches_total" in metrics
+    finally:
+        app.close_batchers()
+
+
+def test_server_without_batching_is_direct():
+    model = CountingServable()
+    app = ModelServerApp(ModelRepository([model]))
+    client = TestClient(app)
+    assert client.post(
+        "/v1/models/ident:predict", {"instances": [[1.0]]}
+    ).status == 200
+    assert model.calls == [1]
+
+
+def test_mixed_signatures_grouped_not_failed():
+    """A flush holding incompatible shapes runs one execution per
+    signature group — a client's odd shape never fails its neighbors
+    (TF-Serving batches per signature the same way)."""
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=8, timeout_ms=50.0)
+    )
+    try:
+        inputs = [
+            np.ones((1, 2)), np.ones((1, 3)), np.ones((1, 2)) * 5,
+        ]
+        results, errors = _concurrent(queue, inputs)
+        assert errors == [None] * 3, errors
+        assert results[0].shape == (1, 2)
+        assert results[1].shape == (1, 3)
+        np.testing.assert_array_equal(results[2], np.full((1, 2), 10.0))
+        # Two signature groups → at most 2 executions (maybe split by
+        # timing, but never a crash or cross-failure).
+        assert sum(model.calls) == 3
+    finally:
+        queue.close()
+
+
+def test_oversized_request_admitted_when_idle():
+    """Backpressure gates on what's already queued: a request larger
+    than max_pending on an idle server is admitted and chunked, not
+    bounced into a futile retry loop."""
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=4, timeout_ms=5.0, max_pending=8)
+    )
+    try:
+        out = queue.predict(np.ones((20, 2)))
+        assert out.shape == (20, 2)
+    finally:
+        queue.close()
+
+
+def test_closed_queue_raises_queue_closed():
+    from kubeflow_tpu.serving.batching import QueueClosed
+
+    model = CountingServable()
+    queue = BatchingQueue(model, BatchingConfig(timeout_ms=1.0))
+    queue.close()
+    with pytest.raises(QueueClosed):
+        queue.predict(np.ones((1, 1)))
