@@ -36,8 +36,11 @@ class TokenRegistry:
         self._lock = threading.Lock()
 
     def issue(self, user: str) -> str:
-        """Mint a fresh opaque token for `user` and return it."""
-        token = secrets.token_urlsafe(24)
+        """Mint a fresh opaque token for `user` and return it. The fixed
+        prefix guarantees tokens never start with '-' (token_urlsafe can,
+        and `--token <value>` through any argparse CLI would then parse
+        the credential as an option flag)."""
+        token = "kt-" + secrets.token_urlsafe(24)
         with self._lock:
             self._tokens[token] = user
         return token
