@@ -39,6 +39,11 @@ class TpuJobSpec:
     num_slices: int = 1
     max_restarts: int = 3
     checkpoint_dir: str = ""
+    # Gang priority (the PriorityClass analog, flattened to an int):
+    # when chips are scarce, a pending gang may PREEMPT running gangs of
+    # strictly lower priority in its pool (whole gangs — all-or-nothing
+    # both ways). 0 = default; negative = preemptible batch tier.
+    priority: int = 0
 
     def validate(self) -> None:
         if self.replicas < 1:
@@ -67,6 +72,7 @@ class TpuJobSpec:
             },
             "maxRestarts": self.max_restarts,
             "checkpointDir": self.checkpoint_dir,
+            "priority": self.priority,
         }
 
     @classmethod
@@ -85,6 +91,7 @@ class TpuJobSpec:
             num_slices=tpu.get("numSlices", 1),
             max_restarts=d.get("maxRestarts", 3),
             checkpoint_dir=d.get("checkpointDir", ""),
+            priority=int(d.get("priority", 0)),
         )
         spec.validate()
         return spec

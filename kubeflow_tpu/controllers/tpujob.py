@@ -21,6 +21,7 @@ transitions in between).
 from __future__ import annotations
 
 import logging
+import time
 
 from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
 from kubeflow_tpu.api.tpujob import COORDINATOR_PORT, KIND, TpuJobSpec
@@ -162,12 +163,19 @@ class TpuJobController:
 
     # -- native placement -------------------------------------------------
 
-    def _build_scheduler(self, api: FakeApiServer, placing_job: str):
+    def _build_scheduler(
+        self,
+        api: FakeApiServer,
+        placing_job: str,
+        exclude: frozenset[str] = frozenset(),
+    ):
         """Construct a fresh native scheduler from OBSERVED state — current
         Nodes plus reservations implied by live pods' nodeName — for one
         placement decision. No long-lived mirror: deleted/recreated nodes,
         spec edits, and operator restarts can't desynchronize what doesn't
-        persist. Returns None when the cluster model has no Nodes."""
+        persist. `exclude` drops additional gangs' reservations (preemption
+        what-if planning). Returns None when the cluster model has no
+        Nodes."""
         nodes = api.list("Node")
         if not nodes:
             return None
@@ -192,8 +200,8 @@ class TpuJobController:
                 continue
             owner = pod.metadata.labels.get(LABEL_JOB, "")
             gang = f"{pod.metadata.namespace}/{owner}"
-            if gang == placing_job:
-                continue  # our own stale pods are being replaced
+            if gang == placing_job or gang in exclude:
+                continue  # replaced (own stale pods) or hypothetically evicted
             limits = (
                 pod.spec.get("containers", [{}])[0]
                 .get("resources", {})
@@ -201,6 +209,144 @@ class TpuJobController:
             )
             sched.reserve(gang, node, int(limits.get("google.com/tpu", 0)))
         return sched
+
+    # -- preemption -------------------------------------------------------
+
+    def _preempt_for(self, api, job, spec: TpuJobSpec) -> bool:
+        """Evict lower-priority gangs so `job` can place; True if anything
+        was preempted (caller requeues and retries placement).
+
+        Victim selection follows the kube-scheduler's rules at gang
+        granularity: only gangs of STRICTLY lower priority in the same
+        pool qualify; lowest priority evicts first (youngest first within
+        a tier, so the longest-running work survives); and no victim is
+        touched unless a what-if PLACEMENT with those reservations
+        removed actually succeeds — chip arithmetic alone would evict for
+        capacity that is fragmented across nodes and still leave the
+        preemptor Unschedulable, pure disruption. A preemption is NOT a failure: victims
+        return to Pending with their restart budget intact and reschedule
+        when capacity frees up."""
+        if spec.replicas * spec.tpu_chips_per_worker <= 0:
+            return False
+
+        # One pod scan aggregates every gang's held chips (the same
+        # extraction _build_scheduler does) — O(pods), not O(jobs*pods).
+        held_by_gang: dict[str, int] = {}
+        for pod in api.list("Pod"):
+            if not pod.spec.get("nodeName") or pod.status.get("phase") in (
+                "Succeeded", "Failed"
+            ):
+                continue
+            gang = (
+                f"{pod.metadata.namespace}/"
+                f"{pod.metadata.labels.get(LABEL_JOB, '')}"
+            )
+            limits = (
+                pod.spec.get("containers", [{}])[0]
+                .get("resources", {})
+                .get("limits", {})
+            )
+            held_by_gang[gang] = held_by_gang.get(gang, 0) + int(
+                limits.get("google.com/tpu", 0)
+            )
+
+        candidates = []
+        for other in api.list(KIND):
+            if (
+                other.metadata.uid == job.metadata.uid
+                or other.status.get("phase") in ("Succeeded", "Failed")
+            ):
+                continue
+            try:
+                other_spec = TpuJobSpec.from_dict(other.spec)
+            except Exception:
+                continue
+            if (
+                other_spec.priority >= spec.priority
+                or other_spec.topology != spec.topology
+            ):
+                continue
+            gang = f"{other.metadata.namespace}/{other.metadata.name}"
+            if held_by_gang.get(gang, 0) > 0:
+                candidates.append((other_spec.priority, other, gang))
+        # Lowest priority first; youngest first within a tier.
+        candidates.sort(
+            key=lambda c: (
+                c[0], -(c[1].metadata.creation_timestamp or 0)
+            )
+        )
+
+        # Grow the victim set until the gang actually PLACES on a what-if
+        # scheduler with those reservations removed — aggregate chip
+        # counts aren't enough (freed chips fragmented across nodes can
+        # leave the preemptor Unschedulable anyway, and evicting for that
+        # would be pure disruption).
+        gang_id = f"{job.metadata.namespace}/{job.metadata.name}"
+        victims: list = []
+        excluded: set[str] = set()
+        feasible = False
+        for _, victim, gang in candidates:
+            victims.append(victim)
+            excluded.add(gang)
+            trial = self._build_scheduler(
+                api, gang_id, exclude=frozenset(excluded)
+            )
+            if trial is None:
+                return False
+            from kubeflow_tpu.native import PlacementError
+
+            try:
+                trial.place_gang(
+                    gang_id, spec.topology, spec.replicas,
+                    spec.tpu_chips_per_worker,
+                )
+                feasible = True
+                break
+            except PlacementError:
+                continue
+        if not feasible:
+            return False  # even evicting every lower tier won't unblock
+
+        for victim in victims:
+            vns = victim.metadata.namespace
+            for pod in api.list(
+                "Pod", vns, label_selector={LABEL_JOB: victim.metadata.name}
+            ):
+                try:
+                    api.delete("Pod", pod.metadata.name, vns)
+                except NotFound:
+                    pass
+            api.record_event(
+                victim,
+                "Preempted",
+                f"evicted by higher-priority gang "
+                f"{job.metadata.namespace}/{job.metadata.name} "
+                f"(priority {spec.priority})",
+                type_="Warning",
+            )
+            # The victim may be deleted (or its controller writing) while
+            # we evict — a vanished victim is simply a freed one.
+            from kubeflow_tpu.testing.fake_apiserver import Conflict
+
+            for _ in range(3):
+                try:
+                    fresh = api.get(KIND, victim.metadata.name, vns)
+                except NotFound:
+                    break
+                fresh.status["phase"] = "Pending"
+                fresh.status["reason"] = "Preempted"
+                try:
+                    api.update_status(fresh)
+                    break
+                except Conflict:
+                    continue
+        api.record_event(
+            job,
+            "PreemptedLowerPriority",
+            f"evicted {len(victims)} gang(s) "
+            f"({sum(held_by_gang.get(g, 0) for g in excluded)} chips)",
+        )
+        return True
 
     # -- reconcile --------------------------------------------------------
 
@@ -232,6 +378,23 @@ class TpuJobController:
         by_index = {p.metadata.labels.get(LABEL_WORKER): p for p in pods}
 
         if not pods:
+            reason = job.status.get("reason")
+            if reason == "Preempted":
+                # Freshly evicted: hold back one beat so the preemptor
+                # gets first claim on the chips it just freed (the
+                # nominatedNodeName grace, time-based at gang scale).
+                # Deadline-based — the status write below retriggers an
+                # event-driven reconcile immediately, which must keep
+                # holding until the clock actually passes.
+                fresh = api.get(KIND, name, ns)
+                fresh.status["reason"] = "PreemptedBackoff"
+                fresh.status["preemptedUntil"] = time.time() + 3.0
+                api.update_status(fresh)
+                return Result(requeue_after=3.0)
+            if reason == "PreemptedBackoff":
+                remaining = job.status.get("preemptedUntil", 0) - time.time()
+                if remaining > 0:
+                    return Result(requeue_after=remaining)
             # Gang creation: all pods in one pass, with topology-aware
             # placement when a cluster node model exists.
             assignment: list[str] | None = None
@@ -248,6 +411,13 @@ class TpuJobController:
                         spec.tpu_chips_per_worker,
                     )
                 except PlacementError as e:
+                    # Priority preemption (the PriorityClass analog at
+                    # gang granularity): evict strictly-lower-priority
+                    # gangs from the pool if — and only if — that frees
+                    # enough chips for this one. Useless disruption
+                    # (preempting without unblocking) is never done.
+                    if self._preempt_for(api, job, spec):
+                        return Result(requeue_after=0.5)
                     # Record the event once per stuck episode, not per
                     # 10s retry — unbounded Event growth otherwise.
                     if job.status.get("reason") != "Unschedulable":
@@ -264,9 +434,12 @@ class TpuJobController:
                     f"placed on {len(set(assignment))} node(s), "
                     f"ring cost {ring_cost}",
                 )
-                if job.status.get("reason") == "Unschedulable":
+                if job.status.get("reason") in (
+                    "Unschedulable", "Preempted", "PreemptedBackoff"
+                ):
                     fresh = api.get(KIND, name, ns)
                     fresh.status.pop("reason", None)
+                    fresh.status.pop("preemptedUntil", None)
                     api.update_status(fresh)
             incarnation = job.status.get("restarts", 0)
             for i in range(spec.replicas):
