@@ -227,25 +227,50 @@ class ModelServerApp(App):
         return json_response({"predictions": predictions.tolist()})
 
     def _predictor(self, model):
-        """model.predict, or its batching queue when batching is on
-        (lazily built per live servable; a reloaded version gets a fresh
-        queue and the stale one is drained + closed)."""
+        """model.predict, or its batching queue when batching is on.
+
+        The REPOSITORY is the authority on which servable object is
+        current for (name, version) — a requester racing a reload may
+        hold the pre-reload object, and keying the replace decision on it
+        would let two generations ping-pong, each closing the other's
+        queue. The stale requester is simply served by the current
+        generation's queue (correct post-rollout behavior). Queues for
+        unloaded versions are pruned here (close drained off the request
+        path)."""
         if self._batching is None:
             return model.predict
+        try:
+            current = self.repository.get(model.name, model.version)
+        except HttpError:
+            # Unloaded between route lookup and here; serve the caller's
+            # object directly, unbatched — last request out the door.
+            return model.predict
         key = (model.name, model.version)
-        stale = None
+        stale = []
         with self._batcher_lock:
             queue = self._batchers.get(key)
-            if queue is None or queue.servable is not model:
-                stale = queue
+            if queue is None or queue.servable is not current:
+                if queue is not None:
+                    stale.append(queue)
                 queue = self._batchers[key] = BatchingQueue(
-                    model, self._batching, metrics=self._metrics_registry
+                    current, self._batching, metrics=self._metrics_registry
                 )
-        if stale is not None:
-            # Drain the replaced queue off the request path — its close()
-            # joins the scheduler through the remaining device work.
+            # Prune queues whose model/version is no longer served —
+            # every unloaded rollout generation would otherwise pin its
+            # weights and scheduler thread until process exit.
+            for other_key in list(self._batchers):
+                try:
+                    live = self.repository.get(*other_key)
+                except HttpError:
+                    live = None
+                if live is not self._batchers[other_key].servable:
+                    if other_key != key:
+                        stale.append(self._batchers.pop(other_key))
+        for old in stale:
+            # Drain replaced queues off the request path — close() joins
+            # the scheduler through the remaining device work.
             threading.Thread(
-                target=stale.close, name="batcher-drain", daemon=True
+                target=old.close, name="batcher-drain", daemon=True
             ).start()
         return queue.predict
 

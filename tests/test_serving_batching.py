@@ -276,3 +276,55 @@ def test_closed_queue_raises_queue_closed():
     queue.close()
     with pytest.raises(QueueClosed):
         queue.predict(np.ones((1, 1)))
+
+
+def test_reload_swaps_queue_to_current_generation():
+    """The repository is the authority: after a same-version reload the
+    batcher serves the NEW servable, and the old generation's queue is
+    replaced exactly once (no ping-pong)."""
+    gen1, gen2 = CountingServable(), CountingServable()
+    repo = ModelRepository([gen1])
+    app = ModelServerApp(
+        repo, batching=BatchingConfig(max_batch=4, timeout_ms=5.0)
+    )
+    client = TestClient(app)
+    try:
+        assert client.post(
+            "/v1/models/ident:predict", {"instances": [[1.0]]}
+        ).status == 200
+        assert sum(gen1.calls) == 1
+
+        repo.load(gen2)  # same name/version: a rollout reload
+        assert client.post(
+            "/v1/models/ident:predict", {"instances": [[1.0]]}
+        ).status == 200
+        assert sum(gen2.calls) == 1  # served by the new generation
+        assert sum(gen1.calls) == 1  # old one never touched again
+        assert app._batchers[("ident", 1)].servable is gen2
+    finally:
+        app.close_batchers()
+
+
+def test_unload_prunes_stale_queue():
+    """An unloaded version's queue must not pin its weights + scheduler
+    thread forever — the next predict prunes it."""
+    a = CountingServable()
+
+    class B(CountingServable):
+        name = "other"
+
+    b = B()
+    repo = ModelRepository([a, b])
+    app = ModelServerApp(
+        repo, batching=BatchingConfig(max_batch=4, timeout_ms=5.0)
+    )
+    client = TestClient(app)
+    try:
+        client.post("/v1/models/ident:predict", {"instances": [[1.0]]})
+        client.post("/v1/models/other:predict", {"instances": [[1.0]]})
+        assert ("ident", 1) in app._batchers
+        repo.unload("ident", 1)
+        client.post("/v1/models/other:predict", {"instances": [[1.0]]})
+        assert ("ident", 1) not in app._batchers
+    finally:
+        app.close_batchers()
