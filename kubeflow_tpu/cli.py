@@ -271,6 +271,54 @@ def cmd_describe(client: HttpApiClient, args) -> int:
     return 0
 
 
+def cmd_top(client: HttpApiClient, args) -> int:
+    """kubectl-top analog for the TPU fleet: per-node chip capacity,
+    chips reserved by live pods, and the duty/utilization series the
+    node health stack publishes — 'is the fleet busy' in one table."""
+    nodes = client.list("Node", namespace="")
+    reserved: dict[str, int] = {}
+    for pod in client.list("Pod"):
+        node = pod.spec.get("nodeName")
+        if not node or pod.status.get("phase") in ("Succeeded", "Failed"):
+            continue
+        limits = (
+            pod.spec.get("containers", [{}])[0]
+            .get("resources", {})
+            .get("limits", {})
+        )
+        reserved[node] = reserved.get(node, 0) + int(
+            limits.get("google.com/tpu", 0)
+        )
+    rows = []
+    for n in sorted(nodes, key=lambda n: n.metadata.name):
+        chips = int(n.spec.get("chips", 0))
+        used = reserved.get(n.metadata.name, 0)
+        duty = n.status.get("tpuDutyCycle")
+        cpu = n.status.get("cpuUtilization")
+        rows.append((
+            n.metadata.name,
+            n.spec.get("pool", ""),
+            f"{used}/{chips}",
+            f"{duty * 100:.0f}%" if duty is not None else "-",
+            f"{cpu * 100:.0f}%" if cpu is not None else "-",
+            "Ready" if n.status.get("ready") else "NotReady",
+        ))
+    headers = ("NAME", "POOL", "CHIPS(USED/CAP)", "TPU-DUTY", "CPU", "STATUS")
+    widths = [
+        max([len(h)] + [len(r[i]) for r in rows])
+        for i, h in enumerate(headers)
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers))
+    for row in rows:
+        print(fmt.format(*row))
+    total = sum(int(n.spec.get("chips", 0)) for n in nodes)
+    used_total = sum(reserved.values())
+    print(f"# {used_total}/{total} chips reserved across "
+          f"{len(nodes)} node(s)")
+    return 0
+
+
 def cmd_apply(client: HttpApiClient, args) -> int:
     text = (
         sys.stdin.read() if args.filename == "-"
@@ -404,6 +452,11 @@ def main(argv: list[str] | None = None) -> int:
     describe.add_argument("name")
     describe.add_argument("-n", "--namespace", default=None)
     describe.set_defaults(fn=cmd_describe)
+
+    top = sub.add_parser(
+        "top", help="fleet chip usage by node (kubectl top analog)"
+    )
+    top.set_defaults(fn=cmd_top)
 
     apply_p = sub.add_parser("apply", help="create-or-update from YAML")
     apply_p.add_argument("-f", "--filename", required=True,

@@ -390,3 +390,29 @@ def test_apply_continues_past_forbidden_doc():
     assert "TpuJob/denied" in err and "not allowed" in err
     assert "notebook/allowed created" in out
     assert api.get("Notebook", "allowed").metadata.name == "allowed"
+
+
+def test_top_shows_fleet_chip_usage(server):
+    api, url = server
+    for i in range(2):
+        node = new_resource(
+            "Node", f"tpu-{i}", "", spec={"pool": "v5e", "chips": 4}
+        )
+        node.status = {"ready": True, "tpuDutyCycle": 0.5,
+                       "cpuUtilization": 0.25}
+        api.create(node)
+    pod = new_resource("Pod", "w0", "default", spec={
+        "nodeName": "tpu-0",
+        "containers": [{"name": "w",
+                        "resources": {"limits": {"google.com/tpu": 4}}}],
+    })
+    api.create(pod)
+    rc, out, _ = run(url, "top")
+    assert rc == 0
+    lines = out.splitlines()
+    assert lines[0].split() == [
+        "NAME", "POOL", "CHIPS(USED/CAP)", "TPU-DUTY", "CPU", "STATUS"
+    ]
+    assert "tpu-0" in lines[1] and "4/4" in lines[1] and "50%" in lines[1]
+    assert "tpu-1" in lines[2] and "0/4" in lines[2]
+    assert "# 4/8 chips reserved across 2 node(s)" in out
