@@ -71,6 +71,29 @@ def _emit(obj, fmt: str) -> None:
         print(yaml.safe_dump(obj, sort_keys=False), end="")
 
 
+def _print_table(headers, rows) -> None:
+    widths = [
+        max([len(h)] + [len(str(row[i])) for row in rows])
+        for i, h in enumerate(headers)
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers))
+    for row in rows:
+        print(fmt.format(*row))
+
+
+def _pod_tpu_limits(pod) -> int:
+    """Chips a pod reserves, summed across ALL containers (a limit on a
+    second container counts; an empty container list is 0, not a crash
+    — Pod is a passthrough kind, any shape can be stored)."""
+    return sum(
+        int(
+            c.get("resources", {}).get("limits", {}).get("google.com/tpu", 0)
+        )
+        for c in pod.spec.get("containers", [])
+    )
+
+
 def _phase(res: Resource) -> str:
     status = res.status or {}
     for key in ("phase", "containerState", "state"):
@@ -97,17 +120,10 @@ def cmd_get(client: HttpApiClient, args) -> int:
     if args.output in ("yaml", "json"):
         _emit([r.to_dict() for r in items], args.output)
         return 0
-    rows = [
-        (r.metadata.namespace, r.metadata.name, _phase(r)) for r in items
-    ]
-    widths = [
-        max([len(h)] + [len(row[i]) for row in rows])
-        for i, h in enumerate(("NAMESPACE", "NAME", "STATUS"))
-    ]
-    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
-    print(fmt.format("NAMESPACE", "NAME", "STATUS"))
-    for row in rows:
-        print(fmt.format(*row))
+    _print_table(
+        ("NAMESPACE", "NAME", "STATUS"),
+        [(r.metadata.namespace, r.metadata.name, _phase(r)) for r in items],
+    )
     return 0
 
 
@@ -281,14 +297,7 @@ def cmd_top(client: HttpApiClient, args) -> int:
         node = pod.spec.get("nodeName")
         if not node or pod.status.get("phase") in ("Succeeded", "Failed"):
             continue
-        limits = (
-            pod.spec.get("containers", [{}])[0]
-            .get("resources", {})
-            .get("limits", {})
-        )
-        reserved[node] = reserved.get(node, 0) + int(
-            limits.get("google.com/tpu", 0)
-        )
+        reserved[node] = reserved.get(node, 0) + _pod_tpu_limits(pod)
     rows = []
     for n in sorted(nodes, key=lambda n: n.metadata.name):
         chips = int(n.spec.get("chips", 0))
@@ -303,19 +312,25 @@ def cmd_top(client: HttpApiClient, args) -> int:
             f"{cpu * 100:.0f}%" if cpu is not None else "-",
             "Ready" if n.status.get("ready") else "NotReady",
         ))
-    headers = ("NAME", "POOL", "CHIPS(USED/CAP)", "TPU-DUTY", "CPU", "STATUS")
-    widths = [
-        max([len(h)] + [len(r[i]) for r in rows])
-        for i, h in enumerate(headers)
-    ]
-    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
-    print(fmt.format(*headers))
-    for row in rows:
-        print(fmt.format(*row))
+    _print_table(
+        ("NAME", "POOL", "CHIPS(USED/CAP)", "TPU-DUTY", "CPU", "STATUS"),
+        rows,
+    )
     total = sum(int(n.spec.get("chips", 0)) for n in nodes)
-    used_total = sum(reserved.values())
-    print(f"# {used_total}/{total} chips reserved across "
-          f"{len(nodes)} node(s)")
+    node_names = {n.metadata.name for n in nodes}
+    used_total = sum(
+        used for node, used in reserved.items() if node in node_names
+    )
+    orphaned = sum(
+        used for node, used in reserved.items() if node not in node_names
+    )
+    line = (f"# {used_total}/{total} chips reserved across "
+            f"{len(nodes)} node(s)")
+    if orphaned:
+        # Pods bound to since-deleted nodes: not in any table row, so
+        # they must not silently inflate (or contradict) the totals.
+        line += f"; {orphaned} chip(s) on vanished node(s)"
+    print(line)
     return 0
 
 

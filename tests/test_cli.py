@@ -416,3 +416,30 @@ def test_top_shows_fleet_chip_usage(server):
     assert "tpu-0" in lines[1] and "4/4" in lines[1] and "50%" in lines[1]
     assert "tpu-1" in lines[2] and "0/4" in lines[2]
     assert "# 4/8 chips reserved across 2 node(s)" in out
+
+
+def test_top_handles_odd_pods_and_vanished_nodes(server):
+    api, url = server
+    node = new_resource("Node", "tpu-0", "", spec={"pool": "v5e", "chips": 4})
+    node.status = {"ready": True}
+    api.create(node)
+    # Empty containers list must not crash; multi-container limits sum.
+    api.create(new_resource("Pod", "empty", "default",
+                            spec={"nodeName": "tpu-0", "containers": []}))
+    api.create(new_resource("Pod", "multi", "default", spec={
+        "nodeName": "tpu-0",
+        "containers": [
+            {"name": "a"},
+            {"name": "b", "resources": {"limits": {"google.com/tpu": 2}}},
+        ],
+    }))
+    # A pod bound to a node that no longer exists: reported, not counted.
+    api.create(new_resource("Pod", "ghost", "default", spec={
+        "nodeName": "gone",
+        "containers": [{"name": "w",
+                        "resources": {"limits": {"google.com/tpu": 4}}}],
+    }))
+    rc, out, _ = run(url, "top")
+    assert rc == 0, out
+    assert "2/4" in out
+    assert "# 2/4 chips reserved across 1 node(s); 4 chip(s) on vanished node(s)" in out
