@@ -138,6 +138,18 @@ def owner_ref(owner: Resource, *, controller: bool = True) -> dict:
     }
 
 
+def container_limits_total(pod: "Resource", resource: str) -> int:
+    """Sum a resource limit across ALL of a pod's containers (a limit on
+    a second container counts; an empty container list is 0). The one
+    accounting rule shared by quota admission, the gang scheduler's
+    reservations, and the CLI's fleet view — they must never disagree on
+    how many chips a pod holds."""
+    return sum(
+        int(c.get("resources", {}).get("limits", {}).get(resource, 0))
+        for c in pod.spec.get("containers", [])
+    )
+
+
 def fresh_uid() -> str:
     return str(uuid.uuid4())
 

@@ -29,7 +29,7 @@ from kubeflow_tpu.apps.dashboard import DashboardApp
 from kubeflow_tpu.apps.jupyter import JupyterApp
 from kubeflow_tpu.apps.kfam import KfamApp
 from kubeflow_tpu.apps.tensorboards import TensorboardsApp
-from kubeflow_tpu.controllers import poddefault
+from kubeflow_tpu.controllers import poddefault, quota
 from kubeflow_tpu.controllers.cronworkflow import CronWorkflowController
 from kubeflow_tpu.controllers.nodehealth import NodeHealthController
 from kubeflow_tpu.controllers.notebook import NotebookController
@@ -117,6 +117,7 @@ def main() -> None:
     ):
         manager.add(ctl.controller)
     poddefault.register(api)
+    quota.register(api)
     manager.start()
 
     # Pod runtime: without one, TpuJob/Study/Workflow pods would sit

@@ -25,7 +25,7 @@ import sys
 
 import yaml
 
-from kubeflow_tpu.api.objects import Resource
+from kubeflow_tpu.api.objects import Resource, container_limits_total
 from kubeflow_tpu.testing.apiserver_http import HttpApiClient
 from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
@@ -80,18 +80,6 @@ def _print_table(headers, rows) -> None:
     print(fmt.format(*headers))
     for row in rows:
         print(fmt.format(*row))
-
-
-def _pod_tpu_limits(pod) -> int:
-    """Chips a pod reserves, summed across ALL containers (a limit on a
-    second container counts; an empty container list is 0, not a crash
-    — Pod is a passthrough kind, any shape can be stored)."""
-    return sum(
-        int(
-            c.get("resources", {}).get("limits", {}).get("google.com/tpu", 0)
-        )
-        for c in pod.spec.get("containers", [])
-    )
 
 
 def _phase(res: Resource) -> str:
@@ -297,7 +285,7 @@ def cmd_top(client: HttpApiClient, args) -> int:
         node = pod.spec.get("nodeName")
         if not node or pod.status.get("phase") in ("Succeeded", "Failed"):
             continue
-        reserved[node] = reserved.get(node, 0) + _pod_tpu_limits(pod)
+        reserved[node] = reserved.get(node, 0) + container_limits_total(pod, "google.com/tpu")
     rows = []
     for n in sorted(nodes, key=lambda n: n.metadata.name):
         chips = int(n.spec.get("chips", 0))

@@ -1,0 +1,88 @@
+"""ResourceQuota enforcement — the quota admission controller.
+
+The profile controller materializes `ResourceQuota` objects with a
+`google.com/tpu` hard cap per tenant namespace
+(`profile.py:166-173`, mirroring `profile_controller.go`'s
+resourceQuotaSpec handling), but the reference leaned on the REAL
+apiserver's built-in quota admission to enforce them — our in-process
+apiserver has no such built-in, so without this module the caps were
+decorative. `register(api)` installs the enforcement at the same
+boundary K8s does: pod admission.
+
+Semantics (K8s quota, scoped to the resources the platform meters):
+- on Pod create, for each hard-capped resource, current namespace usage
+  (live pods' container limits, terminal pods excluded) + the new pod's
+  ask must fit under the cap, else the create is rejected;
+- updates re-admit the object, so the pod's own existing usage is
+  excluded from "current" (no self-double-count);
+- namespaces without a ResourceQuota are unmetered.
+
+The TpuJob operator turns a quota rejection into a `QuotaExceeded`
+Pending episode instead of a crash-looping partial gang (all-or-nothing
+cuts both ways: if one worker doesn't fit the budget, none start).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.api.objects import Resource, container_limits_total
+from kubeflow_tpu.testing.fake_apiserver import (
+    FakeApiServer,
+    Invalid,
+    NotFound,
+)
+
+# Resources the platform meters. cpu/memory strings ("64", "128Gi") are
+# K8s quantities; the TPU resource is always an integer chip count.
+METERED = ("google.com/tpu",)
+
+
+class QuotaExceeded(Invalid):
+    """Rejected by quota admission — an Invalid subclass so in-process
+    callers and the HTTP facade surface it as the 422 class every other
+    admission rejection uses."""
+
+
+def _usage(
+    api: FakeApiServer, namespace: str, resource: str, exclude: str
+) -> int:
+    used = 0
+    for pod in api.list("Pod", namespace):
+        if pod.metadata.name == exclude:
+            continue
+        if pod.status.get("phase") in ("Succeeded", "Failed"):
+            continue
+        used += container_limits_total(pod, resource)
+    return used
+
+
+def check_pod(api: FakeApiServer, pod: Resource) -> Resource:
+    """Admission hook: reject the pod if it busts any hard cap."""
+    namespace = pod.metadata.namespace
+    try:
+        rq = api.get("ResourceQuota", "kf-resource-quota", namespace)
+    except NotFound:
+        return pod  # unmetered namespace
+    # Any OTHER read failure propagates: silently skipping the check
+    # would turn the caps decorative again — fail closed, not open.
+    hard = rq.spec.get("hard", {})
+    for resource in METERED:
+        if resource not in hard:
+            continue
+        cap = int(hard[resource])
+        ask = container_limits_total(pod, resource)
+        if ask == 0:
+            continue
+        used = _usage(api, namespace, resource, exclude=pod.metadata.name)
+        if used + ask > cap:
+            raise QuotaExceeded(
+                f"pod {pod.metadata.name!r} exceeds ResourceQuota "
+                f"{resource!r} in namespace {namespace!r}: "
+                f"used {used} + requested {ask} > hard cap {cap}"
+            )
+    return pod
+
+
+def register(api: FakeApiServer) -> None:
+    """Install quota admission on the store (idempotent hooks are the
+    admission contract; this one only reads)."""
+    api.register_admission(lambda pod: check_pod(api, pod), kind="Pod")

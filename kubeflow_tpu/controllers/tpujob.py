@@ -23,11 +23,20 @@ from __future__ import annotations
 import logging
 import time
 
-from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.api.objects import (
+    Resource,
+    container_limits_total,
+    new_resource,
+    owner_ref,
+)
 from kubeflow_tpu.api.tpujob import COORDINATOR_PORT, KIND, TpuJobSpec
 from kubeflow_tpu.controllers.runtime import Controller, Key, Result
 from kubeflow_tpu.parallel import distributed as dist
-from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+from kubeflow_tpu.testing.fake_apiserver import (
+    FakeApiServer,
+    Invalid,
+    NotFound,
+)
 from kubeflow_tpu.utils.metrics import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -56,9 +65,11 @@ class TpuJobController:
         api: FakeApiServer,
         metrics: MetricsRegistry | None = None,
         scheduler=None,
+        quota_retry_seconds: float = 10.0,
     ):
         self.api = api
         self._scheduler_factory = scheduler
+        self._quota_retry_seconds = quota_retry_seconds
         metrics = metrics or MetricsRegistry()
         self.jobs_running = metrics.gauge(
             "tpujob_running", "TpuJobs currently running"
@@ -202,12 +213,9 @@ class TpuJobController:
             gang = f"{pod.metadata.namespace}/{owner}"
             if gang == placing_job or gang in exclude:
                 continue  # replaced (own stale pods) or hypothetically evicted
-            limits = (
-                pod.spec.get("containers", [{}])[0]
-                .get("resources", {})
-                .get("limits", {})
+            sched.reserve(
+                gang, node, container_limits_total(pod, "google.com/tpu")
             )
-            sched.reserve(gang, node, int(limits.get("google.com/tpu", 0)))
         return sched
 
     # -- preemption -------------------------------------------------------
@@ -241,14 +249,9 @@ class TpuJobController:
                 f"{pod.metadata.namespace}/"
                 f"{pod.metadata.labels.get(LABEL_JOB, '')}"
             )
-            limits = (
-                pod.spec.get("containers", [{}])[0]
-                .get("resources", {})
-                .get("limits", {})
-            )
-            held_by_gang[gang] = held_by_gang.get(gang, 0) + int(
-                limits.get("google.com/tpu", 0)
-            )
+            held_by_gang[gang] = held_by_gang.get(
+                gang, 0
+            ) + container_limits_total(pod, "google.com/tpu")
 
         candidates = []
         for other in api.list(KIND):
@@ -395,6 +398,14 @@ class TpuJobController:
                 remaining = job.status.get("preemptedUntil", 0) - time.time()
                 if remaining > 0:
                     return Result(requeue_after=remaining)
+            if reason == "QuotaExceeded":
+                # Time-gated retry: each attempt creates-then-deletes a
+                # pod (admission happens at the store), and those watch
+                # events re-enqueue this job — ungated, that churn is a
+                # hot loop.
+                remaining = job.status.get("quotaRetryAt", 0) - time.time()
+                if remaining > 0:
+                    return Result(requeue_after=remaining)
             # Gang creation: all pods in one pass, with topology-aware
             # placement when a cluster node model exists.
             assignment: list[str] | None = None
@@ -435,21 +446,59 @@ class TpuJobController:
                     f"ring cost {ring_cost}",
                 )
                 if job.status.get("reason") in (
-                    "Unschedulable", "Preempted", "PreemptedBackoff"
+                    "Unschedulable", "Preempted", "PreemptedBackoff",
+                    "QuotaExceeded",
                 ):
                     fresh = api.get(KIND, name, ns)
                     fresh.status.pop("reason", None)
                     fresh.status.pop("preemptedUntil", None)
                     api.update_status(fresh)
             incarnation = job.status.get("restarts", 0)
-            for i in range(spec.replicas):
-                pod = self._desired_pod(job, spec, i, incarnation)
-                if assignment is not None:
-                    pod.spec["nodeName"] = assignment[i]
-                api.create(pod)
+            created = []
+            try:
+                for i in range(spec.replicas):
+                    pod = self._desired_pod(job, spec, i, incarnation)
+                    if assignment is not None:
+                        pod.spec["nodeName"] = assignment[i]
+                    api.create(pod)
+                    created.append(pod)
+            except Invalid as e:
+                # Quota (or other admission) rejected a worker: the gang
+                # is all-or-nothing, so nothing starts — tear down the
+                # partial set and hold a Pending episode instead of
+                # crash-looping (`controllers/quota.py`).
+                for p in created:
+                    try:
+                        api.delete("Pod", p.metadata.name, ns)
+                    except NotFound:
+                        pass
+                first = job.status.get("reason") != "QuotaExceeded"
+                if first:
+                    api.record_event(
+                        job, "QuotaExceeded", str(e), type_="Warning"
+                    )
+                fresh = api.get(KIND, name, ns)
+                fresh.status["reason"] = "QuotaExceeded"
+                fresh.status["quotaRetryAt"] = (
+                    time.time() + self._quota_retry_seconds
+                )
+                api.update_status(fresh)
+                self._set_phase(api, job, "Pending")
+                return Result(requeue_after=self._quota_retry_seconds)
             api.record_event(
                 job, "GangCreated", f"created {spec.replicas} workers"
             )
+            if job.status.get("reason") in (
+                "Unschedulable", "Preempted", "PreemptedBackoff",
+                "QuotaExceeded",
+            ):
+                # Episode over (covers the no-scheduler path, where the
+                # placement-success clear above never runs).
+                fresh = api.get(KIND, name, ns)
+                fresh.status.pop("reason", None)
+                fresh.status.pop("preemptedUntil", None)
+                fresh.status.pop("quotaRetryAt", None)
+                api.update_status(fresh)
             return self._set_phase(api, job, "Pending")
 
         if len(pods) != spec.replicas or set(by_index) != {

@@ -30,6 +30,7 @@ from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
     Conflict,
     FakeApiServer,
+    Invalid,
     NotFound,
 )
 
@@ -46,6 +47,7 @@ LABEL_WORKLOAD_KIND = "kubeflow-tpu.org/workload-kind"
 class WorkloadMaterializer:
     def __init__(self, api: FakeApiServer):
         self.api = api
+        self._last_rejection: dict[str, str] = {}
 
     def step(self) -> None:
         for kind in WORKLOAD_KINDS:
@@ -54,6 +56,26 @@ class WorkloadMaterializer:
                     self._reconcile(workload)
                 except (Conflict, AlreadyExists, NotFound):
                     pass  # raced with a controller; next step converges
+                except Invalid as e:
+                    # Admission (e.g. quota) rejected this workload's pod:
+                    # contained to THIS workload — others still reconcile
+                    # — and surfaced on the owner instead of spamming the
+                    # runner log at 5 Hz with nothing tenant-visible.
+                    self._note_rejection(workload, e)
+
+    def _note_rejection(self, workload: Resource, error: Invalid) -> None:
+        """One Event per rejection episode (keyed on the message) — the
+        tenant sees WHY their notebook/tensorboard pods never appear."""
+        marker = f"rejected:{workload.kind}/{workload.metadata.name}"
+        if self._last_rejection.get(marker) == str(error):
+            return
+        self._last_rejection[marker] = str(error)
+        try:
+            self.api.record_event(
+                workload, "PodRejected", str(error), type_="Warning"
+            )
+        except Exception:
+            log.warning("%s: pod rejected: %s", marker, error)
 
     @staticmethod
     def _pod_prefix(workload: Resource) -> str:

@@ -260,8 +260,13 @@ class FakeApiServer:
             raise Invalid(str(e)) from e
 
     def create(self, obj: Resource) -> Resource:
-        obj = self._admit(self._normalize_version(obj))
+        obj = self._normalize_version(obj)
         with self._lock:
+            # Admission INSIDE the critical section: validating hooks
+            # (quota) read current state, and check-then-insert must be
+            # atomic or two concurrent creates can both pass a cap.
+            # Hooks may re-enter the store (RLock).
+            obj = self._admit(obj)
             key = obj.key
             if key in self._objects:
                 raise AlreadyExists(f"{key} already exists")
@@ -345,9 +350,11 @@ class FakeApiServer:
         return out
 
     def update(self, obj: Resource) -> Resource:
-        return self._update(
-            self._admit(self._normalize_version(obj)), status_only=False
-        )
+        with self._lock:  # admission atomic with the write (see create)
+            return self._update(
+                self._admit(self._normalize_version(obj)),
+                status_only=False,
+            )
 
     def update_status(self, obj: Resource) -> Resource:
         return self._update(obj, status_only=True)

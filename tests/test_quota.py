@@ -1,0 +1,148 @@
+"""ResourceQuota enforcement — the quota admission the reference got
+from the real apiserver's built-in controller and we must provide
+ourselves (`controllers/quota.py`). The profile controller materializes
+the caps; admission makes them real."""
+
+import pytest
+
+from kubeflow_tpu.api import make_tpujob
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.tpujob import KIND
+from kubeflow_tpu.controllers import quota
+from kubeflow_tpu.controllers.quota import QuotaExceeded
+from kubeflow_tpu.controllers.tpujob import LABEL_JOB, TpuJobController
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.fake_apiserver import Invalid
+
+
+def _pod(name, ns="team", chips=4, node=None):
+    spec = {
+        "containers": [
+            {"name": "w",
+             "resources": {"limits": {"google.com/tpu": chips}}}
+        ],
+    }
+    if node:
+        spec["nodeName"] = node
+    return new_resource("Pod", name, ns, spec=spec)
+
+
+def _quota(api, ns="team", chips=8):
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", ns,
+        spec={"hard": {"google.com/tpu": chips}},
+    ))
+
+
+def test_pod_over_quota_rejected():
+    api = FakeApiServer()
+    quota.register(api)
+    _quota(api, chips=8)
+    api.create(_pod("a", chips=4))
+    api.create(_pod("b", chips=4))
+    with pytest.raises(QuotaExceeded) as err:
+        api.create(_pod("c", chips=1))
+    assert "used 8 + requested 1 > hard cap 8" in str(err.value)
+    # QuotaExceeded IS Invalid: the HTTP facade maps it to 422.
+    assert isinstance(err.value, Invalid)
+
+
+def test_terminal_pods_release_budget():
+    api = FakeApiServer()
+    quota.register(api)
+    _quota(api, chips=4)
+    api.create(_pod("a", chips=4))
+    done = api.get("Pod", "a", "team")
+    done.status["phase"] = "Succeeded"
+    api.update_status(done)
+    api.create(_pod("b", chips=4))  # fits now
+
+
+def test_unmetered_namespace_and_zero_ask_pass():
+    api = FakeApiServer()
+    quota.register(api)
+    api.create(_pod("free", ns="open", chips=16))  # no quota object
+    _quota(api, chips=0)
+    api.create(new_resource("Pod", "cpu-only", "team",
+                            spec={"containers": [{"name": "w"}]}))
+
+
+def test_update_does_not_double_count_self():
+    api = FakeApiServer()
+    quota.register(api)
+    _quota(api, chips=4)
+    api.create(_pod("a", chips=4))
+    pod = api.get("Pod", "a", "team")
+    pod.spec["nodeName"] = "n0"
+    api.update(pod)  # re-admission must exclude its own usage
+
+
+def test_gang_over_quota_holds_pending_episode():
+    """All-or-nothing cuts both ways: if worker #2 busts the budget,
+    worker #1 must not be left running — the job parks in a
+    QuotaExceeded Pending episode and recovers when budget frees."""
+    api = FakeApiServer()
+    quota.register(api)
+    _quota(api, ns="default", chips=4)
+    ctl = TpuJobController(api, quota_retry_seconds=0.05)
+    api.create(make_tpujob(
+        "gang", replicas=2, tpu_chips_per_worker=4, command=("true",),
+    ))
+    for _ in range(6):
+        ctl.controller.run_until_idle()
+    job = api.get(KIND, "gang")
+    assert job.status.get("reason") == "QuotaExceeded"
+    assert job.status.get("phase") == "Pending"
+    assert api.list("Pod", "default",
+                    label_selector={LABEL_JOB: "gang"}) == []
+    reasons = {e.spec["reason"] for e in api.list("Event", "default")}
+    assert "QuotaExceeded" in reasons
+
+    # The budget doubles (profile edit); the next pass starts the gang.
+    rq = api.get("ResourceQuota", "kf-resource-quota", "default")
+    rq.spec["hard"]["google.com/tpu"] = 8
+    api.update(rq)
+    import time as _time
+
+    _time.sleep(0.1)  # past the quota retry gate
+    ctl.controller.enqueue(("default", "gang"))
+    for _ in range(6):
+        ctl.controller.run_until_idle()
+    job = api.get(KIND, "gang")
+    assert len(api.list("Pod", "default",
+                        label_selector={LABEL_JOB: "gang"})) == 2
+    assert job.status.get("reason") is None
+
+
+def test_materializer_contains_quota_rejection():
+    """An over-quota notebook STS must not starve other workloads'
+    materialization, and the tenant gets a PodRejected event."""
+    from kubeflow_tpu.runtime import WorkloadMaterializer
+
+    api = FakeApiServer()
+    quota.register(api)
+    _quota(api, ns="team", chips=0)
+    api.create(new_resource("StatefulSet", "greedy", "team", spec={
+        "replicas": 1,
+        "template": {"spec": {"containers": [
+            {"name": "nb",
+             "resources": {"limits": {"google.com/tpu": 4}}}]}},
+    }))
+    api.create(new_resource("StatefulSet", "modest", "team", spec={
+        "replicas": 1,
+        "template": {"spec": {"containers": [{"name": "nb"}]}},
+    }))
+    m = WorkloadMaterializer(api)
+    for _ in range(3):
+        m.step()
+    pods = {p.metadata.name for p in api.list("Pod", "team")}
+    assert any(p.startswith("modest") for p in pods), pods
+    assert not any(p.startswith("greedy") for p in pods), pods
+    reasons = {e.spec["reason"] for e in api.list("Event", "team")}
+    assert "PodRejected" in reasons
+    # Episode-deduped: repeated steps don't spam events.
+    count = sum(
+        1 for e in api.list("Event", "team")
+        if e.spec["reason"] == "PodRejected"
+    )
+    assert count == 1
