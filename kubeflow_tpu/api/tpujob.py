@@ -77,7 +77,28 @@ class TpuJobSpec:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "TpuJobSpec":
+        # Strict field validation (the kubectl --validate analog): a
+        # typo'd or K8s-shaped field (e.g. `template:`) silently dropped
+        # would leave e.g. an empty command and a gang that can never
+        # run, with nothing pointing at the cause.
+        unknown = set(d) - KNOWN_FIELDS
+        if unknown:
+            raise ValueError(
+                f"unknown TpuJob spec field(s) {sorted(unknown)}; known: "
+                f"{sorted(KNOWN_FIELDS)}"
+            )
         tpu = d.get("tpu") or {}
+        if not isinstance(tpu, dict):
+            raise ValueError(
+                f"spec.tpu must be a mapping "
+                f"(chipsPerWorker/topology/numSlices), got {tpu!r}"
+            )
+        unknown_tpu = set(tpu) - KNOWN_TPU_FIELDS
+        if unknown_tpu:
+            raise ValueError(
+                f"unknown TpuJob spec.tpu field(s) {sorted(unknown_tpu)}; "
+                f"known: {sorted(KNOWN_TPU_FIELDS)}"
+            )
         spec = cls(
             replicas=d.get("replicas", 1),
             image=d.get("image", "kubeflow-tpu/worker:latest"),
@@ -95,6 +116,13 @@ class TpuJobSpec:
         )
         spec.validate()
         return spec
+
+
+# Derived from the serializer so the allowlists can never drift from
+# what to_dict emits (a drift would make from_dict reject the platform's
+# own round-tripped specs).
+KNOWN_FIELDS = frozenset(TpuJobSpec().to_dict())
+KNOWN_TPU_FIELDS = frozenset(TpuJobSpec().to_dict()["tpu"])
 
 
 def make_tpujob(

@@ -143,3 +143,22 @@ def test_job_delete_cascades_to_pods(api, ctl):
     assert api.list("Pod", label_selector={LABEL_JOB: "j"}) == []
     with pytest.raises(NotFound):
         api.get("Service", "j")
+
+
+def test_spec_rejects_unknown_fields():
+    """kubectl --validate analog: a K8s-shaped or typo'd field must fail
+    loudly, not be silently dropped (a dropped `template:` leaves an
+    empty command and a gang that can never run)."""
+    with pytest.raises(ValueError) as err:
+        TpuJobSpec.from_dict({
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"command": ["python", "-c", "print('hi')"]}]}},
+        })
+    assert "template" in str(err.value)
+    with pytest.raises(ValueError) as err:
+        TpuJobSpec.from_dict({"tpu": {"chipsPerWoker": 4}})
+    assert "chipsPerWoker" in str(err.value)
+    with pytest.raises(ValueError) as err:
+        TpuJobSpec.from_dict({"tpu": "4x4"})
+    assert "must be a mapping" in str(err.value)
