@@ -201,6 +201,20 @@ class Controller:
         api.watch(self._on_primary, kind)
         for owned in self._owns:
             api.watch(self._on_owned, owned)
+        # Initial sync (controller-runtime's informer list-then-watch):
+        # primaries that already exist get a reconcile. FakeApiServer's
+        # in-process watch has no replay, so without this a controller
+        # attached to a store RESTORED FROM DISK (durable apiserver
+        # restart) would never look at the restored objects until some
+        # new event happened to touch them. Best-effort for remote
+        # clients — their watch stream does its own list-then-watch
+        # resync, so a boot-time network blip here costs nothing.
+        try:
+            for obj in api.list(kind):
+                self._on_primary("MODIFIED", obj)
+        except Exception:
+            log.debug("%s: initial list failed; relying on watch resync",
+                      self.name, exc_info=True)
 
     # -- watch handlers ---------------------------------------------------
 

@@ -67,6 +67,19 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.kftpu_store_status.restype = I32
     lib.kftpu_store_error.restype = S
 
+    lib.kftpu_wal_open.restype = P
+    lib.kftpu_wal_open.argtypes = [S]
+    lib.kftpu_wal_free.argtypes = [P]
+    lib.kftpu_wal_append.restype = I32
+    lib.kftpu_wal_append.argtypes = [P, S]
+    lib.kftpu_wal_snapshot.restype = I32
+    lib.kftpu_wal_snapshot.argtypes = [P, S]
+    lib.kftpu_wal_read_snapshot.restype = S
+    lib.kftpu_wal_read_snapshot.argtypes = [P]
+    lib.kftpu_wal_read_journal.restype = S
+    lib.kftpu_wal_read_journal.argtypes = [P]
+    lib.kftpu_wal_error.restype = S
+
 
 def _lib() -> ctypes.CDLL:
     return load("libkftpu_core.so", _configure)
@@ -219,3 +232,58 @@ class NativeStore:
 
     def __len__(self) -> int:
         return int(self._lib.kftpu_store_len(self._handle))
+
+
+class WalError(Exception):
+    pass
+
+
+class NativeWal:
+    """Durable WAL+snapshot directory (wal.cc): fsync'd appends, atomic
+    snapshot replacement. The compiled persistence tier FakeApiServer
+    stores through (the reference's equivalent durability comes from
+    etcd, `profile-controller/controllers/suite_test.go:29-54`)."""
+
+    def __init__(self, directory: str):
+        import os
+
+        self._lib = _lib()
+        # wal.cc creates the leaf directory only; deep paths are the
+        # caller's concern — make them here so both backends accept them.
+        os.makedirs(str(directory), mode=0o700, exist_ok=True)
+        self._handle = self._lib.kftpu_wal_open(str(directory).encode())
+        if not self._handle:
+            raise WalError(
+                (self._lib.kftpu_wal_error() or b"").decode()
+                or f"cannot open wal dir {directory!r}"
+            )
+
+    def close(self) -> None:
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.kftpu_wal_free(handle)
+            self._handle = None
+
+    __del__ = close
+
+    def _check(self, rc: int) -> None:
+        if rc != 0:
+            raise WalError((self._lib.kftpu_wal_error() or b"").decode())
+
+    def append(self, line: str) -> None:
+        self._check(self._lib.kftpu_wal_append(self._handle, line.encode()))
+
+    def snapshot(self, text: str) -> None:
+        self._check(self._lib.kftpu_wal_snapshot(self._handle, text.encode()))
+
+    def read_snapshot(self) -> str:
+        out = self._lib.kftpu_wal_read_snapshot(self._handle)
+        if out is None:
+            raise WalError((self._lib.kftpu_wal_error() or b"").decode())
+        return out.decode()
+
+    def read_journal(self) -> str:
+        out = self._lib.kftpu_wal_read_journal(self._handle)
+        if out is None:
+            raise WalError((self._lib.kftpu_wal_error() or b"").decode())
+        return out.decode()

@@ -95,11 +95,15 @@ class LocalPodRunner:
             if proc is None and phase is None:
                 self._start(pod, key)
             elif proc is not None and proc.poll() is not None:
-                with self._lock:
-                    self._procs.pop(key, None)
+                # Report the exit BEFORE untracking: if the status write
+                # fails (apiserver outage), the process stays tracked and
+                # the next step() retries — otherwise the exit is lost
+                # and the pod reads Running forever.
                 self._set_phase(
                     pod, "Succeeded" if proc.returncode == 0 else "Failed"
                 )
+                with self._lock:
+                    self._procs.pop(key, None)
 
     def _start(self, pod: Resource, key: tuple[str, str]) -> None:
         c = pod.spec["containers"][0]

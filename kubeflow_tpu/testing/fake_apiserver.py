@@ -75,9 +75,19 @@ def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
 
 
 class FakeApiServer:
-    def __init__(self, *, journal_size: int = 10_000):
+    def __init__(
+        self,
+        *,
+        journal_size: int = 10_000,
+        persist_dir: str | None = None,
+        snapshot_every: int = 1_000,
+        wal_backend: str = "auto",
+    ):
         self._objects: dict[tuple[str, str, str], Resource] = {}
         self._rv = 0
+        # Events at or below the floor are unknowable (pre-restart, or
+        # trimmed): watch bookmarks under it get Gone → relist.
+        self._floor = 0
         self._lock = threading.RLock()
         self._watchers: list[tuple[str | None, WatchHandler]] = []
         self._admission: list[tuple[str | None, Callable[[Resource], Resource]]] = []
@@ -99,6 +109,129 @@ class FakeApiServer:
         self._dispatch_enqueued = 0
         self._dispatch_done = 0
         self._dispatcher: threading.Thread | None = None
+        # Durable store (WAL+snapshot; `testing/persist.py`). The
+        # reference gets this from etcd (`suite_test.go:29-54`); here the
+        # server is durable exactly when a persist_dir is given: every
+        # committed write is fsync'd to the WAL before its watch event is
+        # emitted, and a restart over the same directory restores state.
+        self._wal = None
+        self._snapshot_every = max(1, snapshot_every)
+        self._appends_since_snapshot = 0
+        if persist_dir is not None:
+            from kubeflow_tpu.testing import persist
+
+            self._wal = persist.open_wal(persist_dir, backend=wal_backend)
+            self._restore()
+
+    # -- persistence ------------------------------------------------------
+
+    def _restore(self) -> None:
+        """Load snapshot + replay WAL (construction time, no lock needed).
+        Replay stops at the first undecodable line — a torn tail from a
+        crash mid-append loses only the un-acked record. Records at or
+        below the snapshot's rv are skipped (a crash between snapshot
+        rename and WAL truncate legally leaves them behind)."""
+        import json as _json
+
+        from kubeflow_tpu.testing.persist import FORMAT
+
+        snap_text = self._wal.read_snapshot()
+        if snap_text:
+            try:
+                snap = _json.loads(snap_text)
+            except ValueError as e:
+                raise Invalid(f"corrupt snapshot: {e}") from e
+            if snap.get("format") != FORMAT:
+                raise Invalid(
+                    f"snapshot format {snap.get('format')!r} is not "
+                    f"{FORMAT} — refusing to guess at a migration"
+                )
+            for d in snap.get("objects", []):
+                obj = Resource.from_dict(d)
+                self._objects[obj.key] = obj
+            self._rv = int(snap.get("rv", 0))
+        torn = False
+        for line in self._wal.read_journal().splitlines():
+            try:
+                rec = _json.loads(line)
+                rv = int(rec["rv"])
+                event = rec["event"]
+                obj = Resource.from_dict(rec["object"])
+            except (ValueError, KeyError, TypeError):
+                log.warning("WAL replay stopped at torn/corrupt record")
+                torn = True
+                break
+            if rv <= self._rv:
+                continue  # pre-snapshot leftover
+            if event == "DELETED":
+                self._objects.pop(obj.key, None)
+            else:
+                self._objects[obj.key] = obj
+            self._rv = rv
+        if torn:
+            # REPAIR the log now: the WAL reopens in append mode, so the
+            # next acked write would otherwise glue onto the partial
+            # line and be silently dropped by the NEXT restart's replay
+            # (an acked, fsync'd write lost). Folding state into a fresh
+            # snapshot truncates the torn tail away.
+            self._checkpoint_locked()
+        # Watchers resuming from before the restart can't be served from
+        # the (empty) in-memory journal: 410 Gone → they relist.
+        self._floor = self._rv
+
+    def _persist(self, event: str, obj: Resource) -> None:
+        """WAL-append one committed write (caller holds the lock). Runs
+        BEFORE the in-memory journal append / watch delivery: an event a
+        watcher saw must never be missing after a crash."""
+        import json as _json
+
+        self._wal.append(
+            _json.dumps(
+                {
+                    "rv": obj.metadata.resource_version,
+                    "event": event,
+                    "object": obj.to_dict(),
+                },
+                separators=(",", ":"),
+            )
+        )
+        self._appends_since_snapshot += 1
+        if self._appends_since_snapshot >= self._snapshot_every:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
+        import json as _json
+
+        from kubeflow_tpu.testing.persist import FORMAT
+
+        self._wal.snapshot(
+            _json.dumps(
+                {
+                    "format": FORMAT,
+                    "rv": self._rv,
+                    "objects": [
+                        o.to_dict() for _, o in sorted(self._objects.items())
+                    ],
+                },
+                separators=(",", ":"),
+            )
+        )
+        self._appends_since_snapshot = 0
+
+    def checkpoint(self) -> None:
+        """Fold the WAL into a fresh snapshot now (graceful shutdown, or
+        bounding recovery time). No-op without persistence."""
+        with self._lock:
+            if self._wal is not None:
+                self._checkpoint_locked()
+
+    def close(self) -> None:
+        """Checkpoint (if durable) and release the WAL handles."""
+        with self._lock:
+            if self._wal is not None:
+                self._checkpoint_locked()
+                self._wal.close()
+                self._wal = None
 
     # -- admission --------------------------------------------------------
 
@@ -136,6 +269,11 @@ class FakeApiServer:
                 self._dispatcher.start()
 
     def _emit(self, event: str, obj: Resource) -> None:
+        # Durability first: the WAL append (fsync'd) happens before any
+        # watcher can observe the event, so an acked write survives a
+        # crash that follows it.
+        if self._wal is not None:
+            self._persist(event, obj)
         # Journal under the lock (all callers hold it) so journal order is
         # resourceVersion order — a watcher resuming from rv N can never
         # miss an event that commits with rv > N after N was served.
@@ -203,6 +341,11 @@ class FakeApiServer:
         server's current rv (the resume point even when nothing matched
         the filter). Raises Gone when the bookmark predates the journal."""
         with self._lock:
+            if resource_version < self._floor:
+                raise Gone(
+                    f"resourceVersion {resource_version} predates this "
+                    f"server's history (floor {self._floor}) — relist"
+                )
             if self._journal and resource_version < self._journal[0][0] - 1:
                 raise Gone(
                     f"resourceVersion {resource_version} is too old "

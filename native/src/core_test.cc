@@ -9,6 +9,7 @@
 
 #include "json.h"
 #include "store.h"
+#include "wal.h"
 #include "workqueue.h"
 
 using kftpu::Json;
@@ -251,6 +252,40 @@ static void TestStoreNamespaceDrain() {
   kftpu_store_free(s);
 }
 
+static void TestWalAppendSnapshotReopen() {
+  char dir[] = "/tmp/kftpu_wal_test_XXXXXX";
+  CHECK(mkdtemp(dir) != nullptr);
+  std::string sub = std::string(dir) + "/state";  // open() must mkdir it
+  void* w = kftpu_wal_open(sub.c_str());
+  CHECK(w != nullptr);
+  CHECK(std::string(kftpu_wal_read_snapshot(w)) == "");
+  CHECK(std::string(kftpu_wal_read_journal(w)) == "");
+  CHECK(kftpu_wal_append(w, R"({"rv":1,"event":"ADDED"})") == 0);
+  CHECK(kftpu_wal_append(w, R"({"rv":2,"event":"MODIFIED"})") == 0);
+  CHECK(std::string(kftpu_wal_read_journal(w)) ==
+        "{\"rv\":1,\"event\":\"ADDED\"}\n{\"rv\":2,\"event\":\"MODIFIED\"}\n");
+  // Snapshot replaces atomically and truncates the WAL.
+  CHECK(kftpu_wal_snapshot(w, R"({"format":1,"rv":2})") == 0);
+  CHECK(std::string(kftpu_wal_read_snapshot(w)) == R"({"format":1,"rv":2})");
+  CHECK(std::string(kftpu_wal_read_journal(w)) == "");
+  CHECK(kftpu_wal_append(w, R"({"rv":3,"event":"DELETED"})") == 0);
+  kftpu_wal_free(w);
+  // A second open (the restarted-apiserver path) sees durable state and
+  // appends after the existing tail, not over it.
+  void* w2 = kftpu_wal_open(sub.c_str());
+  CHECK(w2 != nullptr);
+  CHECK(std::string(kftpu_wal_read_snapshot(w2)) == R"({"format":1,"rv":2})");
+  CHECK(std::string(kftpu_wal_read_journal(w2)) ==
+        "{\"rv\":3,\"event\":\"DELETED\"}\n");
+  CHECK(kftpu_wal_append(w2, R"({"rv":4,"event":"ADDED"})") == 0);
+  CHECK(std::string(kftpu_wal_read_journal(w2)) ==
+        "{\"rv\":3,\"event\":\"DELETED\"}\n{\"rv\":4,\"event\":\"ADDED\"}\n");
+  kftpu_wal_free(w2);
+  // Unwritable parent fails open with a message, not a crash.
+  CHECK(kftpu_wal_open("/proc/nope/state") == nullptr);
+  CHECK(std::strlen(kftpu_wal_error()) > 0);
+}
+
 int main() {
   TestJsonRoundtrip();
   TestWorkqueueBasics();
@@ -259,6 +294,7 @@ int main() {
   TestStoreCrud();
   TestStoreFinalizersAndCascade();
   TestStoreNamespaceDrain();
+  TestWalAppendSnapshotReopen();
   std::printf("core_test: all ok\n");
   return 0;
 }

@@ -1,0 +1,80 @@
+"""The durable apiserver as its own OS process — the kill target.
+
+Boots FakeApiServer over a persistent state directory (WAL+snapshot,
+`testing/persist.py`) behind the secure HTTP facade, so the restart e2e
+can SIGKILL this process mid-gang and bring it back with state — the
+property the reference's control plane inherits from etcd
+(`profile-controller/controllers/suite_test.go:29-54` spins the real
+thing even for unit tests).
+
+Env contract:
+    KFTPU_REPO        repo root (sys.path bootstrap)
+    KFTPU_STATE_DIR   persistence directory (same across restarts)
+    KFTPU_TOKEN_FILE  kube-style token,user CSV (same across restarts)
+    KFTPU_PORT        fixed port (same across restarts, so clients and
+                      watch streams reconnect without rediscovery)
+    KFTPU_LOG_ROOT    optional pod-log containment root
+
+Prints "apiserver ready <port>" once serving. First boot (empty store)
+seeds the RBAC roles + a system:admin binding; on restart they are
+restored from disk — the e2e asserts that, so don't reseed.
+"""
+
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+from kubeflow_tpu.api.rbac import (  # noqa: E402
+    make_cluster_role_binding,
+    seed_cluster_roles,
+)
+from kubeflow_tpu.api.tokens import TokenRegistry  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp  # noqa: E402
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer  # noqa: E402
+from kubeflow_tpu.web.wsgi import serve  # noqa: E402
+
+
+def main() -> None:
+    api = FakeApiServer(
+        persist_dir=os.path.join(os.environ["KFTPU_STATE_DIR"], "store")
+    )
+    tokens = TokenRegistry.load(os.environ["KFTPU_TOKEN_FILE"])
+    tokens.autosave(os.environ["KFTPU_TOKEN_FILE"])
+    tokens.watch_profiles(api)
+    if api.current_rv == 0:
+        seed_cluster_roles(api)
+        api.create(
+            make_cluster_role_binding(
+                "boot-admin", "kubeflow-admin", "system:admin"
+            )
+        )
+    app = ApiServerApp(
+        api, tokens=tokens, log_root=os.environ.get("KFTPU_LOG_ROOT")
+    )
+    # TLS rides the state dir: a restart reuses the SAME CA, so clients
+    # that pinned it reconnect without re-trusting anything.
+    from kubeflow_tpu.web import tls
+
+    paths = tls.ensure_tls_dir(
+        os.path.join(os.environ["KFTPU_STATE_DIR"], "tls")
+    )
+    server, _ = serve(
+        app,
+        host="127.0.0.1",
+        port=int(os.environ["KFTPU_PORT"]),
+        tls=paths,
+    )
+    print(f"apiserver ready {server.server_port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.shutdown()
+    api.close()  # graceful path folds the WAL into a snapshot
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Checkpoint-resume gang worker for the apiserver-restart e2e.
+
+Incarnation 1: does a few seconds of "work", writes a per-rank
+checkpoint, and exits nonzero (a simulated preemption). The TpuJob
+operator's whole-gang restart then re-creates the gang; incarnation 2
+finds the checkpoint and completes — proving a training job rides
+through a control-plane outage and resumes from its checkpoint with no
+operator intervention.
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    rank = os.environ.get("TPUJOB_PROCESS_ID", "0")
+    path = os.path.join(os.environ["CKPT_DIR"], f"ckpt-{rank}")
+    time.sleep(float(os.environ.get("WORK_SECONDS", "2")))
+    if os.path.exists(path):
+        with open(path) as f:
+            print(f"resumed from checkpoint step={f.read()}", flush=True)
+        return 0
+    with open(path, "w") as f:
+        f.write("100")
+    print("checkpoint written; simulating preemption", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

