@@ -35,7 +35,10 @@ from kubeflow_tpu.testing.fake_apiserver import (
 
 # Matches `python -m kubeflow_tpu.apps` default (--port-base 8080, facade
 # at base+4). Override with --server / KFTPU_SERVER.
-DEFAULT_SERVER = "http://127.0.0.1:8084"
+# The default launcher boot serves the facade HTTPS-only (secure by
+# default); an insecure (--insecure-apiserver) boot needs an explicit
+# --server http://... .
+DEFAULT_SERVER = "https://127.0.0.1:8084"
 
 ALIASES = {
     "notebook": "Notebook", "notebooks": "Notebook", "nb": "Notebook",
@@ -186,7 +189,7 @@ def _get_scoped(client: HttpApiClient, kind, name, namespace, version=None):
     cluster scope, so `describe node tpu-node-0` works without the user
     spelling the empty namespace (kubectl ignores -n for cluster-scoped
     kinds; we have no client-side kind registry to know scope upfront)."""
-    from kubeflow_tpu.testing.fake_apiserver import NotFound
+    from kubeflow_tpu.testing.fake_apiserver import Forbidden, NotFound
 
     if namespace is not None:
         return client.get(kind, name, namespace, version=version)
@@ -194,6 +197,15 @@ def _get_scoped(client: HttpApiClient, kind, name, namespace, version=None):
         return client.get(kind, name, "default", version=version)
     except NotFound:
         return client.get(kind, name, "", version=version)
+    except Forbidden as denied:
+        # A namespace-scoped token 403s the default-ns probe; the target
+        # may still be a cluster-scoped object this identity CAN read
+        # (`describe node x` with a node-reader token). Try cluster scope
+        # before surfacing the denial.
+        try:
+            return client.get(kind, name, "", version=version)
+        except (NotFound, Forbidden):
+            raise denied from None
 
 
 def cmd_describe(client: HttpApiClient, args) -> int:
@@ -430,6 +442,13 @@ def main(argv: list[str] | None = None) -> int:
         help="bearer token for a secure facade (env KFTPU_TOKEN; the "
         "platform launcher prints/saves an admin token at boot)",
     )
+    parser.add_argument(
+        "--ca",
+        default=None,
+        help="platform CA certificate to pin for an https:// server "
+        "(env KFTPU_CA; the launcher prints the path at boot). Tokens "
+        "are refused over plaintext http unless KFTPU_ALLOW_PLAINTEXT=1",
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     get = sub.add_parser("get", help="list a kind or fetch one object")
@@ -486,7 +505,11 @@ def main(argv: list[str] | None = None) -> int:
     traces.set_defaults(fn=cmd_traces)
 
     args = parser.parse_args(argv)
-    client = HttpApiClient(args.server, token=args.token)
+    try:
+        client = HttpApiClient(args.server, token=args.token, ca=args.ca)
+    except ValueError as e:  # e.g. token-over-plaintext refusal
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     try:
         return args.fn(client, args)
     except PermissionError as e:

@@ -85,11 +85,13 @@ class _ProcessWorker:
         name: str,
         apiserver_url: str,
         token: str,
+        ca: str,
         extra_args: tuple[str, ...] = (),
     ):
         self.name = name
         self.apiserver_url = apiserver_url
         self.token = token
+        self.ca = ca
         self.extra_args = extra_args
         self.respawns = 0
         self.last_applied: float = 0.0
@@ -116,6 +118,7 @@ class _ProcessWorker:
                     if p
                 ),
                 "KFTPU_TOKEN": self.token,
+                "KFTPU_CA": self.ca,
             },
             stdout=subprocess.DEVNULL,
             # stderr inherits: a worker failing its CR polls (RBAC, bad
@@ -221,10 +224,23 @@ class DeployServer(App):
         except AlreadyExists:
             pass  # second server over the same store
         self._worker_token = tokens.issue(worker_user)
+        # The worker credential rides TLS (the facade refuses plaintext
+        # tokens by design); workers pin the minted CA via KFTPU_CA.
+        import atexit
+        import shutil
+        import tempfile
+
+        from kubeflow_tpu.web import tls as tlsmod
+
+        tls_dir = tempfile.mkdtemp(prefix="kftpu-deploy-tls-")
+        atexit.register(shutil.rmtree, tls_dir, True)
+        tls_paths = tlsmod.ensure_tls_dir(tls_dir)
+        self._worker_ca = tls_paths.ca_cert
         self._facade, _ = serve(
-            ApiServerApp(self.api, tokens=tokens), host="127.0.0.1", port=0
+            ApiServerApp(self.api, tokens=tokens), host="127.0.0.1", port=0,
+            tls=tls_paths,
         )
-        self._facade_url = f"http://127.0.0.1:{self._facade.server_port}"
+        self._facade_url = f"https://127.0.0.1:{self._facade.server_port}"
         self._monitor = threading.Thread(
             target=self._babysit, name="deploy-worker-monitor", daemon=True
         )
@@ -315,6 +331,7 @@ class DeployServer(App):
                         name,
                         self._facade_url,
                         self._worker_token,
+                        self._worker_ca,
                         self.worker_args,
                     )
                 else:

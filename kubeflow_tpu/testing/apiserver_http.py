@@ -433,6 +433,8 @@ class HttpApiClient:
         watch_poll_timeout: float = 5.0,
         watch_retry: float = 0.5,
         token: str | None = None,
+        ca: str | None = None,
+        allow_plaintext_token: bool | None = None,
     ):
         self.base_url = base_url.rstrip("/")
         # The identity credential (serviceaccount-token analog). Falls
@@ -441,6 +443,48 @@ class HttpApiClient:
         self.token = token if token is not None else os.environ.get(
             "KFTPU_TOKEN"
         )
+        # TLS: pin the platform CA (env fallback KFTPU_CA rides the same
+        # launcher env contract as the token). Verification is against
+        # the pinned CA only — never the system trust store.
+        ca = ca if ca is not None else os.environ.get("KFTPU_CA")
+        self._ssl = None
+        if self.base_url.startswith("https:"):
+            from kubeflow_tpu.web import tls as tlsmod
+
+            if ca:
+                self._ssl = tlsmod.client_context(ca)
+            elif os.environ.get("KFTPU_SYSTEM_TRUST") == "1":
+                # Publicly-signed deployments opt into the system trust
+                # store explicitly.
+                import ssl as _ssl
+
+                self._ssl = _ssl.create_default_context()
+            else:
+                # The platform CA is self-signed: without the pin every
+                # request would die later with an opaque
+                # CERTIFICATE_VERIFY_FAILED. Fail actionably, now.
+                raise ValueError(
+                    f"https server {self.base_url!r} needs the platform "
+                    "CA pinned (ca=/--ca/KFTPU_CA; the launcher prints "
+                    "the path at boot), or KFTPU_SYSTEM_TRUST=1 for a "
+                    "publicly-signed endpoint"
+                )
+        elif self.token:
+            # A bearer token over cleartext is a leaked credential, not a
+            # working config: refuse unless the caller explicitly opts
+            # in (loopback-only test rigs; KFTPU_ALLOW_PLAINTEXT=1 for
+            # spawned workers). Secure-by-default, like the serving side.
+            if allow_plaintext_token is None:
+                allow_plaintext_token = os.environ.get(
+                    "KFTPU_ALLOW_PLAINTEXT"
+                ) == "1"
+            if not allow_plaintext_token:
+                raise ValueError(
+                    f"refusing to send a bearer token over plaintext "
+                    f"{self.base_url!r} — use https:// (pin the CA via "
+                    f"ca=/KFTPU_CA) or pass allow_plaintext_token=True / "
+                    f"KFTPU_ALLOW_PLAINTEXT=1 for a trusted loopback"
+                )
         self.timeout = timeout
         self.watch_poll_timeout = watch_poll_timeout
         self.watch_retry = watch_retry
@@ -463,7 +507,9 @@ class HttpApiClient:
             },
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl
+            ) as resp:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
@@ -551,7 +597,9 @@ class HttpApiClient:
             headers={**self._auth_header(), **tracing.trace_header()},
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl
+            ) as resp:
                 return resp.read().decode(errors="replace")
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")

@@ -1,0 +1,185 @@
+"""Control-plane TLS: a platform CA + server certificates.
+
+The reference never serves a custom listener in clear: its only in-repo
+custom server is TLS-only (`admission-webhook/main.go:443` raw TLS,
+`:597`), apiserver traffic is always TLS, and the edge is
+IAP-authenticated (`metric-collector/service-readiness/
+kubeflow-readiness.py:21-38`). Our facade authenticates every request
+with bearer tokens (`api/tokens.py`) — tokens that must not ride
+plaintext between processes. This module is the cert plumbing:
+
+- `ensure_tls_dir(dir)` mints (idempotently) a CA plus a server cert
+  with localhost/127.0.0.1 SANs into `dir` and returns the paths — the
+  launcher calls it at boot, clients pin `ca.crt`;
+- `server_context`/`client_context` build the ssl contexts both ends
+  use (client side verifies against the pinned CA only — no system
+  trust store, so a stolen public CA cert is useless against us).
+
+Key files are written 0600. Certs are valid for ~2 years; the CA is an
+issuing root only (pathlen 0, CA:TRUE), the server key is a leaf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import ipaddress
+import os
+import ssl
+import threading
+
+_mint_lock = threading.Lock()
+
+
+@dataclasses.dataclass(frozen=True)
+class TlsPaths:
+    ca_cert: str
+    server_cert: str
+    server_key: str
+
+
+def _write_private(path: str, data: bytes) -> None:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+
+
+def _expiring_soon(cert_path: str, margin_days: float = 30.0) -> bool:
+    """True when the cert is unreadable, expired, or within the renewal
+    margin — a state-dir older than the cert lifetime must re-mint at
+    boot, not serve an expired cert forever."""
+    from cryptography import x509
+
+    try:
+        with open(cert_path, "rb") as f:
+            cert = x509.load_pem_x509_certificate(f.read())
+    except (OSError, ValueError):
+        return True
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return cert.not_valid_after_utc <= now + datetime.timedelta(
+        days=margin_days
+    )
+
+
+def ensure_tls_dir(
+    directory: str, hosts: tuple[str, ...] = ("localhost", "127.0.0.1")
+) -> TlsPaths:
+    """Mint (or reuse) a CA + server cert pair under `directory`."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(directory, mode=0o700, exist_ok=True)
+    paths = TlsPaths(
+        ca_cert=os.path.join(directory, "ca.crt"),
+        server_cert=os.path.join(directory, "server.crt"),
+        server_key=os.path.join(directory, "server.key"),
+    )
+    hosts_marker = os.path.join(directory, "hosts")
+    hosts_line = ",".join(hosts)
+    with _mint_lock:
+        if all(
+            os.path.exists(p)
+            for p in (paths.ca_cert, paths.server_cert, paths.server_key)
+        ):
+            try:
+                with open(hosts_marker) as f:
+                    prior = f.read().strip()
+            except FileNotFoundError:
+                prior = ""
+            if prior == hosts_line and not _expiring_soon(
+                paths.server_cert
+            ):
+                # Durable restart: same CA, clients stay pinned.
+                return paths
+            # Host set changed (rebooted with a different --host) or the
+            # cert is near/past expiry (the CA key is deliberately not
+            # kept, so renewal IS a re-mint) — re-mint the whole dir;
+            # clients re-pin the printed CA.
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        not_after = now + datetime.timedelta(days=730)
+
+        def name(cn: str) -> x509.Name:
+            return x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
+            )
+
+        # EC keys: small, fast handshakes, no RSA keygen latency at boot.
+        ca_key = ec.generate_private_key(ec.SECP256R1())
+        ca_cert = (
+            x509.CertificateBuilder()
+            .subject_name(name("kubeflow-tpu-ca"))
+            .issuer_name(name("kubeflow-tpu-ca"))
+            .public_key(ca_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(not_after)
+            .add_extension(
+                x509.BasicConstraints(ca=True, path_length=0), critical=True
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+
+        server_key = ec.generate_private_key(ec.SECP256R1())
+        sans: list[x509.GeneralName] = []
+        for host in hosts:
+            try:
+                sans.append(x509.IPAddress(ipaddress.ip_address(host)))
+            except ValueError:
+                sans.append(x509.DNSName(host))
+        server_cert = (
+            x509.CertificateBuilder()
+            .subject_name(name("kubeflow-tpu-apiserver"))
+            .issuer_name(ca_cert.subject)
+            .public_key(server_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(not_after)
+            .add_extension(
+                x509.BasicConstraints(ca=False, path_length=None),
+                critical=True,
+            )
+            .add_extension(
+                x509.SubjectAlternativeName(sans), critical=False
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+
+        pem = serialization.Encoding.PEM
+        with open(paths.ca_cert, "wb") as f:
+            f.write(ca_cert.public_bytes(pem))
+        with open(paths.server_cert, "wb") as f:
+            f.write(server_cert.public_bytes(pem))
+        _write_private(
+            paths.server_key,
+            server_key.private_bytes(
+                pem,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            ),
+        )
+        with open(hosts_marker, "w") as f:
+            f.write(hosts_line + "\n")
+        # The CA key is NOT persisted: nothing needs to issue later certs
+        # (rotation = re-mint the whole dir), and a CA key on disk is the
+        # one secret that would let an attacker impersonate the apiserver.
+        return paths
+
+
+def server_context(paths: TlsPaths) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(paths.server_cert, paths.server_key)
+    return ctx
+
+
+def client_context(ca_cert: str) -> ssl.SSLContext:
+    """Verify the server against the pinned platform CA only."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.check_hostname = True
+    ctx.load_verify_locations(cafile=ca_cert)
+    return ctx

@@ -289,19 +289,56 @@ class _ThreadingWSGIServer(socketserver.ThreadingMixIn, WSGIServer):
     daemon_threads = True
 
 
-def serve(app: App, host: str = "0.0.0.0", port: int = 8080):
+class _TlsThreadingWSGIServer(_ThreadingWSGIServer):
+    """TLS server whose handshake runs in the per-request thread, not the
+    accept loop: wrap_socket here defers the handshake
+    (do_handshake_on_connect=False; it happens transparently on the
+    handler's first read) — otherwise one stalled client parks accept()
+    and blocks every request including /healthz, the exact failure the
+    per-request-thread design exists to prevent."""
+
+    ssl_context = None
+
+    def get_request(self):
+        conn, addr = super().get_request()
+        conn = self.ssl_context.wrap_socket(
+            conn, server_side=True, do_handshake_on_connect=False
+        )
+        return conn, addr
+
+    def handle_error(self, request, client_address):
+        # Failed handshakes (plaintext probes, wrong CA) are expected
+        # noise at a TLS port, not tracebacks worth stderr.
+        log.debug("error handling request from %s", client_address,
+                  exc_info=True)
+
+
+def serve(app: App, host: str = "0.0.0.0", port: int = 8080, tls=None):
     """Serve on a background thread; returns (server, thread).
 
     Connections are handled on per-request threads so a stalled client
     can't block /healthz probes. `server.server_port` gives the bound
-    port (use port=0 in tests)."""
+    port (use port=0 in tests).
+
+    `tls` (a `web.tls.TlsPaths`) serves HTTPS: each accepted connection
+    is wrapped server-side (handshake in the request thread), so a
+    plaintext client gets a handshake error — never a served request.
+    The secure facade always passes this (bearer tokens must not ride
+    cleartext; the reference's only custom listener is TLS-only,
+    `admission-webhook/main.go:443`)."""
     server = make_server(
         host,
         port,
         app,
-        server_class=_ThreadingWSGIServer,
+        server_class=(
+            _ThreadingWSGIServer if tls is None else _TlsThreadingWSGIServer
+        ),
         handler_class=_QuietHandler,
     )
+    if tls is not None:
+        from kubeflow_tpu.web import tls as tlsmod
+
+        server.ssl_context = tlsmod.server_context(tls)
     thread = threading.Thread(
         target=server.serve_forever, name=f"{app.name}-http", daemon=True
     )

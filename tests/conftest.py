@@ -37,3 +37,13 @@ def mesh8(devices):
     from kubeflow_tpu.parallel import MeshSpec, build_mesh
 
     return build_mesh(MeshSpec(dp=2, fsdp=2, tp=2), devices)
+
+
+@pytest.fixture(scope="session")
+def tls_paths(tmp_path_factory):
+    """One platform CA + server cert for the whole test session: every
+    secure-facade test serves HTTPS with these and pins the CA — bearer
+    tokens never ride plaintext, mirroring the launcher's boot path."""
+    from kubeflow_tpu.web import tls
+
+    return tls.ensure_tls_dir(str(tmp_path_factory.mktemp("tls")))

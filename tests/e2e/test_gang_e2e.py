@@ -59,7 +59,7 @@ def test_tpujob_gang_end_to_end(tmp_path):
     assert "psum ok" in logs.get("e2e-worker-1.log", ""), logs
 
 
-def test_distributed_training_end_to_end(tmp_path):
+def test_distributed_training_end_to_end(tmp_path, tls_paths):
     """TpuJob gang of 2 real processes trains a tiny ResNet over a dp
     mesh (gloo collectives), and rank 0's reported observation flows back
     onto the job — training results, not just liveness, cross the
@@ -85,15 +85,17 @@ def test_distributed_training_end_to_end(tmp_path):
         make_cluster_role_binding("train-worker", "train-worker", worker_user)
     )
     server, _ = serve(
-        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
     )
     ctl = TpuJobController(api)
     runner = LocalPodRunner(
         api,
         extra_env={
             "KFTPU_REPO": REPO,
-            "KFTPU_APISERVER": f"http://127.0.0.1:{server.server_port}",
+            "KFTPU_APISERVER": f"https://127.0.0.1:{server.server_port}",
             "KFTPU_TOKEN": tokens.issue(worker_user),
+            "KFTPU_CA": tls_paths.ca_cert,
         },
         capture_dir=str(tmp_path / "logs"),
     )

@@ -47,7 +47,7 @@ CONTROLLER_RULES = [
 ]
 
 
-def test_out_of_process_controller_runs_gang(tmp_path):
+def test_out_of_process_controller_runs_gang(tmp_path, tls_paths):
     api = FakeApiServer()
     tokens = TokenRegistry()
     ctl_user = service_account("kubeflow", "tpujob-controller")
@@ -56,14 +56,17 @@ def test_out_of_process_controller_runs_gang(tmp_path):
         make_cluster_role_binding("tpujob-controller", "tpujob-controller",
                                   ctl_user)
     )
+    # The production topology all the way: the cross-process credential
+    # rides TLS with the platform CA pinned, never plaintext.
     server, _ = serve(
-        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
     )
-    base_url = f"http://127.0.0.1:{server.server_port}"
+    base_url = f"https://127.0.0.1:{server.server_port}"
 
     # The secure boundary actually holds: no token → no write.
     with pytest.raises(PermissionError):
-        HttpApiClient(base_url, token="").create(
+        HttpApiClient(base_url, token="", ca=tls_paths.ca_cert).create(
             new_resource("ConfigMap", "x", "default", spec={})
         )
 
@@ -76,6 +79,7 @@ def test_out_of_process_controller_runs_gang(tmp_path):
             # Least-privilege credential: the controller runs with its own
             # serviceaccount token, not cluster-admin.
             "KFTPU_TOKEN": tokens.issue(ctl_user),
+            "KFTPU_CA": tls_paths.ca_cert,
         },
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,

@@ -34,22 +34,28 @@ STATIC = REPO / "kubeflow_tpu" / "apps" / "static"
 USER = "alice@corp.com"
 
 
-def _req(url, body=None, method=None, token=None):
+def _req(url, body=None, method=None, token=None, ca=None):
     data = json.dumps(body).encode() if body is not None else None
     headers = {"Content-Type": "application/json"} if data else {}
     if token:
         headers["Authorization"] = f"Bearer {token}"
     r = urllib.request.Request(url, data=data, method=method, headers=headers)
-    with urllib.request.urlopen(r, timeout=20) as resp:
+    ctx = None
+    if ca:
+        from kubeflow_tpu.web import tls as tlsmod
+
+        ctx = tlsmod.client_context(ca)
+    with urllib.request.urlopen(r, timeout=20, context=ctx) as resp:
         raw = resp.read()
         return resp.status, json.loads(raw) if raw.strip() else {}
 
 
-def _read_admin_token(proc, timeout=30):
-    """The launcher prints the minted facade credential at boot (secure
-    by default since the bearer-token round); scrape it like an operator
-    would."""
+def _read_boot_secrets(proc, timeout=30):
+    """The launcher prints the minted facade credential AND the CA path
+    at boot (secure-and-TLS by default); scrape them like an operator
+    would: (token, ca_path)."""
     deadline = time.time() + timeout
+    token = ca = None
     while time.time() < deadline:
         line = proc.stdout.readline()
         if not line:
@@ -57,8 +63,13 @@ def _read_admin_token(proc, timeout=30):
             continue
         m = re.match(r"apiserver admin token: (\S+)", line)
         if m:
-            return m.group(1)
-    raise TimeoutError("launcher never printed the apiserver admin token")
+            token = m.group(1)
+        m = re.match(r"apiserver CA .*: (\S+)", line)
+        if m:
+            ca = m.group(1)
+        if token and ca:
+            return token, ca
+    raise TimeoutError("launcher never printed the token + CA lines")
 
 
 def _wait(pred, timeout=90, interval=0.5):
@@ -83,7 +94,7 @@ def test_spawn_path_over_live_servers(tmp_path):
     dash = f"http://127.0.0.1:{port}"
     jup = f"http://127.0.0.1:{port + 2}"
     try:
-        token = _read_admin_token(proc)
+        token, ca = _read_boot_secrets(proc)
         _wait(lambda: _probe_up(f"{dash}/healthz"), timeout=60)
 
         # 1. Fresh user: no workgroup yet → register (dashboard flow).
@@ -136,17 +147,30 @@ def test_spawn_path_over_live_servers(tmp_path):
         #    /notebook/{ns}/my-nb/, which the controller's
         #    VirtualService carries (generateVirtualService parity,
         #    notebook_controller.go:379) — read it off the facade.
-        facade = f"http://127.0.0.1:{port + 4}"
-        # The facade is secure: no token → 401; the minted admin token
-        # reads the controller-created VirtualService.
+        facade = f"https://127.0.0.1:{port + 4}"
+        # The facade is secure AND TLS: plaintext is a handshake error,
+        # no token → 401, and the minted admin token (over TLS with the
+        # pinned CA) reads the controller-created VirtualService.
         try:
-            _req(f"{facade}/apis/VirtualService/{ns}/notebook-{ns}-my-nb")
+            _req(f"http://127.0.0.1:{port + 4}/healthz")
+        except urllib.error.HTTPError:
+            # An HTTP status IS a plaintext response — exactly the
+            # regression this guards against (HTTPError is an OSError
+            # subclass, so it must be caught before the refusal case).
+            raise AssertionError("facade served plaintext HTTP")
+        except OSError:
+            pass  # handshake-level refusal — the TLS port stayed TLS
+        else:
+            raise AssertionError("facade answered plaintext HTTP")
+        try:
+            _req(f"{facade}/apis/VirtualService/{ns}/notebook-{ns}-my-nb",
+                 ca=ca)
             raise AssertionError("facade served an unauthenticated read")
         except urllib.error.HTTPError as e:
             assert e.code == 401, e.code
         _, vs = _req(
             f"{facade}/apis/VirtualService/{ns}/notebook-{ns}-my-nb",
-            token=token,
+            token=token, ca=ca,
         )
         assert f"/notebook/{ns}/my-nb/" in json.dumps(vs["spec"]), vs
 

@@ -272,19 +272,22 @@ def test_token_registry_roundtrip(tmp_path):
     assert loaded.authenticate(t1) is None
 
 
-def test_http_client_token_end_to_end():
-    """Over a real socket: admin token works, no token → PermissionError."""
+def test_http_client_token_end_to_end(tls_paths):
+    """Over a real TLS socket: admin token works, no token →
+    PermissionError (and the token never rides plaintext)."""
     api, tokens, app = secure_app()
     grant(api, "admin", "kubeflow-admin", "system:admin")
-    server, _ = serve(app, host="127.0.0.1", port=0)
-    base = f"http://127.0.0.1:{server.server_port}"
+    server, _ = serve(app, host="127.0.0.1", port=0, tls=tls_paths)
+    base = f"https://127.0.0.1:{server.server_port}"
     try:
-        admin = HttpApiClient(base, token=tokens.issue("system:admin"))
+        admin = HttpApiClient(
+            base, token=tokens.issue("system:admin"), ca=tls_paths.ca_cert
+        )
         created = admin.create(
             new_resource("ConfigMap", "cm", "default", spec={"k": "v"})
         )
         assert created.metadata.name == "cm"
-        anon = HttpApiClient(base, token="")
+        anon = HttpApiClient(base, token="", ca=tls_paths.ca_cert)
         with pytest.raises(PermissionError):
             anon.create(new_resource("ConfigMap", "cm2", "default", spec={}))
         with pytest.raises(PermissionError):

