@@ -37,11 +37,13 @@ from kubeflow_tpu.controllers.profile import ProfileController
 from kubeflow_tpu.controllers.runtime import ControllerManager
 from kubeflow_tpu.controllers.study import StudyController
 from kubeflow_tpu.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.controllers import tpujob as tpujob_mod
 from kubeflow_tpu.controllers.tpujob import TpuJobController
 from kubeflow_tpu.controllers.workflow import WorkflowController
 from kubeflow_tpu.runtime import LocalPodRunner, WorkloadMaterializer
 from kubeflow_tpu.testing.apiserver_http import ApiServerApp
-from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.testing.fake_apiserver import AlreadyExists, FakeApiServer
+from kubeflow_tpu.web import tls
 from kubeflow_tpu.web.authn import HeaderAuthn
 from kubeflow_tpu.web.wsgi import serve
 
@@ -67,6 +69,14 @@ def main() -> None:
         "admin token is minted, printed, and saved to a token file",
     )
     parser.add_argument(
+        "--state-dir",
+        default=None,
+        help="durable control-plane state: the store persists here "
+        "(WAL+snapshot) and the admin token file lives here, so the "
+        "platform can be killed and restarted WITH its CRs — the etcd "
+        "role in the reference's control plane. Default: in-memory only",
+    )
+    parser.add_argument(
         "--nodes",
         type=int,
         default=4,
@@ -83,26 +93,47 @@ def main() -> None:
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    api = FakeApiServer()
-    seed_cluster_roles(api)
-    for i in range(args.nodes):
-        # x spreads the nodes on the ICI ring so placement cost is
-        # non-degenerate (matches the scheduler-test fixtures).
-        node = new_resource(
-            "Node",
-            f"tpu-node-{i}",
-            "",
-            spec={"pool": args.node_pool, "chips": 4, "x": i, "y": 0},
+    if args.state_dir:
+        os.makedirs(args.state_dir, mode=0o700, exist_ok=True)
+        api = FakeApiServer(
+            persist_dir=os.path.join(args.state_dir, "store")
         )
-        node.status = {
-            "ready": True,
-            "cpuUtilization": 0.1,
-            "memoryUtilization": 0.2,
-            "tpuDutyCycle": 0.0,
-        }
-        api.create(node)
+    else:
+        api = FakeApiServer()
+    # Seed only a FRESH store: on a durable restart the roles, nodes and
+    # bindings come back from disk (re-creating them would AlreadyExists).
+    if api.current_rv == 0:
+        seed_cluster_roles(api)
+        for i in range(args.nodes):
+            # x spreads the nodes on the ICI ring so placement cost is
+            # non-degenerate (matches the scheduler-test fixtures).
+            node = new_resource(
+                "Node",
+                f"tpu-node-{i}",
+                "",
+                spec={"pool": args.node_pool, "chips": 4, "x": i, "y": 0},
+            )
+            node.status = {
+                "ready": True,
+                "cpuUtilization": 0.1,
+                "memoryUtilization": 0.2,
+                "tpuDutyCycle": 0.0,
+            }
+            api.create(node)
     if args.admin:
-        api.create(make_cluster_role_binding("boot-admin", "kubeflow-admin", args.admin))
+        # Outside the fresh-store guard: --admin on a durable RESTART
+        # must grant too, not be silently ignored. The binding name is
+        # per-user — a fixed name would make a second --admin user
+        # collide with the persisted first and silently get nothing.
+        import hashlib
+
+        suffix = hashlib.sha256(args.admin.encode()).hexdigest()[:8]
+        try:
+            api.create(make_cluster_role_binding(
+                f"boot-admin-{suffix}", "kubeflow-admin", args.admin
+            ))
+        except AlreadyExists:
+            pass  # same user re-granted across restarts
 
     manager = ControllerManager()
     for ctl in (
@@ -118,6 +149,7 @@ def main() -> None:
         manager.add(ctl.controller)
     poddefault.register(api)
     quota.register(api)
+    tpujob_mod.register_admission(api)
     manager.start()
 
     # Pod runtime: without one, TpuJob/Study/Workflow pods would sit
@@ -153,23 +185,59 @@ def main() -> None:
     # token file (kube-apiserver --token-auth-file analog) so the CLI can
     # be pointed at it: `--token $(cut -d, -f1 <file>)` or KFTPU_TOKEN.
     tokens = None
+    tls_paths = None
     if not args.insecure_apiserver:
-        tokens = TokenRegistry()
-        admin_token = tokens.issue("system:admin")
-        api.create(
-            make_cluster_role_binding(
-                "system-admin", "kubeflow-admin", "system:admin"
+        if args.state_dir:
+            # Durable boot: token file rides the state dir, so a restart
+            # keeps the SAME admin credential the operator already holds.
+            token_file = os.path.join(args.state_dir, "tokens")
+            tokens = (
+                TokenRegistry.load(token_file)
+                if os.path.exists(token_file)
+                else TokenRegistry()
             )
+        else:
+            # NOT under log_dir: that directory is the facade's pod-log
+            # containment root, and status.logPath is client-writable — a
+            # secret inside it would be readable via GET .../log.
+            token_dir = tempfile.mkdtemp(prefix="kftpu-apiserver-")
+            atexit.register(shutil.rmtree, token_dir, True)
+            token_file = os.path.join(token_dir, "tokens")
+            tokens = TokenRegistry()
+        # Every token mutation persists — revocation must be as durable
+        # as issuance (a restart must not resurrect revoked credentials).
+        tokens.autosave(token_file)
+        admin_token = tokens.token_for("system:admin")
+        if admin_token is None:
+            admin_token = tokens.issue("system:admin")
+        # Tenant teardown revokes the tenant's serviceaccount tokens.
+        tokens.watch_profiles(api)
+        try:
+            api.create(
+                make_cluster_role_binding(
+                    "system-admin", "kubeflow-admin", "system:admin"
+                )
+            )
+        except AlreadyExists:
+            pass  # restored from disk
+        # Secure facade = TLS facade: bearer tokens never ride cleartext
+        # (clients refuse to send them over http). The CA rides next to
+        # the token file — durable boots keep the same CA so pinned
+        # clients reconnect across restarts.
+        # SANs cover loopback plus the actual bind host (a cert that
+        # only names localhost is unverifiable by every LAN client the
+        # moment --host is non-loopback). 0.0.0.0 is a bind address,
+        # not a reachable name — clients connect via a concrete host.
+        hosts = ["localhost", "127.0.0.1"]
+        if args.host not in hosts and args.host != "0.0.0.0":
+            hosts.append(args.host)
+        tls_paths = tls.ensure_tls_dir(
+            os.path.join(os.path.dirname(token_file), "tls"),
+            hosts=tuple(hosts),
         )
-        # NOT under log_dir: that directory is the facade's pod-log
-        # containment root, and status.logPath is client-writable — a
-        # secret inside it would be readable via GET .../log.
-        token_dir = tempfile.mkdtemp(prefix="kftpu-apiserver-")
-        atexit.register(shutil.rmtree, token_dir, True)
-        token_file = os.path.join(token_dir, "tokens")
-        tokens.save(token_file)
         print(f"apiserver admin token: {admin_token}")
         print(f"apiserver token file:  {token_file}")
+        print(f"apiserver CA (pin via --ca/KFTPU_CA): {tls_paths.ca_cert}")
     apps = [
         DashboardApp(api, authn=authn),
         KfamApp(api, authn=authn),
@@ -183,9 +251,18 @@ def main() -> None:
     ]
     servers = []
     for offset, app in enumerate(apps):
-        server, _ = serve(app, host=args.host, port=args.port_base + offset)
+        # Only the facade carries bearer tokens; the web apps sit behind
+        # header authn (mesh-terminated in the reference) and stay http.
+        is_facade = app.name == "apiserver"
+        server, _ = serve(
+            app,
+            host=args.host,
+            port=args.port_base + offset,
+            tls=tls_paths if is_facade else None,
+        )
         servers.append(server)
-        print(f"{app.name}: http://{args.host}:{server.server_port}")
+        scheme = "https" if (is_facade and tls_paths) else "http"
+        print(f"{app.name}: {scheme}://{args.host}:{server.server_port}")
     try:
         while True:
             time.sleep(3600)
@@ -194,6 +271,7 @@ def main() -> None:
         runner.shutdown()
         for server in servers:
             server.shutdown()
+        api.close()  # durable boot: fold the WAL into a snapshot
 
 
 if __name__ == "__main__":
