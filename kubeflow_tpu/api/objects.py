@@ -138,16 +138,69 @@ def owner_ref(owner: Resource, *, controller: bool = True) -> dict:
     }
 
 
-def container_limits_total(pod: "Resource", resource: str) -> int:
+# K8s quantity suffixes (resource.Quantity): decimal SI, binary, milli.
+_QUANTITY_SUFFIXES = {
+    "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30,
+    "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+_SUFFIXES_BY_LEN = sorted(_QUANTITY_SUFFIXES, key=len, reverse=True)
+
+
+def parse_quantity(value) -> float:
+    """A K8s resource quantity as a float in its base unit (cores,
+    bytes, chips): ``"500m"`` → 0.5, ``"128Gi"`` → 137438953472.0,
+    ``2`` → 2.0. The grammar the reference's ResourceQuotaSpec fields
+    carry (`profile-controller/api/v1/profile_types.go:36-44`, corev1
+    quantities). Raises ValueError on anything unparseable."""
+    import math
+
+    def _finite(x: float) -> float:
+        # Limits/caps are finite and non-negative: 'inf'/'nan'/1e400
+        # must be a clean rejection here (not an OverflowError deep in
+        # quota arithmetic), and a negative "limit" would SUBTRACT from
+        # quota usage — a one-line quota bypass.
+        if not math.isfinite(x) or x < 0:
+            raise ValueError(
+                f"not a non-negative finite quantity: {value!r}"
+            )
+        return x
+
+    if isinstance(value, bool):
+        raise ValueError(f"not a quantity: {value!r}")
+    if isinstance(value, (int, float)):
+        return _finite(float(value))
+    s = str(value).strip()
+    for suffix in _SUFFIXES_BY_LEN:
+        if s.endswith(suffix):
+            try:
+                return _finite(
+                    float(s[: -len(suffix)]) * _QUANTITY_SUFFIXES[suffix]
+                )
+            except ValueError:
+                break  # e.g. "Gi" alone / "xMi": fall through to error
+    try:
+        return _finite(float(s))
+    except ValueError:
+        raise ValueError(f"not a quantity: {value!r}") from None
+
+
+def container_limits_total(pod: "Resource", resource: str) -> int | float:
     """Sum a resource limit across ALL of a pod's containers (a limit on
-    a second container counts; an empty container list is 0). The one
+    a second container counts; an empty container list is 0). Values are
+    K8s quantities ("500m", "128Gi", 4); integral totals come back as
+    int (chip counts feed ctypes int32 scheduler calls). The one
     accounting rule shared by quota admission, the gang scheduler's
     reservations, and the CLI's fleet view — they must never disagree on
     how many chips a pod holds."""
-    return sum(
-        int(c.get("resources", {}).get("limits", {}).get(resource, 0))
+    total = sum(
+        parse_quantity(
+            c.get("resources", {}).get("limits", {}).get(resource, 0)
+        )
         for c in pod.spec.get("containers", [])
     )
+    return int(total) if total == int(total) else total
 
 
 def fresh_uid() -> str:

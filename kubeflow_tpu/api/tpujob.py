@@ -39,6 +39,11 @@ class TpuJobSpec:
     num_slices: int = 1
     max_restarts: int = 3
     checkpoint_dir: str = ""
+    # Per-worker host-resource limits (K8s quantities, e.g.
+    # ("cpu", "500m"), ("memory", "2Gi")) — metered by quota admission
+    # alongside the chip count (the reference's TFJob replica specs carry
+    # full corev1 resource limits, `create_job_specs.py:24-27`).
+    resources: tuple[tuple[str, str], ...] = ()
     # Gang priority (the PriorityClass analog, flattened to an int):
     # when chips are scarce, a pending gang may PREEMPT running gangs of
     # strictly lower priority in its pool (whole gangs — all-or-nothing
@@ -57,6 +62,18 @@ class TpuJobSpec:
             )
         if self.max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
+        from kubeflow_tpu.api.objects import parse_quantity
+
+        for resource, value in self.resources:
+            if resource == "google.com/tpu":
+                raise ValueError(
+                    "spec the chip count via tpu.chipsPerWorker, not "
+                    "resources['google.com/tpu'] — one source of truth"
+                )
+            try:
+                parse_quantity(value)
+            except ValueError as e:
+                raise ValueError(f"resources[{resource!r}]: {e}") from e
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -73,6 +90,7 @@ class TpuJobSpec:
             "maxRestarts": self.max_restarts,
             "checkpointDir": self.checkpoint_dir,
             "priority": self.priority,
+            "resources": {k: v for k, v in self.resources},
         }
 
     @classmethod
@@ -113,6 +131,9 @@ class TpuJobSpec:
             max_restarts=d.get("maxRestarts", 3),
             checkpoint_dir=d.get("checkpointDir", ""),
             priority=int(d.get("priority", 0)),
+            resources=tuple(
+                sorted((d.get("resources") or {}).items())
+            ),
         )
         spec.validate()
         return spec

@@ -24,16 +24,24 @@ cuts both ways: if one worker doesn't fit the budget, none start).
 
 from __future__ import annotations
 
-from kubeflow_tpu.api.objects import Resource, container_limits_total
+from kubeflow_tpu.api.objects import (
+    Resource,
+    container_limits_total,
+    parse_quantity,
+)
 from kubeflow_tpu.testing.fake_apiserver import (
     FakeApiServer,
     Invalid,
     NotFound,
 )
 
-# Resources the platform meters. cpu/memory strings ("64", "128Gi") are
-# K8s quantities; the TPU resource is always an integer chip count.
-METERED = ("google.com/tpu",)
+# Resources the platform meters — the full set a Profile's
+# resourceQuotaSpec can cap (the reference's ResourceQuotaSpec is the
+# corev1 type enforced for ALL listed resources by the real apiserver,
+# `profile-controller/api/v1/profile_types.go:36-44`). cpu/memory values
+# are K8s quantities ("500m", "128Gi"); the TPU resource is an integer
+# chip count.
+METERED = ("google.com/tpu", "cpu", "memory")
 
 
 class QuotaExceeded(Invalid):
@@ -42,16 +50,41 @@ class QuotaExceeded(Invalid):
     admission rejection uses."""
 
 
-def _usage(
-    api: FakeApiServer, namespace: str, resource: str, exclude: str
-) -> int:
-    used = 0
+def _milli(value) -> int:
+    """Quantity → integer milli-units. All quota arithmetic happens in
+    millis (K8s does the same): binary floats would spuriously reject
+    exact fits (0.1+0.1+0.1 > 0.3 in float64)."""
+    return round(parse_quantity(value) * 1000)
+
+
+def _usage_milli(
+    api: FakeApiServer,
+    namespace: str,
+    resources: list[str],
+    exclude: str,
+) -> dict[str, int]:
+    """Live usage per metered resource — ONE pod scan for all of them
+    (each list() deepcopies every pod under the store lock; per-resource
+    scans would triple the admission cost)."""
+    used = dict.fromkeys(resources, 0)
     for pod in api.list("Pod", namespace):
         if pod.metadata.name == exclude:
             continue
         if pod.status.get("phase") in ("Succeeded", "Failed"):
             continue
-        used += container_limits_total(pod, resource)
+        for resource in resources:
+            try:
+                used[resource] += round(
+                    container_limits_total(pod, resource) * 1000
+                )
+            except ValueError as e:
+                # Name the culprit: a garbage limit on a PRE-EXISTING
+                # pod (admitted before the quota existed) must not be
+                # an anonymous 500 on every later admission.
+                raise ValueError(
+                    f"existing pod {pod.metadata.name!r} has an "
+                    f"unusable {resource!r} limit: {e}"
+                ) from e
     return used
 
 
@@ -65,19 +98,37 @@ def check_pod(api: FakeApiServer, pod: Resource) -> Resource:
     # Any OTHER read failure propagates: silently skipping the check
     # would turn the caps decorative again — fail closed, not open.
     hard = rq.spec.get("hard", {})
-    for resource in METERED:
-        if resource not in hard:
-            continue
-        cap = int(hard[resource])
-        ask = container_limits_total(pod, resource)
-        if ask == 0:
-            continue
-        used = _usage(api, namespace, resource, exclude=pod.metadata.name)
-        if used + ask > cap:
+    try:
+        asks = {
+            resource: round(container_limits_total(pod, resource) * 1000)
+            for resource in METERED
+            if resource in hard
+        }
+    except ValueError as e:
+        # Garbage/negative limits in a metered namespace are a client
+        # error (422), not an internal one: a negative "limit" would
+        # SUBTRACT from usage — a quota bypass.
+        raise Invalid(f"pod {pod.metadata.name!r}: {e}") from e
+    asks = {r: a for r, a in asks.items() if a > 0}
+    if not asks:
+        return pod
+    try:
+        used = _usage_milli(
+            api, namespace, list(asks), exclude=pod.metadata.name
+        )
+        caps = {r: _milli(hard[r]) for r in asks}
+    except ValueError as e:
+        # A malformed CAP (the profile's resourceQuotaSpec passes
+        # through verbatim) or a garbage stored limit: still a 422
+        # with the culprit named — never a raw 500 crash-loop.
+        raise Invalid(f"quota evaluation in {namespace!r}: {e}") from e
+    for resource, ask in asks.items():
+        if used[resource] + ask > caps[resource]:
             raise QuotaExceeded(
                 f"pod {pod.metadata.name!r} exceeds ResourceQuota "
                 f"{resource!r} in namespace {namespace!r}: "
-                f"used {used} + requested {ask} > hard cap {cap}"
+                f"used {used[resource] / 1000:g} + requested "
+                f"{ask / 1000:g} > hard cap {hard[resource]}"
             )
     return pod
 

@@ -146,3 +146,208 @@ def test_materializer_contains_quota_rejection():
         if e.spec["reason"] == "PodRejected"
     )
     assert count == 1
+
+
+# -- K8s quantity parsing (the grammar corev1 ResourceQuotaSpec carries,
+# `profile-controller/api/v1/profile_types.go:36-44`) -----------------------
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        (4, 4.0),
+        ("2", 2.0),
+        ("1.5", 1.5),
+        ("500m", 0.5),
+        ("2500m", 2.5),
+        ("1k", 1000.0),
+        ("1M", 1e6),
+        ("2G", 2e9),
+        ("1Ki", 1024.0),
+        ("128Mi", 128 * 2**20),
+        ("128Gi", 128 * 2**30),
+        ("1Ti", 2**40),
+        ("2E", 2e18),
+        ("1e3", 1000.0),
+        ("  64  ", 64.0),
+    ],
+)
+def test_parse_quantity_table(value, expected):
+    from kubeflow_tpu.api.objects import parse_quantity
+
+    assert parse_quantity(value) == expected
+
+
+@pytest.mark.parametrize("bad", ["", "Gi", "xMi", "4x4", "12GiB", True, None])
+def test_parse_quantity_rejects_garbage(bad):
+    from kubeflow_tpu.api.objects import parse_quantity
+
+    with pytest.raises((ValueError, TypeError)):
+        parse_quantity(bad)
+
+
+# -- cpu/memory metering (round-3 verdict: the caps profiles create were
+# decorative for everything but chips) --------------------------------------
+
+
+def _host_pod(name, ns="team", cpu=None, memory=None):
+    limits = {}
+    if cpu is not None:
+        limits["cpu"] = cpu
+    if memory is not None:
+        limits["memory"] = memory
+    return new_resource(
+        "Pod", name, ns,
+        spec={"containers": [{"name": "w", "resources": {"limits": limits}}]},
+    )
+
+
+def test_memory_cap_rejects_over_ask_pod():
+    api = FakeApiServer()
+    quota.register(api)
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", "team",
+        spec={"hard": {"memory": "4Gi"}},
+    ))
+    api.create(_host_pod("a", memory="3Gi"))
+    with pytest.raises(QuotaExceeded) as err:
+        api.create(_host_pod("b", memory="2Gi"))
+    assert "memory" in str(err.value) and "4Gi" in str(err.value)
+    api.create(_host_pod("c", memory="1Gi"))  # exactly fits
+
+
+def test_cpu_cap_meters_millicores():
+    api = FakeApiServer()
+    quota.register(api)
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", "team",
+        spec={"hard": {"cpu": "2"}},
+    ))
+    api.create(_host_pod("a", cpu="1500m"))
+    with pytest.raises(QuotaExceeded):
+        api.create(_host_pod("b", cpu="750m"))
+    api.create(_host_pod("c", cpu="500m"))  # 1.5 + 0.5 == 2.0 fits
+
+
+def test_memory_capped_gang_holds_quota_episode():
+    """A gang whose per-worker memory ask busts the profile's cap parks
+    in the same QuotaExceeded Pending episode chips do — the full
+    ResourceQuotaSpec is enforced, not just the TPU row."""
+    api = FakeApiServer()
+    quota.register(api)
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", "default",
+        spec={"hard": {"memory": "4Gi"}},
+    ))
+    ctl = TpuJobController(api, quota_retry_seconds=0.05)
+    api.create(make_tpujob(
+        "gang", replicas=2, tpu_chips_per_worker=0, command=("true",),
+        resources=(("memory", "3Gi"),),
+    ))
+    for _ in range(6):
+        ctl.controller.run_until_idle()
+    job = api.get(KIND, "gang")
+    assert job.status.get("reason") == "QuotaExceeded"
+    assert job.status.get("phase") == "Pending"
+    assert api.list("Pod", "default",
+                    label_selector={LABEL_JOB: "gang"}) == []
+
+
+# -- strict-spec admission + invalid-spec teardown (ADVICE r3) --------------
+
+
+def test_strict_spec_enforced_at_admission():
+    """A typo'd spec field is a 422 at submit time (create AND update),
+    not a Failed job at reconcile time."""
+    from kubeflow_tpu.controllers import tpujob as tpujob_mod
+
+    api = FakeApiServer()
+    tpujob_mod.register_admission(api)
+    bad = make_tpujob("j", replicas=1, tpu_chips_per_worker=0,
+                      command=("true",))
+    bad.spec["template"] = {}  # the classic K8s-shaped typo
+    with pytest.raises(Invalid, match="template"):
+        api.create(bad)
+    good = make_tpujob("j", replicas=1, tpu_chips_per_worker=0,
+                       command=("true",))
+    created = api.create(good)
+    created.spec["replicsa"] = 2
+    with pytest.raises(Invalid, match="replicsa"):
+        api.update(created)
+
+
+def test_invalid_stored_spec_tears_down_gang_pods():
+    """A job whose STORED spec stops parsing (validation tightened across
+    an upgrade) goes Failed AND releases its pods — otherwise its chips
+    are pinned forever (Failed gangs are invisible to preemption)."""
+    api = FakeApiServer()
+    ctl = TpuJobController(api)
+    api.create(make_tpujob(
+        "j", replicas=2, tpu_chips_per_worker=4, command=("sleep", "60"),
+    ))
+    ctl.controller.run_until_idle()
+    assert len(api.list("Pod", "default",
+                        label_selector={LABEL_JOB: "j"})) == 2
+    # The spec rots in storage (no admission hook on this store).
+    job = api.get(KIND, "j")
+    job.spec["surprise"] = True
+    api.update(job)
+    ctl.controller.run_until_idle()
+    job = api.get(KIND, "j")
+    assert job.status.get("phase") == "Failed"
+    assert api.list("Pod", "default",
+                    label_selector={LABEL_JOB: "j"}) == []
+
+
+def test_exact_fit_milli_values_admit():
+    """Quota math is integer milli-units, not binary floats: three 100m
+    pods exactly fill a 300m cap (0.1*3 > 0.3 in float64 — the
+    spurious-rejection bug class real K8s avoids the same way)."""
+    api = FakeApiServer()
+    quota.register(api)
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", "team",
+        spec={"hard": {"cpu": "300m"}},
+    ))
+    for name in ("a", "b", "c"):
+        api.create(_host_pod(name, cpu="100m"))
+    with pytest.raises(QuotaExceeded):
+        api.create(_host_pod("d", cpu="1m"))
+
+
+def test_negative_limit_is_rejected_not_credited():
+    """A negative 'limit' would SUBTRACT from quota usage (reproduced in
+    review round 3): it must 422 at admission, never admit."""
+    api = FakeApiServer()
+    quota.register(api)
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", "team",
+        spec={"hard": {"cpu": "4"}},
+    ))
+    with pytest.raises(Invalid):
+        api.create(_host_pod("neg", cpu="-100"))
+    # And the bypass it would have enabled stays closed.
+    with pytest.raises(QuotaExceeded):
+        api.create(_host_pod("big", cpu="100"))
+
+
+def test_garbage_cap_or_stored_limit_is_422_not_500():
+    """A malformed hard cap (profile resourceQuotaSpec passes through
+    verbatim) or a garbage limit on a pre-quota pod maps to Invalid with
+    the culprit named — never a raw ValueError crash-loop."""
+    api = FakeApiServer()
+    api.create(_host_pod("old", cpu="plenty"))  # admitted pre-quota
+    quota.register(api)
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", "team",
+        spec={"hard": {"cpu": "4"}},
+    ))
+    with pytest.raises(Invalid, match="old"):
+        api.create(_host_pod("new", cpu="1"))
+    # Malformed cap: also a clean 422.
+    rq = api.get("ResourceQuota", "kf-resource-quota", "team")
+    rq.spec["hard"]["cpu"] = "lots"
+    api.update(rq)
+    api.delete("Pod", "old", "team")
+    with pytest.raises(Invalid, match="lots"):
+        api.create(_host_pod("new2", cpu="1"))
