@@ -53,6 +53,23 @@ def worker_name(job: str, index: int) -> str:
     return f"{job}-worker-{index}"
 
 
+def register_admission(api: FakeApiServer) -> None:
+    """Strict TpuJob spec validation at the STORAGE boundary (create and
+    update), not just the reconcile read path: a typo'd field is a 422 at
+    submit time. Enforcing strictness only when reconciling is
+    retroactive — it fails jobs stored before the rule existed and leaves
+    their pods pinning chips; admission only ever judges new writes."""
+
+    def validate(obj: Resource) -> Resource:
+        try:
+            TpuJobSpec.from_dict(obj.spec)
+        except Exception as e:
+            raise Invalid(f"invalid TpuJob spec: {e}") from e
+        return obj
+
+    api.register_admission(validate, kind=KIND)
+
+
 def coordinator_address(job: Resource) -> str:
     # Headless service gives each pod a stable DNS name.
     ns = job.metadata.namespace
@@ -152,10 +169,19 @@ class TpuJobController:
                         ],
                         "resources": {
                             "limits": {
-                                "google.com/tpu": spec.tpu_chips_per_worker
+                                **(
+                                    {
+                                        "google.com/tpu":
+                                            spec.tpu_chips_per_worker
+                                    }
+                                    if spec.tpu_chips_per_worker
+                                    else {}
+                                ),
+                                # Host-resource asks ride along so quota
+                                # admission meters cpu/memory for gangs
+                                # exactly as for single pods.
+                                **dict(spec.resources),
                             }
-                            if spec.tpu_chips_per_worker
-                            else {}
                         },
                     }
                 ],
@@ -197,14 +223,32 @@ class TpuJobController:
             if self._scheduler_factory is not None
             else GangScheduler()
         )
+        import re
+
+        coords: dict[str, list[tuple[int, int]]] = {}
         for n in nodes:
+            pool = n.spec.get("pool", "default")
+            x, y = n.spec.get("x", 0), n.spec.get("y", 0)
+            coords.setdefault(pool, []).append((x, y))
             sched.add_node(
-                n.metadata.name,
-                n.spec.get("pool", "default"),
-                x=n.spec.get("x", 0),
-                y=n.spec.get("y", 0),
+                n.metadata.name, pool, x=x, y=y,
                 chips=n.spec.get("chips", 4),
             )
+        # A pool named by its slice shape ("4x4", "v5e-8x4") declares a
+        # 2D TORUS of those dims: ring cost then uses wraparound
+        # distance per axis, the way real v5e pod slices wrap their ICI
+        # links — Manhattan cost is wrong the moment a ring crosses the
+        # seam. Only when the nodes' coordinates actually LIE in that
+        # grid — a pool whose coords overflow the named shape (e.g. 8
+        # linearly-numbered hosts in a pool labeled 4x4) would alias
+        # distant hosts onto each other mod W. Unshaped names stay flat.
+        for pool, xy in coords.items():
+            m = re.fullmatch(r"(?:.*[-_])?(\d+)x(\d+)", pool)
+            if not m:
+                continue
+            w, h = int(m.group(1)), int(m.group(2))
+            if all(0 <= x < w and 0 <= y < h for x, y in xy):
+                sched.set_pool_topology(pool, w, h)
         for pod in api.list("Pod"):
             node = pod.spec.get("nodeName")
             if not node or pod.status.get("phase") in ("Succeeded", "Failed"):
@@ -237,11 +281,14 @@ class TpuJobController:
         if spec.replicas * spec.tpu_chips_per_worker <= 0:
             return False
 
-        # One pod scan aggregates every gang's held chips (the same
-        # extraction _build_scheduler does) — O(pods), not O(jobs*pods).
+        # One pod scan aggregates every gang's held chips and nodes (the
+        # same extraction _build_scheduler does) — O(pods), not
+        # O(jobs*pods).
         held_by_gang: dict[str, int] = {}
+        nodes_by_gang: dict[str, set[str]] = {}
         for pod in api.list("Pod"):
-            if not pod.spec.get("nodeName") or pod.status.get("phase") in (
+            node = pod.spec.get("nodeName")
+            if not node or pod.status.get("phase") in (
                 "Succeeded", "Failed"
             ):
                 continue
@@ -252,6 +299,19 @@ class TpuJobController:
             held_by_gang[gang] = held_by_gang.get(
                 gang, 0
             ) + container_limits_total(pod, "google.com/tpu")
+            nodes_by_gang.setdefault(gang, set()).add(node)
+
+        # Victims are scoped by where their chips actually ARE — any gang
+        # holding chips on a node in the preemptor's pool can unblock it,
+        # regardless of what topology string ITS spec asked for (exact
+        # topology equality would skip e.g. a ''-topology gang squatting
+        # on the pool's nodes forever). The what-if placement below still
+        # guarantees an eviction is only done when it actually unblocks.
+        pool_nodes = {
+            n.metadata.name
+            for n in api.list("Node")
+            if n.spec.get("pool", "default") == spec.topology
+        }
 
         candidates = []
         for other in api.list(KIND):
@@ -264,13 +324,12 @@ class TpuJobController:
                 other_spec = TpuJobSpec.from_dict(other.spec)
             except Exception:
                 continue
-            if (
-                other_spec.priority >= spec.priority
-                or other_spec.topology != spec.topology
-            ):
+            if other_spec.priority >= spec.priority:
                 continue
             gang = f"{other.metadata.namespace}/{other.metadata.name}"
-            if held_by_gang.get(gang, 0) > 0:
+            if held_by_gang.get(gang, 0) > 0 and (
+                nodes_by_gang.get(gang, set()) & pool_nodes
+            ):
                 candidates.append((other_spec.priority, other, gang))
         # Lowest priority first; youngest first within a tier.
         candidates.sort(
@@ -368,7 +427,17 @@ class TpuJobController:
             spec = TpuJobSpec.from_dict(job.spec)
         except Exception as e:
             # Invalid spec is terminal, not transient — requeueing would
-            # hot-loop in error backoff forever.
+            # hot-loop in error backoff forever. Tear down any pods the
+            # gang still holds: a job whose STORED spec stopped parsing
+            # (e.g. validation got stricter across an upgrade) must not
+            # pin its chips forever — Failed gangs are invisible to both
+            # the scheduler rebuild and preemption, so nothing else
+            # could ever reclaim them.
+            for p in api.list("Pod", ns, label_selector={LABEL_JOB: name}):
+                try:
+                    api.delete("Pod", p.metadata.name, ns)
+                except NotFound:
+                    pass
             api.record_event(job, "InvalidSpec", str(e), type_="Warning")
             return self._set_phase(api, job, "Failed")
 

@@ -28,6 +28,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.kftpu_sched_remove_node.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p,
     ]
+    lib.kftpu_sched_set_pool_topology.restype = ctypes.c_int32
+    lib.kftpu_sched_set_pool_topology.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+    ]
     lib.kftpu_sched_place_gang.restype = ctypes.c_int64
     lib.kftpu_sched_place_gang.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -75,6 +79,19 @@ class GangScheduler:
         return (
             self._lib.kftpu_sched_remove_node(self._handle, name.encode()) == 0
         )
+
+    def set_pool_topology(self, pool: str, width: int, height: int) -> None:
+        """Declare `pool` as a width x height 2D TORUS: ring cost then
+        uses per-axis wraparound distance (min(d, size-d)) — real v5e
+        pod slices wrap their ICI links, so a ring crossing the seam is
+        one hop, not width-1. 0/1 on an axis = no wrap there."""
+        rc = self._lib.kftpu_sched_set_pool_topology(
+            self._handle, pool.encode(), width, height
+        )
+        if rc != 0:
+            raise PlacementError(
+                f"bad topology {width}x{height} for pool {pool!r}"
+            )
 
     def place_gang(
         self, job: str, pool: str, workers: int, chips_per_worker: int

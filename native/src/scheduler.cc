@@ -23,10 +23,31 @@ struct Scheduler {
   std::map<std::string, Node> nodes;
   // job -> (node name, chips) reservations, one entry per worker.
   std::map<std::string, std::vector<std::pair<std::string, int32_t>>> gangs;
+  // pool -> (width, height) torus dims; absent/0 = flat on that axis.
+  std::map<std::string, std::pair<int32_t, int32_t>> pool_topo;
 };
 
-int64_t manhattan(const Node& a, const Node& b) {
-  return std::abs((int64_t)a.x - b.x) + std::abs((int64_t)a.y - b.y);
+// Per-axis hop count: wraparound when the pool declared a torus dim
+// (real v5e pod slices wrap their ICI links — a ring crossing the seam
+// is ONE hop, not width-1). Coordinates are reduced mod size so an
+// out-of-range x still lands on the torus instead of going negative.
+int64_t AxisDist(int64_t d, int32_t size) {
+  d = std::abs(d);
+  if (size > 1) {
+    d %= size;
+    return std::min(d, (int64_t)size - d);
+  }
+  return d;
+}
+
+int64_t Dist(const Scheduler& s, const Node& a, const Node& b) {
+  int32_t w = 0, h = 0;
+  auto it = s.pool_topo.find(a.pool);
+  if (it != s.pool_topo.end()) {
+    w = it->second.first;
+    h = it->second.second;
+  }
+  return AxisDist((int64_t)a.x - b.x, w) + AxisDist((int64_t)a.y - b.y, h);
 }
 
 // A placement slot: a (node, worker capacity) pair expanded per worker.
@@ -57,6 +78,15 @@ int32_t kftpu_sched_remove_node(void* sp, const char* name) {
   auto* s = static_cast<Scheduler*>(sp);
   std::lock_guard<std::mutex> lock(s->mu);
   return s->nodes.erase(name) ? 0 : -1;
+}
+
+int32_t kftpu_sched_set_pool_topology(void* sp, const char* pool,
+                                      int32_t width, int32_t height) {
+  if (!sp || !pool || width < 0 || height < 0) return -1;
+  auto* s = static_cast<Scheduler*>(sp);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->pool_topo[pool] = {width, height};
+  return 0;
 }
 
 int64_t kftpu_sched_place_gang(void* sp, const char* job, const char* pool,
@@ -102,7 +132,7 @@ int64_t kftpu_sched_place_gang(void* sp, const char* job, const char* pool,
   for (size_t start = 0; start + workers <= slots.size(); ++start) {
     int64_t cost = 0;
     for (int32_t i = 1; i < workers; ++i)
-      cost += manhattan(*slots[start + i - 1].node, *slots[start + i].node);
+      cost += Dist(*s, *slots[start + i - 1].node, *slots[start + i].node);
     if (best_cost < 0 || cost < best_cost) {
       best_cost = cost;
       best_start = start;

@@ -29,6 +29,14 @@ int32_t kftpu_sched_add_node(void* s, const char* name, const char* pool,
 // reservation records; callers re-place after release. Returns 0 or -1.
 int32_t kftpu_sched_remove_node(void* s, const char* name);
 
+// Declare pool `pool`'s physical topology as a WIDTH x HEIGHT 2D TORUS:
+// ring cost between hosts then uses wraparound distance per axis
+// (min(d, size-d)), the way real v5e pod slices wrap their ICI links.
+// A dimension of 0/1 means no wrap on that axis; undeclared pools use
+// flat Manhattan distance. Returns 0, or -1 on bad args.
+int32_t kftpu_sched_set_pool_topology(void* s, const char* pool,
+                                      int32_t width, int32_t height);
+
 // Atomically place a gang of `workers` workers needing `chips_per_worker`
 // chips each onto pool `pool`. On success writes a ';'-separated node-name
 // list (one entry per worker, rank order) into out (size out_len) and

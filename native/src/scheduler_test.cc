@@ -62,6 +62,30 @@ int main() {
   CHECK(kftpu_sched_remove_node(s, "host-3") == -1);
 
   kftpu_sched_free(s);
+
+  // --- Torus wraparound (v5e pod slices wrap their ICI links) -------------
+  void* t = kftpu_sched_new();
+  // A 6-wide ring. Free capacity at the SEAM (x=0 and x=5) plus one
+  // off-row host (x=2, y=1).
+  CHECK(kftpu_sched_add_node(t, "t0", "6x1", 0, 0, 4) == 0);
+  CHECK(kftpu_sched_add_node(t, "t5", "6x1", 5, 0, 4) == 0);
+  CHECK(kftpu_sched_add_node(t, "t2b", "6x1", 2, 1, 4) == 0);
+  char tout[512];
+  // WITHOUT the torus declaration (flat Manhattan) the seam pair costs 5,
+  // so placement prefers t5->t2b (3+1=4): the wrong physical choice on
+  // wrapped hardware.
+  long flat = kftpu_sched_place_gang(t, "flat", "6x1", 2, 4, tout, 512);
+  CHECK(flat == 4);
+  CHECK(std::string(tout) == "t5;t2b");
+  CHECK(kftpu_sched_release_gang(t, "flat") == 2);
+  // WITH the torus declared, the seam pair is ONE wrap hop and wins.
+  CHECK(kftpu_sched_set_pool_topology(t, "6x1", 6, 1) == 0);
+  long wrapped = kftpu_sched_place_gang(t, "wrap", "6x1", 2, 4, tout, 512);
+  CHECK(wrapped == 1);
+  CHECK(std::string(tout) == "t0;t5");
+  CHECK(kftpu_sched_set_pool_topology(t, "6x1", -1, 1) == -1);  // bad args
+  kftpu_sched_free(t);
+
   std::printf("all native scheduler tests passed\n");
   return 0;
 }

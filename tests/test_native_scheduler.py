@@ -134,3 +134,63 @@ def test_new_controller_sees_existing_reservations():
     n_ev = sum(1 for e in api.list("Event")
                if e.spec["reason"] == "Unschedulable")
     assert n_ev == 1
+
+
+def test_torus_wrap_beats_manhattan_at_the_seam(sched_cls):
+    """v5e pod slices wrap their ICI links: with the pool declared as a
+    torus, a ring across the seam (x=0 .. x=5) is ONE hop and wins; the
+    flat-Manhattan model picks a physically worse pair (ctest carries
+    the same golden in scheduler_test.cc)."""
+    def fresh():
+        s = sched_cls()
+        s.add_node("t0", "6x1", x=0, y=0, chips=4)
+        s.add_node("t5", "6x1", x=5, y=0, chips=4)
+        s.add_node("t2b", "6x1", x=2, y=1, chips=4)
+        return s
+
+    flat = fresh()
+    nodes, cost = flat.place_gang("flat", "6x1", 2, 4)
+    assert (nodes, cost) == (["t5", "t2b"], 4)  # the seam looked 5 wide
+
+    wrapped = fresh()
+    wrapped.set_pool_topology("6x1", 6, 1)
+    nodes, cost = wrapped.place_gang("wrap", "6x1", 2, 4)
+    assert (nodes, cost) == (["t0", "t5"], 1)  # one wrap hop
+
+
+def test_operator_declares_torus_from_pool_shape():
+    """The controller parses 'WxH'-shaped pool names into torus dims, so
+    a seam-crossing gang gets the wrap-aware placement end to end (the
+    GangPlaced event carries the ring cost)."""
+    api = FakeApiServer()
+    for name, x, y in (("t0", 0, 0), ("t5", 5, 0), ("t2b", 2, 1)):
+        api.create(new_resource(
+            "Node", name, "", spec={"pool": "6x2", "x": x, "y": y,
+                                    "chips": 4}))
+    ctl = TpuJobController(api)
+    api.create(make_tpujob("seam", replicas=2, tpu_chips_per_worker=4,
+                           topology="6x2"))
+    ctl.controller.run_until_idle()
+    pods = api.list("Pod", label_selector={"kubeflow-tpu.org/job": "seam"})
+    assert sorted(p.spec["nodeName"] for p in pods) == ["t0", "t5"]
+    placed = [e for e in api.list("Event")
+              if e.spec["reason"] == "GangPlaced"]
+    assert placed and "ring cost 1" in placed[0].spec["message"]
+
+
+def test_torus_not_declared_when_coords_overflow_shape():
+    """8 linearly-numbered hosts in a pool *named* 4x4 do not form that
+    grid — declaring the torus would alias x=0 onto x=4 (0 hops apart).
+    The operator only trusts the name when the coordinates fit it."""
+    api = FakeApiServer()
+    for i in range(8):
+        api.create(new_resource(
+            "Node", f"n{i}", "", spec={"pool": "v5e-4x4", "x": i, "y": 0,
+                                       "chips": 4}))
+    ctl = TpuJobController(api)
+    api.create(make_tpujob("lin", replicas=2, tpu_chips_per_worker=4,
+                           topology="v5e-4x4"))
+    ctl.controller.run_until_idle()
+    pods = api.list("Pod", label_selector={"kubeflow-tpu.org/job": "lin"})
+    # Flat-grid adjacency: consecutive hosts, never a mod-4 alias pair.
+    assert sorted(p.spec["nodeName"] for p in pods) == ["n0", "n1"]

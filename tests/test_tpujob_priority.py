@@ -192,3 +192,29 @@ def test_preemption_simulates_placement_not_chip_arithmetic():
         if api.get(KIND, n).status.get("phase") == "Pending"
     ]
     assert evicted, "someone must have been evicted to place 4 chips"
+
+
+def test_preemption_scopes_victims_by_node_overlap_not_topology_string():
+    """ADVICE r3: victims are found by where their chips ARE, not by
+    spec.topology equality — a ''-topology gang squatting on the pool's
+    nodes (externally placed) is evictable by a '4x4' preemptor."""
+    api, ctl = _world(nodes=2)  # 8 chips, pool "4x4"
+    api.create(make_tpujob(
+        "squatter", replicas=2, tpu_chips_per_worker=4,
+        topology="", command=("true",), priority=0,
+    ))
+    _run(ctl)
+    # No topology → the controller didn't place; simulate an external
+    # placement pinning the squatter onto the pool's nodes.
+    for i, pod in enumerate(_pods(api, "squatter")):
+        pod.spec["nodeName"] = f"n{i}"
+        api.update(pod)
+
+    api.create(_job("urgent", priority=10))
+    _run(ctl, passes=10)
+
+    assert len(_pods(api, "urgent")) == 2
+    squatter = api.get(KIND, "squatter")
+    assert squatter.status.get("phase") == "Pending"
+    reasons = {e.spec["reason"] for e in api.list("Event", "default")}
+    assert "Preempted" in reasons
