@@ -56,7 +56,13 @@ def _from_matches(rule: dict, user: str) -> bool:
     )
 
 
-def _to_matches(rule: dict, method: str | None, path: str | None) -> bool:
+def _to_matches(
+    rule: dict,
+    method: str | None,
+    path: str | None,
+    *,
+    fail_closed: bool = False,
+) -> bool:
     operations = rule.get("to", [])
     if not operations:
         return True  # no operation constraint = any method/path
@@ -64,11 +70,21 @@ def _to_matches(rule: dict, method: str | None, path: str | None) -> bool:
         op = to.get("operation", {})
         methods = op.get("methods", [])
         paths = op.get("paths", [])
+        # A None method/path means the caller didn't present one (an
+        # in-process check without a request). In Istio every request
+        # carries both, so a constrained rule always gets something to
+        # match; here a DENY rule must treat the absent value as
+        # MATCHING (fail closed) — otherwise method-scoped DENY policies
+        # silently fail open for exactly the callers that bypass HTTP.
         method_ok = not methods or (
-            method is not None and any(_match(m, method) for m in methods)
+            fail_closed
+            if method is None
+            else any(_match(m, method) for m in methods)
         )
         path_ok = not paths or (
-            path is not None and any(_match(p, path) for p in paths)
+            fail_closed
+            if path is None
+            else any(_match(p, path) for p in paths)
         )
         if method_ok and path_ok:
             return True
@@ -76,9 +92,16 @@ def _to_matches(rule: dict, method: str | None, path: str | None) -> bool:
 
 
 def _rule_matches(
-    rule: dict, user: str, method: str | None, path: str | None
+    rule: dict,
+    user: str,
+    method: str | None,
+    path: str | None,
+    *,
+    fail_closed: bool = False,
 ) -> bool:
-    return _from_matches(rule, user) and _to_matches(rule, method, path)
+    return _from_matches(rule, user) and _to_matches(
+        rule, method, path, fail_closed=fail_closed
+    )
 
 
 def mesh_admits(
@@ -93,9 +116,10 @@ def mesh_admits(
     allows = [p for p in policies if p.spec.get("action", "ALLOW") == "ALLOW"]
     denies = [p for p in policies if p.spec.get("action") == "DENY"]
     # DENY is evaluated first and wins (Istio's order of evaluation).
+    # fail_closed: an absent method/path matches constrained DENY rules.
     for policy in denies:
         if any(
-            _rule_matches(rule, user, method, path)
+            _rule_matches(rule, user, method, path, fail_closed=True)
             for rule in policy.spec.get("rules", [])
         ):
             return False

@@ -261,3 +261,31 @@ def test_viewer_post_refused_at_web_tier(api):
     # The mesh rule alone refuses the write even for a principal whose
     # RBAC would allow it (defense in depth, evaluated directly):
     assert not mesh_admits(api, "dana@example.com", "team-a", method="POST")
+
+
+def test_method_scoped_deny_fails_closed_without_method():
+    """ADVICE r3: a method-constrained DENY rule matches a caller that
+    presents NO method (in-process checks without a request) — absent
+    context fails closed, the opposite of silently skipping the rule
+    (in Istio every request carries a method; only our in-process
+    callers can lack one)."""
+    from kubeflow_tpu.api.objects import new_resource
+
+    api = FakeApiServer()
+    api.create(new_resource(
+        "AuthorizationPolicy", "no-writes", "team-a",
+        spec={
+            "action": "DENY",
+            "rules": [{
+                "from": [{"source": {"principals": ["mallory@x.co"]}}],
+                "to": [{"operation": {"methods": ["POST", "DELETE"]}}],
+            }],
+        },
+    ))
+    # With a method: normal Istio semantics.
+    assert not mesh_admits(api, "mallory@x.co", "team-a", method="POST")
+    assert mesh_admits(api, "mallory@x.co", "team-a", method="GET")
+    # WITHOUT one: the DENY still bites (fail closed).
+    assert not mesh_admits(api, "mallory@x.co", "team-a")
+    # ALLOW-side evaluation is unchanged: no allow policies = admit.
+    assert mesh_admits(api, "someone-else@x.co", "team-a")
