@@ -97,6 +97,16 @@ def _block_cls(cfg: "TransformerConfig"):
                 "attn_out"
             ),
         )
+    if cfg.remat_policy == "mlp":
+        # Long-context policy that actually dodges the flash recompute:
+        # NO checkpoint wraps the block — attention's residuals (q/k/v,
+        # o, lse) are saved — and Block itself remats only its MLP half.
+        # Any policy whose checkpoint boundary crosses the flash
+        # custom_vjp ("full", "dots", "attn") re-runs the flash FORWARD
+        # inside the backward to rebuild lse; at S=16k attention is
+        # ~half the layer's FLOPs, so that recompute is the long-context
+        # tax. Costs O(S·d) more activation memory per layer.
+        return Block
     if cfg.remat_policy != "full":
         raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
     return nn.remat(Block, static_argnums=())
@@ -356,12 +366,18 @@ class Block(nn.Module):
         x = x + Attention(cfg, self.mesh, name="attn")(
             RMSNorm(cfg.dtype, name="ln_attn")(x), positions
         )
-        mlp: nn.Module
-        if cfg.num_experts > 0:
-            mlp = SwitchMoE(cfg, name="moe")
-        else:
-            mlp = SwiGLU(cfg, name="mlp")
-        x = x + mlp(RMSNorm(cfg.dtype, name="ln_mlp")(x))
+        mlp_cls: type[nn.Module]
+        mlp_name = "moe" if cfg.num_experts > 0 else "mlp"
+        mlp_cls = SwitchMoE if cfg.num_experts > 0 else SwiGLU
+        if cfg.remat and cfg.remat_policy == "mlp":
+            # The "mlp" policy's only checkpoint: the MLP recomputes in
+            # the backward, attention's residuals stay saved (the lifted
+            # transform keeps the param path, so weights are identical
+            # to the unwrapped module's).
+            mlp_cls = nn.remat(mlp_cls)
+        x = x + mlp_cls(cfg, name=mlp_name)(
+            RMSNorm(cfg.dtype, name="ln_mlp")(x)
+        )
         return x
 
 

@@ -168,17 +168,17 @@ def test_fused_cross_entropy_matches_onehot_formulation():
 
 
 def test_remat_policies_agree():
-    """'dots' and 'full' remat are performance knobs, not semantics: same
-    logits, same grads."""
+    """Remat policies ('dots', 'attn', 'mlp') are performance knobs, not
+    semantics: same logits, same grads, same param tree as 'full'."""
     cfg_full = TransformerConfig(
         vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
         d_ff=64, remat_policy="full", attention_impl="dense",
     )
-    cfg_dots = dataclasses.replace(cfg_full, remat_policy="dots")
     tokens = jnp.arange(2 * 8, dtype=jnp.int32).reshape(2, 8) % 64
 
     out = {}
-    for name, cfg in (("full", cfg_full), ("dots", cfg_dots)):
+    for name in ("full", "dots", "attn", "mlp"):
+        cfg = dataclasses.replace(cfg_full, remat_policy=name)
         model = TransformerLM(cfg)
         params = model.init(jax.random.PRNGKey(0), tokens)
 
@@ -187,11 +187,23 @@ def test_remat_policies_agree():
 
         out[name] = (loss(params), jax.grad(loss)(params))
 
-    assert jnp.allclose(out["full"][0], out["dots"][0], atol=1e-4)
-    flat_f = jax.tree_util.tree_leaves(out["full"][1])
-    flat_d = jax.tree_util.tree_leaves(out["dots"][1])
-    for a, b in zip(flat_f, flat_d):
-        assert jnp.allclose(a, b, atol=1e-3), (a - b)
+    ref_loss, ref_grads = out["full"]
+    ref_paths = [
+        p for p, _ in jax.tree_util.tree_leaves_with_path(ref_grads)
+    ]
+    for name in ("dots", "attn", "mlp"):
+        assert jnp.allclose(ref_loss, out[name][0], atol=1e-4), name
+        # The lifted transforms must not move params ('mlp' wraps a
+        # submodule — a renamed path would orphan every checkpoint).
+        paths = [
+            p for p, _ in jax.tree_util.tree_leaves_with_path(out[name][1])
+        ]
+        assert paths == ref_paths, name
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ref_grads),
+            jax.tree_util.tree_leaves(out[name][1]),
+        ):
+            assert jnp.allclose(a, b, atol=1e-3), (name, a - b)
 
 
 def test_unknown_remat_policy_rejected():
