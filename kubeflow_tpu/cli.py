@@ -55,6 +55,9 @@ ALIASES = {
     "pvc": "PersistentVolumeClaim", "pvcs": "PersistentVolumeClaim",
     "snapshot": "VolumeSnapshot", "snapshots": "VolumeSnapshot",
     "poddefault": "PodDefault", "poddefaults": "PodDefault",
+    "webhookconfiguration": "WebhookConfiguration",
+    "webhookconfigurations": "WebhookConfiguration",
+    "webhook": "WebhookConfiguration", "webhooks": "WebhookConfiguration",
     "event": "Event", "events": "Event",
     "service": "Service", "services": "Service", "svc": "Service",
     "deployment": "Deployment", "deployments": "Deployment",

@@ -1,0 +1,161 @@
+"""The standalone admission-webhook server — PodDefault's own process.
+
+Parity with the reference's admission webhook, which is NOT a library
+inside the apiserver but a separate TLS server the apiserver calls out
+to (`admission-webhook/main.go:443` raw TLS listener, `:447` mutatePods,
+`:597` main), registered via a webhook configuration with timeout and
+failure-policy semantics. This module is that boundary for our control
+plane:
+
+- `MutatingWebhookApp` serves the callout protocol the store speaks
+  (`fake_apiserver._webhook_admit`): POST /mutate with
+  ``{"object": {...}, "operation": "CREATE"|"UPDATE"}`` returns
+  ``{"allowed": true, "object": mutated}`` or
+  ``{"allowed": false, "message": ...}``;
+- `main()` runs the PodDefault mutator in its OWN process: it reads
+  PodDefault CRs through the authenticated facade (HttpApiClient with a
+  least-privilege token), serves /mutate over its own TLS cert, and —
+  with ``--register`` — creates the WebhookConfiguration pointing at
+  itself, so `python -m kubeflow_tpu.controllers.webhook` is the whole
+  deployment.
+
+With this, admission is no longer the one extension point that had to
+link into the apiserver process: a third-party mutator is a server plus
+one CR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import Callable
+
+from kubeflow_tpu.api.objects import Resource, new_resource
+from kubeflow_tpu.controllers import poddefault
+from kubeflow_tpu.testing.fake_apiserver import Invalid
+from kubeflow_tpu.web.wsgi import App, Request, Response, json_response
+
+log = logging.getLogger(__name__)
+
+# mutate(obj, operation) -> mutated obj; raise Invalid to DENY.
+Mutator = Callable[[Resource, str], Resource]
+
+
+class MutatingWebhookApp(App):
+    """Serves the store's admission-callout protocol over one route."""
+
+    def __init__(self, mutate: Mutator, name: str = "admission-webhook"):
+        super().__init__(name)
+        self._mutate = mutate
+        self.add_route("/mutate", self.mutate_route, ("POST",))
+
+    def mutate_route(self, req: Request) -> Response:
+        body = req.json()
+        obj = Resource.from_dict(body["object"])
+        operation = body.get("operation", "CREATE")
+        try:
+            mutated = self._mutate(obj, operation)
+        except Invalid as e:
+            # An explicit denial — distinct from a 5xx, which the caller
+            # treats as webhook FAILURE under its failurePolicy.
+            return json_response({"allowed": False, "message": str(e)})
+        return json_response({"allowed": True, "object": mutated.to_dict()})
+
+
+def make_webhook_config(
+    name: str,
+    url: str,
+    ca_bundle: str,
+    kinds: tuple[str, ...] = ("Pod",),
+    *,
+    failure_policy: str = "Fail",
+    timeout_seconds: float = 5.0,
+) -> Resource:
+    """The WebhookConfiguration CR the store's admission phase consumes
+    (the MutatingWebhookConfiguration analog; cluster-scoped)."""
+    return new_resource(
+        "WebhookConfiguration",
+        name,
+        "",
+        spec={
+            "url": url,
+            "caBundle": ca_bundle,
+            "kinds": list(kinds),
+            "failurePolicy": failure_policy,
+            "timeoutSeconds": timeout_seconds,
+        },
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    """The PodDefault webhook binary (`main.go:597` analog)."""
+    from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+    from kubeflow_tpu.web import tls as tlsmod
+    from kubeflow_tpu.web.wsgi import serve
+
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu-webhook")
+    parser.add_argument(
+        "--apiserver", required=True,
+        help="facade URL for reading PodDefault CRs (token via "
+        "KFTPU_TOKEN, CA via KFTPU_CA — the launcher env contract)",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument(
+        "--tls-dir", required=True,
+        help="directory for this webhook's OWN serving cert (minted on "
+        "first boot; its ca.crt is the caBundle the apiserver pins)",
+    )
+    parser.add_argument(
+        "--register", action="store_true",
+        help="create/refresh the WebhookConfiguration pointing at this "
+        "server (needs create+update on webhookconfigurations)",
+    )
+    parser.add_argument("--name", default="poddefault-webhook")
+    parser.add_argument(
+        "--failure-policy", choices=("Fail", "Ignore"), default="Fail"
+    )
+    args = parser.parse_args(argv)
+
+    client = HttpApiClient(args.apiserver)
+
+    def mutate(obj: Resource, operation: str) -> Resource:
+        # Same semantics as the in-process hook, but the PodDefault
+        # reads cross the process boundary through the secure facade.
+        return poddefault.mutate_pod(client, obj)
+
+    paths = tlsmod.ensure_tls_dir(
+        args.tls_dir, hosts=("localhost", args.host)
+        if args.host not in ("localhost", "127.0.0.1")
+        else ("localhost", "127.0.0.1"),
+    )
+    server, _ = serve(
+        MutatingWebhookApp(mutate), host=args.host, port=args.port,
+        tls=paths,
+    )
+    url = f"https://{args.host}:{server.server_port}/mutate"
+    if args.register:
+        client.apply(
+            make_webhook_config(
+                args.name, url, paths.ca_cert,
+                failure_policy=args.failure_policy,
+            )
+        )
+    print(f"webhook ready {server.server_port}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    logging.basicConfig(
+        level=logging.INFO if os.environ.get("KFTPU_DEBUG") else logging.WARNING
+    )
+    sys.exit(main())

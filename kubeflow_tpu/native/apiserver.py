@@ -88,6 +88,7 @@ class NativeApiServer:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj: Resource) -> Resource:
+        self._reject_webhook_config(obj)
         obj = self._admit(obj)
         with self._dispatch_lock:
             try:
@@ -114,7 +115,21 @@ class NativeApiServer:
             for d in self._store.list(kind, namespace, label_selector)
         ]
 
+    def _reject_webhook_config(self, obj: Resource) -> None:
+        # Webhook callouts are implemented by FakeApiServer only;
+        # silently storing the config here would make failurePolicy=Fail
+        # fail OPEN on this backend — refuse loudly instead.
+        if obj.kind == "WebhookConfiguration":
+            from kubeflow_tpu.testing.fake_apiserver import Invalid
+
+            raise Invalid(
+                "WebhookConfiguration callouts are not supported on the "
+                "native store backend — run the facade over "
+                "FakeApiServer for out-of-process admission"
+            )
+
     def update(self, obj: Resource) -> Resource:
+        self._reject_webhook_config(obj)
         obj = self._admit(obj)
         return self._update(obj, status_only=False)
 

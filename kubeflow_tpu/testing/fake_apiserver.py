@@ -114,6 +114,10 @@ class FakeApiServer:
         # server is durable exactly when a persist_dir is given: every
         # committed write is fsync'd to the WAL before its watch event is
         # emitted, and a restart over the same directory restores state.
+        # Index of stored WebhookConfiguration keys: the zero-webhook
+        # common case must cost writes nothing (a full-store list per
+        # create would be O(N log N) under the lock).
+        self._webhook_keys: set[tuple[str, str, str]] = set()
         self._wal = None
         self._snapshot_every = max(1, snapshot_every)
         self._appends_since_snapshot = 0
@@ -149,6 +153,8 @@ class FakeApiServer:
             for d in snap.get("objects", []):
                 obj = Resource.from_dict(d)
                 self._objects[obj.key] = obj
+                if obj.kind == self.WEBHOOK_KIND:
+                    self._webhook_keys.add(obj.key)
             self._rv = int(snap.get("rv", 0))
         torn = False
         for line in self._wal.read_journal().splitlines():
@@ -165,8 +171,11 @@ class FakeApiServer:
                 continue  # pre-snapshot leftover
             if event == "DELETED":
                 self._objects.pop(obj.key, None)
+                self._webhook_keys.discard(obj.key)
             else:
                 self._objects[obj.key] = obj
+                if obj.kind == self.WEBHOOK_KIND:
+                    self._webhook_keys.add(obj.key)
             self._rv = rv
         if torn:
             # REPAIR the log now: the WAL reopens in append mode, so the
@@ -241,7 +250,10 @@ class FakeApiServer:
         """Mutating-admission hook applied on create AND update (real
         mutating webhooks fire on both; the reference's boundary is
         `admission-webhook/main.go:447`). Mutators must be idempotent —
-        updates re-run them over an already-mutated object."""
+        updates re-run them over an already-mutated object. In-process
+        hooks run INSIDE the store lock (quota's check-then-insert needs
+        the atomicity); third-party mutators belong in a
+        WebhookConfiguration callout instead (see _webhook_admit)."""
         with self._lock:
             self._admission.append((kind, mutator))
 
@@ -249,6 +261,162 @@ class FakeApiServer:
         for kind, mutator in list(self._admission):
             if kind is None or kind == obj.kind:
                 obj = mutator(obj.deepcopy())
+        return obj
+
+    # -- webhook admission (the out-of-process extension point) ------------
+    #
+    # The reference's admission boundary is a STANDALONE TLS server the
+    # apiserver calls out to (`admission-webhook/main.go:443` raw TLS,
+    # `:447` mutatePods, `:597` main), registered via a webhook
+    # configuration with timeout + failure semantics. Here that boundary
+    # is a `WebhookConfiguration` CR:
+    #
+    #   spec:
+    #     url: https://127.0.0.1:9443/mutate   (https only)
+    #     caBundle: /path/to/webhook-ca.crt    (pins the callee)
+    #     kinds: ["Pod"]
+    #     timeoutSeconds: 5
+    #     failurePolicy: Fail | Ignore         (default Fail)
+    #
+    # Callouts run OUTSIDE the store lock (an HTTP round trip must never
+    # stall every writer) and BEFORE the in-process hooks — the K8s
+    # ordering (mutating webhooks, then validating admission), which
+    # also means quota meters the POST-mutation object and keeps its
+    # in-lock check-then-insert atomicity untouched.
+
+    WEBHOOK_KIND = "WebhookConfiguration"
+
+    def _validate_webhook_config(self, obj: Resource) -> None:
+        spec = obj.spec
+        url = spec.get("url", "")
+        if not url.startswith("https://"):
+            raise Invalid(
+                f"WebhookConfiguration {obj.metadata.name!r}: url must be "
+                f"https:// (the admission callee carries object payloads; "
+                f"got {url!r})"
+            )
+        policy = spec.get("failurePolicy", "Fail")
+        if policy not in ("Fail", "Ignore"):
+            raise Invalid(
+                f"WebhookConfiguration {obj.metadata.name!r}: "
+                f"failurePolicy must be Fail or Ignore, got {policy!r}"
+            )
+        kinds = spec.get("kinds")
+        if not isinstance(kinds, list) or not kinds:
+            raise Invalid(
+                f"WebhookConfiguration {obj.metadata.name!r}: spec.kinds "
+                "must be a non-empty list of kind names"
+            )
+        if self.WEBHOOK_KIND in kinds:
+            raise Invalid(
+                f"WebhookConfiguration {obj.metadata.name!r}: a webhook "
+                "cannot admit WebhookConfigurations (self-bricking loop)"
+            )
+        timeout = spec.get("timeoutSeconds", 5)
+        if not isinstance(timeout, (int, float)) or isinstance(
+            timeout, bool
+        ) or not timeout > 0:
+            # Config-time 422, not a per-write "webhook failure" later.
+            raise Invalid(
+                f"WebhookConfiguration {obj.metadata.name!r}: "
+                f"timeoutSeconds must be a positive number, got "
+                f"{timeout!r}"
+            )
+
+    def _call_webhook(
+        self, cfg: Resource, obj: Resource, operation: str
+    ) -> Resource:
+        import json as _json
+        import urllib.request
+
+        from kubeflow_tpu.web import tls as tlsmod
+
+        spec = cfg.spec
+        timeout = min(float(spec.get("timeoutSeconds", 5)), 30.0)
+        ctx = None
+        if spec.get("caBundle"):
+            ctx = tlsmod.client_context(spec["caBundle"])
+        req = urllib.request.Request(
+            spec["url"],
+            method="POST",
+            data=_json.dumps(
+                {"object": obj.to_dict(), "operation": operation}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as r:
+            resp = _json.loads(r.read())
+        if not resp.get("allowed", False):
+            # A DENIAL is an admission decision, not a webhook failure:
+            # it rejects under BOTH failure policies.
+            raise Invalid(
+                f"admission webhook {cfg.metadata.name!r} denied "
+                f"{obj.kind} {obj.metadata.namespace}/"
+                f"{obj.metadata.name}: {resp.get('message', 'denied')}"
+            )
+        if "object" not in resp:
+            return obj
+        mutated = Resource.from_dict(resp["object"])
+        # A mutator only gets to change spec/labels/annotations — never
+        # identity or concurrency fields. A swapped kind would bypass
+        # the per-kind validation that ran before the callout; a dropped
+        # resourceVersion would disable the stale-write Conflict check;
+        # a changed name/namespace would write a different store key
+        # than the client asked for. (K8s enforces the same immutable
+        # fields on webhook patches.)
+        before = (
+            obj.kind, obj.metadata.name, obj.metadata.namespace,
+            obj.metadata.uid, obj.metadata.resource_version,
+            obj.api_version,
+        )
+        after = (
+            mutated.kind, mutated.metadata.name,
+            mutated.metadata.namespace, mutated.metadata.uid,
+            mutated.metadata.resource_version, mutated.api_version,
+        )
+        if before != after:
+            raise Invalid(
+                f"admission webhook {cfg.metadata.name!r} altered "
+                f"immutable fields of {obj.kind} "
+                f"{obj.metadata.namespace}/{obj.metadata.name} "
+                f"({before} -> {after}) — mutation rejected"
+            )
+        return mutated
+
+    def _webhook_admit(self, obj: Resource, operation: str) -> Resource:
+        """Run matching webhook callouts over `obj` (lock NOT held
+        during the HTTP round trips)."""
+        if obj.kind == self.WEBHOOK_KIND:
+            self._validate_webhook_config(obj)
+            return obj
+        if not self._webhook_keys:
+            return obj  # the common case costs one set check
+        with self._lock:
+            configs = [
+                self._objects[k].deepcopy()
+                for k in sorted(self._webhook_keys)
+                if k in self._objects
+                and obj.kind
+                in (self._objects[k].spec.get("kinds") or [])
+            ]
+        for cfg in configs:  # key-sorted: deterministic order
+            try:
+                obj = self._call_webhook(cfg, obj, operation)
+            except Invalid:
+                raise  # an explicit denial, under either policy
+            except Exception as e:
+                if cfg.spec.get("failurePolicy", "Fail") == "Ignore":
+                    log.warning(
+                        "admission webhook %s unreachable (%s); "
+                        "failurePolicy=Ignore — admitting unmodified",
+                        cfg.metadata.name, e,
+                    )
+                    continue
+                raise Invalid(
+                    f"admission webhook {cfg.metadata.name!r} failed "
+                    f"({e}) and failurePolicy=Fail — rejecting "
+                    f"{obj.kind} {obj.metadata.name!r}"
+                ) from e
         return obj
 
     # -- watch ------------------------------------------------------------
@@ -404,6 +572,10 @@ class FakeApiServer:
 
     def create(self, obj: Resource) -> Resource:
         obj = self._normalize_version(obj)
+        # Webhook callouts OUTSIDE the lock (an HTTP round trip must not
+        # stall writers), before in-process hooks (the K8s mutating →
+        # validating order, so quota meters the post-mutation object).
+        obj = self._webhook_admit(obj, "CREATE")
         with self._lock:
             # Admission INSIDE the critical section: validating hooks
             # (quota) read current state, and check-then-insert must be
@@ -420,6 +592,8 @@ class FakeApiServer:
             stored.metadata.generation = 1
             stored.metadata.creation_timestamp = now()
             self._objects[key] = stored
+            if stored.kind == self.WEBHOOK_KIND:
+                self._webhook_keys.add(key)
             out = stored.deepcopy()
             self._emit("ADDED", stored)
         return out
@@ -493,11 +667,10 @@ class FakeApiServer:
         return out
 
     def update(self, obj: Resource) -> Resource:
-        with self._lock:  # admission atomic with the write (see create)
-            return self._update(
-                self._admit(self._normalize_version(obj)),
-                status_only=False,
-            )
+        # Same two-phase admission as create: webhooks off-lock first.
+        obj = self._webhook_admit(self._normalize_version(obj), "UPDATE")
+        with self._lock:  # in-process admission atomic with the write
+            return self._update(self._admit(obj), status_only=False)
 
     def update_status(self, obj: Resource) -> Resource:
         return self._update(obj, status_only=True)
@@ -530,6 +703,7 @@ class FakeApiServer:
 
     def _remove(self, key: tuple, *, emit_delete: bool = True) -> None:
         obj = self._objects.pop(key)
+        self._webhook_keys.discard(key)
         if emit_delete:
             # Deletion is a state transition of its own: give the DELETED
             # event a fresh rv so a watcher resuming from the object's
@@ -584,8 +758,13 @@ class FakeApiServer:
             return self.create(obj)
         # Compare post-conversion, post-admission desired state against
         # stored state — otherwise an apply() of a spoke-version or
-        # pre-admission spec would never no-op (or strip injected fields).
-        obj = self._admit(self._normalize_version(obj))
+        # pre-admission spec would never no-op (or strip injected
+        # fields). Webhook mutations are part of "post-admission" too,
+        # so webhook-injected fields don't defeat the no-op detection
+        # (webhooks, like hooks, must be idempotent).
+        obj = self._admit(
+            self._webhook_admit(self._normalize_version(obj), "UPDATE")
+        )
         if (
             current.spec == obj.spec
             and current.metadata.labels == obj.metadata.labels
@@ -595,7 +774,12 @@ class FakeApiServer:
         merged = obj.deepcopy()
         merged.metadata.resource_version = current.metadata.resource_version
         merged.metadata.uid = current.metadata.uid
-        return self.update(merged)
+        # Internal update path: webhooks already ran on this object for
+        # the comparison above — self.update() would pay every callout's
+        # HTTPS round trip a second time. In-process hooks re-run under
+        # the lock (quota's atomicity; they're cheap and idempotent).
+        with self._lock:
+            return self._update(self._admit(merged), status_only=False)
 
     def record_event(
         self,

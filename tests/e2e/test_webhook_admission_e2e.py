@@ -1,0 +1,141 @@
+"""Out-of-process admission e2e: the PodDefault mutator as its own
+process, matching the reference's deployment shape — a standalone TLS
+webhook server (`admission-webhook/main.go:443,597`) that the apiserver
+calls out to, reading its PodDefault CRs through the authenticated
+facade with a least-privilege token.
+
+Flow: secure TLS facade in the parent; `python -m
+kubeflow_tpu.controllers.webhook --register` as a child process (it
+mints its own serving cert and creates the WebhookConfiguration pointing
+at itself); a Pod created through the facade comes back with the
+PodDefault's env injected by the CHILD. Then the webhook dies:
+failurePolicy=Fail rejects creates; flipped to Ignore, creates pass
+unmodified."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import (
+    make_cluster_role,
+    make_cluster_role_binding,
+    seed_cluster_roles,
+)
+from kubeflow_tpu.api.tokens import TokenRegistry, service_account
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.web.wsgi import serve
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# Exactly what the webhook binary touches: PodDefault reads plus its own
+# registration (the reference grants its webhook the same minimal set
+# via manifests).
+WEBHOOK_RULES = [
+    {"verbs": ["get", "list", "watch"], "resources": ["poddefaults"]},
+    {"verbs": ["create", "update", "patch"],
+     "resources": ["webhookconfigurations"]},
+]
+
+
+def test_poddefault_mutation_via_separate_process(tmp_path, tls_paths):
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    tokens = TokenRegistry()
+    admin_token = tokens.issue("system:admin")
+    api.create(
+        make_cluster_role_binding("adm", "kubeflow-admin", "system:admin")
+    )
+    wh_user = service_account("kubeflow", "poddefault-webhook")
+    api.create(make_cluster_role("poddefault-webhook", WEBHOOK_RULES))
+    api.create(
+        make_cluster_role_binding(
+            "poddefault-webhook", "poddefault-webhook", wh_user
+        )
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
+    )
+    base_url = f"https://127.0.0.1:{server.server_port}"
+    admin = HttpApiClient(base_url, token=admin_token,
+                          ca=tls_paths.ca_cert)
+
+    admin.create(new_resource(
+        "PodDefault", "add-proxy", "default",
+        spec={
+            "selector": {"matchLabels": {"inject": "yes"}},
+            "env": [{"name": "HTTP_PROXY", "value": "http://proxy:80"}],
+        },
+    ))
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.controllers.webhook",
+         "--apiserver", base_url,
+         "--tls-dir", str(tmp_path / "webhook-tls"),
+         "--register"],
+        env={
+            **os.environ,
+            "PYTHONPATH": REPO,
+            "KFTPU_TOKEN": tokens.issue(wh_user),
+            "KFTPU_CA": tls_paths.ca_cert,
+        },
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip().startswith("webhook ready")
+
+        # The callout really crossed process + TLS boundaries: the pod
+        # comes back with the child's injection.
+        matched = admin.create(new_resource(
+            "Pod", "wants-proxy", "default",
+            spec={"containers": [{"name": "w"}]},
+            labels={"inject": "yes"},
+        ))
+        env = matched.spec["containers"][0].get("env", [])
+        assert {"name": "HTTP_PROXY", "value": "http://proxy:80"} in env, env
+        # Selector miss: admitted untouched.
+        plain = admin.create(new_resource(
+            "Pod", "plain", "default",
+            spec={"containers": [{"name": "w"}]},
+        ))
+        assert "env" not in plain.spec["containers"][0]
+
+        # Webhook dies. failurePolicy=Fail (the default): creates of the
+        # webhook's kinds are refused — fail closed, like the reference's
+        # failure policy.
+        proc.terminate()
+        proc.wait(timeout=15)
+        from kubeflow_tpu.testing.fake_apiserver import Invalid
+
+        with pytest.raises(Invalid, match="failurePolicy=Fail"):
+            admin.create(new_resource(
+                "Pod", "orphan", "default",
+                spec={"containers": [{"name": "w"}]},
+            ))
+        # Other kinds are unaffected while the webhook is down.
+        admin.create(new_resource("ConfigMap", "fine", spec={}))
+
+        # Operator flips the policy to Ignore: creates pass, unmodified.
+        cfg = admin.get("WebhookConfiguration", "poddefault-webhook", "")
+        cfg.spec["failurePolicy"] = "Ignore"
+        admin.update(cfg)
+        degraded = admin.create(new_resource(
+            "Pod", "degraded", "default",
+            spec={"containers": [{"name": "w"}]},
+            labels={"inject": "yes"},
+        ))
+        assert "env" not in degraded.spec["containers"][0]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        server.shutdown()
