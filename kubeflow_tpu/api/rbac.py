@@ -34,7 +34,14 @@ _EDIT_VERBS = _VIEW_VERBS + ["create", "update", "patch", "delete"]
 # view/edit roles enumerate resources and omit RBAC kinds entirely, and
 # policy objects shouldn't leak to every wildcard reader.
 RBAC_RESOURCES = frozenset(
-    {"roles", "rolebindings", "clusterroles", "clusterrolebindings"}
+    {
+        "roles", "rolebindings", "clusterroles", "clusterrolebindings",
+        # Webhook configs are the same escalation class as RBAC objects:
+        # registering one injects a mutator into every future write of
+        # the kinds it names (it could rewrite a later ClusterRoleBinding
+        # cluster-wide). Wildcard rules must not reach them either.
+        "webhookconfigurations",
+    }
 )
 
 

@@ -387,12 +387,24 @@ def _flash_bhsd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, bwd_block_q,
                    bwd_block_k, interpret):
     o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+    # Residual slimming: the kernel writes lse BROADCAST across all 128
+    # lanes (Mosaic's f32 tile shape — a narrower kernel output is
+    # blocked, see the dead-end log), but the backward kernels read only
+    # lane 0. Saving all 128 identical copies as the VJP residual is
+    # 128x the bytes that carry information — at S=16k that's ~64 MB of
+    # activation memory per layer per (batch*head) group of 8. Keep one
+    # lane; the backward re-broadcasts before its pallas_calls. This is
+    # what made batch 2 fit at S=16k under the attention-saving remat
+    # policy (it previously overflowed HBM by 74 MB).
+    return o, (q, k, v, o, lse[:, :, :1])
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k,
                    interpret, residuals, do):
-    q, k, v, o, lse = residuals
+    q, k, v, o, lse_slim = residuals
+    lse = jnp.broadcast_to(
+        lse_slim, lse_slim.shape[:2] + (_LANES,)
+    )
     return _flash_bwd_impl(
         q, k, v, o, lse, do, causal, bwd_block_q, bwd_block_k, interpret
     )

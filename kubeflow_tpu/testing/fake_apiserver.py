@@ -367,12 +367,16 @@ class FakeApiServer:
         before = (
             obj.kind, obj.metadata.name, obj.metadata.namespace,
             obj.metadata.uid, obj.metadata.resource_version,
-            obj.api_version,
+            obj.api_version, obj.status,
         )
         after = (
             mutated.kind, mutated.metadata.name,
             mutated.metadata.namespace, mutated.metadata.uid,
             mutated.metadata.resource_version, mutated.api_version,
+            # status too: the facade strips status from clients without
+            # the <resource>/status grant BEFORE admission runs — a
+            # webhook forging phase=Succeeded would bypass that guard.
+            mutated.status,
         )
         if before != after:
             raise Invalid(

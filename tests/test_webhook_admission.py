@@ -281,3 +281,50 @@ def test_native_backend_refuses_webhook_configs():
             "WebhookConfiguration", "x", "",
             spec={"url": "https://x/mutate", "kinds": ["Pod"]},
         ))
+
+
+def test_wildcard_edit_cannot_register_webhooks():
+    """Registering a webhook = code execution on every future write of
+    the kinds it names — the same escalation class as RBAC objects, so
+    `resources: ["*"]` must not reach webhookconfigurations either."""
+    from kubeflow_tpu.api.rbac import (
+        make_cluster_role_binding,
+        seed_cluster_roles,
+        subject_access_review,
+    )
+
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    api.create(
+        make_cluster_role_binding("ed", "kubeflow-edit", "mallory@x.co")
+    )
+    assert subject_access_review(api, "mallory@x.co", "create", "pods", "")
+    assert not subject_access_review(
+        api, "mallory@x.co", "create", "webhookconfigurations", ""
+    )
+    # cluster-admin's explicit grant still reaches them.
+    api.create(
+        make_cluster_role_binding("adm", "kubeflow-admin", "root@x.co")
+    )
+    assert subject_access_review(
+        api, "root@x.co", "create", "webhookconfigurations", ""
+    )
+
+
+def test_webhook_cannot_forge_status(tls_paths):
+    """The facade strips status from clients without the status grant
+    BEFORE admission runs; a webhook adding status afterwards would
+    bypass that forgery guard — status is immutable through callouts."""
+
+    def forge(obj, operation):
+        obj.status = {"phase": "Succeeded"}
+        return obj
+
+    api = FakeApiServer()
+    server, cfg = _webhook(tls_paths, mutate=forge)
+    try:
+        api.create(cfg)
+        with pytest.raises(Invalid, match="immutable"):
+            api.create(_pod())
+    finally:
+        server.shutdown()
