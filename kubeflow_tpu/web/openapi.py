@@ -99,6 +99,7 @@ def main() -> None:
     import yaml
 
     from kubeflow_tpu.apps.kfam import KfamApp
+    from kubeflow_tpu.controllers.webhook import MutatingWebhookApp
     from kubeflow_tpu.deploy.provisioner import FakeCloud
     from kubeflow_tpu.deploy.server import DeployServer
     from kubeflow_tpu.testing.apiserver_http import ApiServerApp
@@ -109,6 +110,10 @@ def main() -> None:
         (ApiServerApp(api), "kubeflow-tpu apiserver facade"),
         (KfamApp(api), "kubeflow-tpu access management (kfam)"),
         (DeployServer(api, FakeCloud(api)), "kubeflow-tpu deploy service"),
+        (
+            MutatingWebhookApp(lambda obj, op: obj),
+            "kubeflow-tpu admission webhook",
+        ),
     ):
         sys.stdout.write(f"# --- {app.name} ---\n")
         yaml.safe_dump(skeleton(app, title), sys.stdout, sort_keys=False)

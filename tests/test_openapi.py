@@ -12,6 +12,7 @@ import pytest
 import yaml
 
 from kubeflow_tpu.apps.kfam import KfamApp
+from kubeflow_tpu.controllers.webhook import MutatingWebhookApp
 from kubeflow_tpu.deploy.provisioner import FakeCloud
 from kubeflow_tpu.deploy.server import DeployServer
 from kubeflow_tpu.testing.apiserver_http import ApiServerApp
@@ -32,11 +33,12 @@ def _apps():
         "apiserver.yaml": ApiServerApp(api),
         "kfam.yaml": KfamApp(api),
         "deploy.yaml": DeployServer(api, FakeCloud(api)),
+        "webhook.yaml": MutatingWebhookApp(lambda obj, op: obj),
     }
 
 
 @pytest.mark.parametrize("spec_file", ["apiserver.yaml", "kfam.yaml",
-                                       "deploy.yaml"])
+                                       "deploy.yaml", "webhook.yaml"])
 def test_spec_matches_routes(spec_file):
     app = _apps()[spec_file]
     spec = yaml.safe_load((DOCS / spec_file).read_text())
@@ -45,7 +47,7 @@ def test_spec_matches_routes(spec_file):
 
 
 @pytest.mark.parametrize("spec_file", ["apiserver.yaml", "kfam.yaml",
-                                       "deploy.yaml"])
+                                       "deploy.yaml", "webhook.yaml"])
 def test_spec_is_valid_openapi3_shape(spec_file):
     spec = yaml.safe_load((DOCS / spec_file).read_text())
     assert spec["openapi"].startswith("3.")
