@@ -571,11 +571,14 @@ def _ring_flash_body(q, k, v, axis, causal, bq, bk, interpret):
 
 def _ring_flash_body_fwd(q, k, v, axis, causal, bq, bk, interpret):
     o, lse = _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret)
-    return o, (q, k, v, o, lse)
+    # Same residual slimming as _flash_vjp_fwd: the global lse is
+    # lane-broadcast 128 wide; save one lane, re-broadcast in bwd.
+    return o, (q, k, v, o, lse[:, :, :1])
 
 
 def _ring_flash_body_bwd(axis, causal, bq, bk, interpret, residuals, do):
-    q, k, v, o, lse = residuals
+    q, k, v, o, lse_slim = residuals
+    lse = jnp.broadcast_to(lse_slim, lse_slim.shape[:2] + (_LANES,))
     b, c, h, d = q.shape
     n = lax.axis_size(axis)
     my = lax.axis_index(axis)
