@@ -328,3 +328,20 @@ def test_webhook_cannot_forge_status(tls_paths):
             api.create(_pod())
     finally:
         server.shutdown()
+
+
+def test_webhook_config_survives_durable_restart(tls_paths, tmp_path):
+    """A restored store keeps calling out: the WebhookConfiguration is a
+    CR like any other, and the restore path rebuilds the webhook index
+    (an unindexed config would silently fail open after restart)."""
+    api = FakeApiServer(persist_dir=str(tmp_path / "state"))
+    server, cfg = _webhook(tls_paths)
+    try:
+        api.create(cfg)
+        api.close()
+        restored = FakeApiServer(persist_dir=str(tmp_path / "state"))
+        created = restored.create(_pod())
+        env = created.spec["containers"][0]["env"]
+        assert {"name": "INJECTED", "value": "CREATE"} in env
+    finally:
+        server.shutdown()
