@@ -74,8 +74,26 @@ def _boot(tmp_path, port: int) -> subprocess.Popen:
         text=True,
     )
     line = proc.stdout.readline().strip()
-    assert line == f"apiserver ready {port}", line
+    if line != f"apiserver ready {port}":
+        proc.kill()
+        proc.communicate()  # reap; don't leak a worker on a failed boot
+        raise AssertionError(line)
     return proc
+
+
+def _boot_fresh(tmp_path) -> tuple[subprocess.Popen, int]:
+    """First boot: pick a port and start the worker, retrying on the
+    inherent _free_port()→bind race (another process — e.g. a parallel
+    pytest run — can steal the port in between). RESTART boots must
+    reuse the original port and don't retry: clients hold the URL."""
+    last: Exception | None = None
+    for _ in range(3):
+        port = _free_port()
+        try:
+            return _boot(tmp_path, port), port
+        except AssertionError as e:
+            last = e
+    raise AssertionError(f"could not boot the apiserver worker: {last}")
 
 
 def _ca(tmp_path) -> str:
@@ -108,8 +126,7 @@ def test_sigkill_restart_preserves_state_and_watch_recovers(tmp_path):
     tokens = TokenRegistry()
     admin_token = tokens.issue("system:admin")
     tokens.save(str(tmp_path / "tokens"))
-    port = _free_port()
-    proc = _boot(tmp_path, port)
+    proc, port = _boot_fresh(tmp_path)
     base_url = f"https://127.0.0.1:{port}"
     admin = HttpApiClient(
         base_url, token=admin_token, watch_poll_timeout=2.0,
@@ -181,8 +198,7 @@ def test_sigkill_mid_gang_job_resumes_from_checkpoint(tmp_path):
     ctl_user = service_account("kubeflow", "tpujob-controller")
     ctl_token = tokens.issue(ctl_user)
     tokens.save(str(tmp_path / "tokens"))
-    port = _free_port()
-    proc = _boot(tmp_path, port)
+    proc, port = _boot_fresh(tmp_path)
     base_url = f"https://127.0.0.1:{port}"
     admin = HttpApiClient(
         base_url, token=admin_token, watch_poll_timeout=2.0,
