@@ -74,21 +74,26 @@ def make_webhook_config(
     *,
     failure_policy: str = "Fail",
     timeout_seconds: float = 5.0,
+    namespaces: tuple[str, ...] = (),
+    match_labels: dict[str, str] | None = None,
 ) -> Resource:
     """The WebhookConfiguration CR the store's admission phase consumes
-    (the MutatingWebhookConfiguration analog; cluster-scoped)."""
-    return new_resource(
-        "WebhookConfiguration",
-        name,
-        "",
-        spec={
-            "url": url,
-            "caBundle": ca_bundle,
-            "kinds": list(kinds),
-            "failurePolicy": failure_policy,
-            "timeoutSeconds": timeout_seconds,
-        },
-    )
+    (the MutatingWebhookConfiguration analog; cluster-scoped).
+    `namespaces` scopes callouts to those namespaces (the
+    namespaceSelector analog; empty = all); `match_labels` is the
+    objectSelector — only matching objects are sent."""
+    spec = {
+        "url": url,
+        "caBundle": ca_bundle,
+        "kinds": list(kinds),
+        "failurePolicy": failure_policy,
+        "timeoutSeconds": timeout_seconds,
+    }
+    if namespaces:
+        spec["namespaces"] = list(namespaces)
+    if match_labels:
+        spec["selector"] = {"matchLabels": dict(match_labels)}
+    return new_resource("WebhookConfiguration", name, "", spec=spec)
 
 
 def main(argv: list[str] | None = None) -> int:

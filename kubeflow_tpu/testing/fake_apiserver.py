@@ -275,6 +275,9 @@ class FakeApiServer:
     #     url: https://127.0.0.1:9443/mutate   (https only)
     #     caBundle: /path/to/webhook-ca.crt    (pins the callee)
     #     kinds: ["Pod"]
+    #     namespaces: ["team-a"]               (optional; [] = all — the
+    #                                           namespaceSelector analog)
+    #     selector: {matchLabels: {...}}       (optional objectSelector)
     #     timeoutSeconds: 5
     #     failurePolicy: Fail | Ignore         (default Fail)
     #
@@ -395,13 +398,22 @@ class FakeApiServer:
             return obj
         if not self._webhook_keys:
             return obj  # the common case costs one set check
+
+        def _matches_cfg(spec: dict) -> bool:
+            if obj.kind not in (spec.get("kinds") or []):
+                return False
+            namespaces = spec.get("namespaces") or []
+            if namespaces and obj.metadata.namespace not in namespaces:
+                return False  # the namespaceSelector analog
+            selector = (spec.get("selector") or {}).get("matchLabels") or {}
+            return _matches(obj.metadata.labels, selector)  # objectSelector
+
         with self._lock:
             configs = [
                 self._objects[k].deepcopy()
                 for k in sorted(self._webhook_keys)
                 if k in self._objects
-                and obj.kind
-                in (self._objects[k].spec.get("kinds") or [])
+                and _matches_cfg(self._objects[k].spec)
             ]
         for cfg in configs:  # key-sorted: deterministic order
             try:

@@ -345,3 +345,36 @@ def test_webhook_config_survives_durable_restart(tls_paths, tmp_path):
         assert {"name": "INJECTED", "value": "CREATE"} in env
     finally:
         server.shutdown()
+
+
+def test_namespace_and_object_selectors_scope_callouts(tls_paths):
+    """The namespaceSelector/objectSelector analogs: a scoped webhook
+    only sees objects in its namespaces AND matching its labels —
+    everything else is admitted without a round trip."""
+    api = FakeApiServer()
+    server, cfg = _webhook(
+        tls_paths,
+        namespaces=("team-a",),
+        match_labels={"inject": "yes"},
+    )
+    try:
+        api.create(cfg)
+        hit = api.create(new_resource(
+            "Pod", "hit", "team-a",
+            spec={"containers": [{"name": "w"}]},
+            labels={"inject": "yes"},
+        ))
+        assert "env" in hit.spec["containers"][0]
+        wrong_ns = api.create(new_resource(
+            "Pod", "wrong-ns", "team-b",
+            spec={"containers": [{"name": "w"}]},
+            labels={"inject": "yes"},
+        ))
+        assert "env" not in wrong_ns.spec["containers"][0]
+        wrong_labels = api.create(new_resource(
+            "Pod", "wrong-labels", "team-a",
+            spec={"containers": [{"name": "w"}]},
+        ))
+        assert "env" not in wrong_labels.spec["containers"][0]
+    finally:
+        server.shutdown()
