@@ -264,8 +264,12 @@ def main() -> None:
         scheme = "https" if (is_facade and tls_paths) else "http"
         print(f"{app.name}: {scheme}://{args.host}:{server.server_port}")
     try:
+        # Short sleeps, not one long park: a SIGINT delivered to a
+        # non-main thread only raises KeyboardInterrupt when the main
+        # thread next runs bytecode — sleep(3600) would defer Ctrl-C by
+        # up to an hour in this very threaded process.
         while True:
-            time.sleep(3600)
+            time.sleep(1)
     except KeyboardInterrupt:
         runner_stop.set()
         runner.shutdown()

@@ -154,7 +154,11 @@ def main(argv: list[str] | None = None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
-    stop.wait()
+    # Poll, don't park: a process-directed signal delivered to a worker
+    # thread only runs its Python handler when the MAIN thread executes
+    # bytecode — a bare wait() would defer shutdown indefinitely.
+    while not stop.wait(0.5):
+        pass
     server.shutdown()
     return 0
 

@@ -92,8 +92,10 @@ def main() -> int:
         server, _ = serve(deploy_server, host=args.host, port=args.port)
         print(f"deploy-server: http://{args.host}:{server.server_port}")
         try:
+            # Short sleeps: a SIGINT landing on a non-main thread only
+            # raises in the main thread at its next bytecode boundary.
             while True:
-                time.sleep(3600)
+                time.sleep(1)
         except KeyboardInterrupt:
             # Workers first: orphaned per-deployment processes would poll
             # the dead facade forever.

@@ -20,12 +20,18 @@ seeds the RBAC roles + a system:admin binding; on restart they are
 restored from disk — the e2e asserts that, so don't reseed.
 """
 
+import faulthandler
 import os
 import signal
 import sys
 import threading
 
 sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+# Diagnostics for a hung shutdown: SIGUSR1 dumps every thread's stack to
+# stderr (the e2e sends it before killing a worker that missed its
+# SIGTERM deadline, so the captured output names the stuck frame).
+faulthandler.register(signal.SIGUSR1)
 
 from kubeflow_tpu.api.rbac import (  # noqa: E402
     make_cluster_role_binding,
@@ -71,9 +77,21 @@ def main() -> None:
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
-    stop.wait()
+    # Poll, don't park: a process-directed SIGTERM can be DELIVERED to a
+    # non-main thread, and the Python-level handler then only runs when
+    # the MAIN thread next executes bytecode — a bare stop.wait() parks
+    # it in sem_wait forever, so the handler never fires (reproduced:
+    # the restart e2e's faulthandler dump showed exactly this). Waking
+    # every 0.5 s gives the pending handler a bytecode boundary.
+    while not stop.wait(0.5):
+        pass
+    # Stage markers: if shutdown wedges, the captured stdout shows how
+    # far it got (paired with the SIGUSR1 stack dump above).
+    print("shutting down: server", flush=True)
     server.shutdown()
+    print("shutting down: store", flush=True)
     api.close()  # graceful path folds the WAL into a snapshot
+    print("shutdown complete", flush=True)
 
 
 if __name__ == "__main__":

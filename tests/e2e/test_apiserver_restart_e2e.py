@@ -187,7 +187,19 @@ def test_sigkill_restart_preserves_state_and_watch_recovers(tmp_path):
     finally:
         admin.close()
         proc.send_signal(signal.SIGTERM)
-        out = proc.communicate(timeout=30)[0]
+        try:
+            out = proc.communicate(timeout=30)[0]
+        except subprocess.TimeoutExpired:
+            # Collect WHERE it wedged before killing: SIGUSR1 triggers
+            # the worker's faulthandler all-thread stack dump.
+            proc.send_signal(signal.SIGUSR1)
+            time.sleep(2)
+            proc.kill()
+            out = proc.communicate()[0]
+            raise AssertionError(
+                f"apiserver worker missed the SIGTERM deadline; "
+                f"stacks/markers:\n{out}"
+            )
     # Graceful shutdown checkpointed the store.
     assert (tmp_path / "state" / "store" / "snapshot.json").exists(), out
 
