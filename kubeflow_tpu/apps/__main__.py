@@ -14,13 +14,20 @@ from __future__ import annotations
 
 import argparse
 import atexit
+import faulthandler
 import logging
 import shutil
+import signal
 import tempfile
 import threading
-import time
 
 import os
+
+from kubeflow_tpu.utils import signals
+
+# Operational diagnostics: SIGUSR1 dumps every thread's stack (find a
+# wedged shutdown or a stuck controller without killing the platform).
+faulthandler.register(signal.SIGUSR1)
 
 from kubeflow_tpu.api.objects import new_resource
 from kubeflow_tpu.api.rbac import make_cluster_role_binding, seed_cluster_roles
@@ -92,6 +99,10 @@ def main() -> None:
     )
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+
+    # Graceful shutdown on SIGTERM/SIGINT (see utils/signals.py for the
+    # event-based + installed-early + poll-not-park rationale).
+    shutdown_requested = signals.install_shutdown_handlers()
 
     if args.state_dir:
         os.makedirs(args.state_dir, mode=0o700, exist_ok=True)
@@ -263,19 +274,12 @@ def main() -> None:
         servers.append(server)
         scheme = "https" if (is_facade and tls_paths) else "http"
         print(f"{app.name}: {scheme}://{args.host}:{server.server_port}")
-    try:
-        # Short sleeps, not one long park: a SIGINT delivered to a
-        # non-main thread only raises KeyboardInterrupt when the main
-        # thread next runs bytecode — sleep(3600) would defer Ctrl-C by
-        # up to an hour in this very threaded process.
-        while True:
-            time.sleep(1)
-    except KeyboardInterrupt:
-        runner_stop.set()
-        runner.shutdown()
-        for server in servers:
-            server.shutdown()
-        api.close()  # durable boot: fold the WAL into a snapshot
+    signals.wait_for_shutdown(shutdown_requested)
+    runner_stop.set()
+    runner.shutdown()
+    for server in servers:
+        server.shutdown()
+    api.close()  # durable boot: fold the WAL into a snapshot
 
 
 if __name__ == "__main__":

@@ -29,9 +29,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import signal
 import sys
-import threading
 from typing import Callable
 
 from kubeflow_tpu.api.objects import Resource, new_resource
@@ -151,14 +149,9 @@ def main(argv: list[str] | None = None) -> int:
             )
         )
     print(f"webhook ready {server.server_port}", flush=True)
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    signal.signal(signal.SIGINT, lambda *_: stop.set())
-    # Poll, don't park: a process-directed signal delivered to a worker
-    # thread only runs its Python handler when the MAIN thread executes
-    # bytecode — a bare wait() would defer shutdown indefinitely.
-    while not stop.wait(0.5):
-        pass
+    from kubeflow_tpu.utils import signals as sigutil
+
+    sigutil.wait_for_shutdown(sigutil.install_shutdown_handlers())
     server.shutdown()
     return 0
 

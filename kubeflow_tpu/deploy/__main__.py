@@ -16,13 +16,12 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
-
 from kubeflow_tpu.deploy.apply import apply_platform, delete_platform
 from kubeflow_tpu.deploy.kfdef import PlatformSpec, default_spec
 from kubeflow_tpu.deploy.provisioner import FakeCloud
 from kubeflow_tpu.deploy.server import DeployServer
 from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+from kubeflow_tpu.utils import signals
 from kubeflow_tpu.web.wsgi import serve
 
 
@@ -89,18 +88,16 @@ def main() -> int:
             worker_mode=args.worker_mode,
             worker_args=tuple(worker_args),
         )
+        # Graceful stop on SIGTERM/SIGINT (see utils/signals.py for the
+        # event-based + installed-early + poll-not-park rationale).
+        stop_requested = signals.install_shutdown_handlers()
         server, _ = serve(deploy_server, host=args.host, port=args.port)
         print(f"deploy-server: http://{args.host}:{server.server_port}")
-        try:
-            # Short sleeps: a SIGINT landing on a non-main thread only
-            # raises in the main thread at its next bytecode boundary.
-            while True:
-                time.sleep(1)
-        except KeyboardInterrupt:
-            # Workers first: orphaned per-deployment processes would poll
-            # the dead facade forever.
-            deploy_server.shutdown_workers()
-            server.shutdown()
+        signals.wait_for_shutdown(stop_requested)
+        # Workers first: orphaned per-deployment processes would poll
+        # the dead facade forever.
+        deploy_server.shutdown_workers()
+        server.shutdown()
         return 0
 
     with open(args.file) as f:

@@ -1,0 +1,49 @@
+"""Graceful-shutdown signal handling for threaded server processes.
+
+Every long-running entrypoint (platform launcher, deploy server,
+admission webhook, e2e apiserver worker) needs the same three subtle
+properties, so they live in one place:
+
+- **Event-based handlers, installed early.** A handler that raises
+  (KeyboardInterrupt-style) can unwind through half-constructed boot
+  state; setting an Event lets the main function finish (or abort) its
+  boot and run one well-defined cleanup path. Installing before
+  anything serves means a stop signal can never catch the boot window
+  on the default disposition. SIGINT is installed explicitly even
+  though Python normally does it: a backgrounding non-interactive shell
+  starts children with SIGINT=SIG_IGN, and Python then skips its
+  default handler — `kill -INT` would silently no-op.
+- **Poll, don't park.** A process-directed signal can be DELIVERED to a
+  non-main thread; the Python-level handler then only runs when the
+  MAIN thread next executes bytecode. A main thread parked in a bare
+  ``Event.wait()`` (sem_wait) or ``time.sleep(3600)`` never gets there
+  — reproduced in the restart e2e, where a worker ignored its SIGTERM
+  forever. Waking every half second bounds shutdown latency instead.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+
+def install_shutdown_handlers(
+    signals: tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+) -> threading.Event:
+    """Install handlers for `signals` that set the returned Event.
+    Call from the MAIN thread, before serving anything."""
+    stop = threading.Event()
+    for sig in signals:
+        signal.signal(sig, lambda *_: stop.set())
+    return stop
+
+
+def wait_for_shutdown(stop: threading.Event, poll: float = 0.5) -> None:
+    """Block the main thread until `stop` is set — polling (see module
+    docstring for why), and treating a raw KeyboardInterrupt (Ctrl-C
+    delivered before/around our handler) as the same request."""
+    try:
+        while not stop.wait(poll):
+            pass
+    except KeyboardInterrupt:
+        stop.set()

@@ -24,7 +24,6 @@ import faulthandler
 import os
 import signal
 import sys
-import threading
 
 sys.path.insert(0, os.environ["KFTPU_REPO"])
 
@@ -74,17 +73,11 @@ def main() -> None:
         tls=paths,
     )
     print(f"apiserver ready {server.server_port}", flush=True)
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    signal.signal(signal.SIGINT, lambda *_: stop.set())
-    # Poll, don't park: a process-directed SIGTERM can be DELIVERED to a
-    # non-main thread, and the Python-level handler then only runs when
-    # the MAIN thread next executes bytecode — a bare stop.wait() parks
-    # it in sem_wait forever, so the handler never fires (reproduced:
-    # the restart e2e's faulthandler dump showed exactly this). Waking
-    # every 0.5 s gives the pending handler a bytecode boundary.
-    while not stop.wait(0.5):
-        pass
+    from kubeflow_tpu.utils import signals as sigutil
+
+    # Poll-not-park graceful stop (utils/signals.py has the rationale —
+    # this worker's hang is the reproduction that motivated it).
+    sigutil.wait_for_shutdown(sigutil.install_shutdown_handlers())
     # Stage markers: if shutdown wedges, the captured stdout shows how
     # far it got (paired with the SIGUSR1 stack dump above).
     print("shutting down: server", flush=True)
