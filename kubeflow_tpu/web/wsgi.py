@@ -339,6 +339,14 @@ def serve(app: App, host: str = "0.0.0.0", port: int = 8080, tls=None):
         from kubeflow_tpu.web import tls as tlsmod
 
         server.ssl_context = tlsmod.server_context(tls)
+    # Bound accept(): select() can report a pending connection that the
+    # peer RESETS before we accept it (a client tearing down right as
+    # the server stops — exactly the e2e shutdown sequence), and a
+    # blocking accept on a drained queue then parks the serve loop
+    # FOREVER — shutdown() never returns. A listener timeout turns that
+    # into a retried OSError; accepted connections stay blocking (the
+    # accepted socket does not inherit the listener's timeout).
+    server.socket.settimeout(5.0)
     thread = threading.Thread(
         target=server.serve_forever, name=f"{app.name}-http", daemon=True
     )
