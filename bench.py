@@ -86,9 +86,10 @@ def main() -> None:
         help="lm only: per-block checkpoint policy. auto = mlp (remat "
         "only the MLP half; attention residuals saved, so the flash "
         "forward is never re-run in the backward — measured fastest at "
-        "EVERY seq length: 58.0%% MFU at 2k, 55.9%% at 8k, 50.7%% at "
-        "16k, vs 57.2/47.2/42.2 for the old dots/full auto). dots "
-        "spills at long S; full re-runs flash fwd in bwd",
+        "EVERY seq length: 58.0%% MFU at 2k, 55.9%% at 8k, 52.2%% at "
+        "16k with bs=2 after lse slimming — the bs=1 policy-comparison "
+        "run measured 50.7%% — vs 57.2/47.2/42.2 for the old dots/full "
+        "auto). dots spills at long S; full re-runs flash fwd in bwd",
     )
     parser.add_argument(
         "--flash-block-q", type=int, default=None,

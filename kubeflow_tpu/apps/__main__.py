@@ -58,6 +58,16 @@ from kubeflow_tpu.web.wsgi import serve
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--advertise-host",
+        action="append",
+        default=None,
+        help="extra hostname/IP to put in the facade cert's SANs (repeat "
+        "for several). Required context for --host 0.0.0.0: that is a "
+        "bind address, not a reachable name, so clients connect via some "
+        "concrete name that must be in the cert. Default when binding "
+        "0.0.0.0: this machine's hostname/FQDN/primary IP",
+    )
     parser.add_argument("--port-base", type=int, default=8080)
     parser.add_argument(
         "--anonymous",
@@ -238,14 +248,39 @@ def main() -> None:
         # SANs cover loopback plus the actual bind host (a cert that
         # only names localhost is unverifiable by every LAN client the
         # moment --host is non-loopback). 0.0.0.0 is a bind address,
-        # not a reachable name — clients connect via a concrete host.
+        # not a reachable name — clients connect via a concrete host,
+        # so a wildcard bind pulls in --advertise-host (or, failing
+        # that, the machine's own resolvable names) instead of silently
+        # minting a loopback-only cert no LAN client can verify.
         hosts = ["localhost", "127.0.0.1"]
         if args.host not in hosts and args.host != "0.0.0.0":
             hosts.append(args.host)
-        tls_paths = tls.ensure_tls_dir(
-            os.path.join(os.path.dirname(token_file), "tls"),
-            hosts=tuple(hosts),
-        )
+        tls_dir = os.path.join(os.path.dirname(token_file), "tls")
+        prior_hosts = tls.read_hosts_marker(tls_dir)
+        # Durable restart: keep every name the minted cert already
+        # carries. Dropping one (because a probe or flag set changed)
+        # would re-mint the CA and break every client pinned to it —
+        # names are only ever ADDED, matching the flag's "extra" help.
+        hosts.extend(h for h in prior_hosts if h not in hosts)
+        if args.advertise_host:
+            hosts.extend(h for h in args.advertise_host if h not in hosts)
+        elif args.host == "0.0.0.0":
+            if not prior_hosts:
+                import socket
+
+                candidates = [socket.gethostname(), socket.getfqdn()]
+                # UDP connect never sends a packet; it just picks the
+                # interface/IP the default route would use.
+                probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                try:
+                    probe.connect(("10.255.255.255", 1))
+                    candidates.append(probe.getsockname()[0])
+                except OSError:
+                    pass
+                finally:
+                    probe.close()
+                hosts.extend(h for h in candidates if h and h not in hosts)
+        tls_paths = tls.ensure_tls_dir(tls_dir, hosts=tuple(hosts))
         print(f"apiserver admin token: {admin_token}")
         print(f"apiserver token file:  {token_file}")
         print(f"apiserver CA (pin via --ca/KFTPU_CA): {tls_paths.ca_cert}")

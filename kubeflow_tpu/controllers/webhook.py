@@ -77,9 +77,24 @@ def make_webhook_config(
 ) -> Resource:
     """The WebhookConfiguration CR the store's admission phase consumes
     (the MutatingWebhookConfiguration analog; cluster-scoped).
+    `ca_bundle` should be the PEM data itself (like the K8s caBundle
+    field, which embeds base64 PEM in the config object) so a config
+    created by a remote client is self-contained; a local file path is
+    accepted as a legacy convenience and inlined here when readable.
     `namespaces` scopes callouts to those namespaces (the
     namespaceSelector analog; empty = all); `match_labels` is the
     objectSelector — only matching objects are sent."""
+    from kubeflow_tpu.web.tls import is_pem_data
+
+    if not is_pem_data(ca_bundle):
+        try:
+            with open(ca_bundle, "r", encoding="utf-8") as f:
+                ca_bundle = f.read()
+        except OSError as e:
+            raise ValueError(
+                f"ca_bundle is neither PEM data nor a readable file: "
+                f"{ca_bundle!r} ({e})"
+            ) from e
     spec = {
         "url": url,
         "caBundle": ca_bundle,

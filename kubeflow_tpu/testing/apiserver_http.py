@@ -55,6 +55,7 @@ from kubeflow_tpu.testing.fake_apiserver import (
     Gone,
     Invalid,
     NotFound,
+    Unavailable,
     WatchHandler,
 )
 
@@ -527,6 +528,8 @@ class HttpApiClient:
                 raise Gone(detail)
             if e.code == 422:
                 raise Invalid(detail)
+            if e.code == 503:
+                raise Unavailable(detail)
             raise
 
     def get(

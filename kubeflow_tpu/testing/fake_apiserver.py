@@ -70,6 +70,15 @@ class Gone(ApiError):
     resourceVersion."""
 
 
+class Unavailable(ApiError):
+    """The store fail-stopped: a WAL write failed, so in-memory state may
+    have run ahead of the durable log. Serving on would expose writes a
+    restart silently loses (and a later snapshot would wrongly
+    legitimize the divergence), so every operation refuses until the
+    process restarts over the intact log — etcd's own posture when its
+    backend errors. Maps to HTTP 503."""
+
+
 def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
@@ -121,6 +130,9 @@ class FakeApiServer:
         self._wal = None
         self._snapshot_every = max(1, snapshot_every)
         self._appends_since_snapshot = 0
+        # Set on the first WAL/snapshot IO failure; every public op then
+        # raises Unavailable (see _fail_stop).
+        self._broken: BaseException | None = None
         if persist_dir is not None:
             from kubeflow_tpu.testing import persist
 
@@ -188,43 +200,79 @@ class FakeApiServer:
         # the (empty) in-memory journal: 410 Gone → they relist.
         self._floor = self._rv
 
+    def _fail_stop(self, cause: BaseException) -> None:
+        """Durable-write failure (disk full, IO error): the in-memory
+        mutation that triggered it has NOT reached the journal or any
+        watcher yet, but it is in self._objects — so rather than audit a
+        rollback at every mutation site, stop serving entirely. The
+        divergent state is then unobservable (all ops raise) and can
+        never be checkpointed (the WAL handle is dropped, so close()/
+        checkpoint() no-op instead of snapshotting un-logged writes)."""
+        self._broken = cause
+        wal, self._wal = self._wal, None
+        if wal is not None:
+            try:
+                wal.close()
+            except Exception:
+                pass
+        log.error("store fail-stopped after persistence failure: %s", cause)
+        raise Unavailable(
+            f"store fail-stopped after a persistence failure: {cause}"
+        ) from cause
+
+    def _check_available(self) -> None:
+        if self._broken is not None:
+            raise Unavailable(
+                f"store fail-stopped after a persistence failure: "
+                f"{self._broken}"
+            )
+
     def _persist(self, event: str, obj: Resource) -> None:
         """WAL-append one committed write (caller holds the lock). Runs
         BEFORE the in-memory journal append / watch delivery: an event a
         watcher saw must never be missing after a crash."""
         import json as _json
 
-        self._wal.append(
-            _json.dumps(
-                {
-                    "rv": obj.metadata.resource_version,
-                    "event": event,
-                    "object": obj.to_dict(),
-                },
-                separators=(",", ":"),
+        try:
+            self._wal.append(
+                _json.dumps(
+                    {
+                        "rv": obj.metadata.resource_version,
+                        "event": event,
+                        "object": obj.to_dict(),
+                    },
+                    separators=(",", ":"),
+                )
             )
-        )
-        self._appends_since_snapshot += 1
-        if self._appends_since_snapshot >= self._snapshot_every:
-            self._checkpoint_locked()
+            self._appends_since_snapshot += 1
+            if self._appends_since_snapshot >= self._snapshot_every:
+                self._checkpoint_locked()
+        except ApiError:
+            raise
+        except Exception as e:
+            self._fail_stop(e)
 
     def _checkpoint_locked(self) -> None:
         import json as _json
 
         from kubeflow_tpu.testing.persist import FORMAT
 
-        self._wal.snapshot(
-            _json.dumps(
-                {
-                    "format": FORMAT,
-                    "rv": self._rv,
-                    "objects": [
-                        o.to_dict() for _, o in sorted(self._objects.items())
-                    ],
-                },
-                separators=(",", ":"),
+        try:
+            self._wal.snapshot(
+                _json.dumps(
+                    {
+                        "format": FORMAT,
+                        "rv": self._rv,
+                        "objects": [
+                            o.to_dict()
+                            for _, o in sorted(self._objects.items())
+                        ],
+                    },
+                    separators=(",", ":"),
+                )
             )
-        )
+        except Exception as e:
+            self._fail_stop(e)
         self._appends_since_snapshot = 0
 
     def checkpoint(self) -> None:
@@ -273,7 +321,7 @@ class FakeApiServer:
     #
     #   spec:
     #     url: https://127.0.0.1:9443/mutate   (https only)
-    #     caBundle: /path/to/webhook-ca.crt    (pins the callee)
+    #     caBundle: <inline PEM>               (pins the callee)
     #     kinds: ["Pod"]
     #     namespaces: ["team-a"]               (optional; [] = all — the
     #                                           namespaceSelector analog)
@@ -324,6 +372,20 @@ class FakeApiServer:
                 f"WebhookConfiguration {obj.metadata.name!r}: "
                 f"timeoutSeconds must be a positive number, got "
                 f"{timeout!r}"
+            )
+        from kubeflow_tpu.web.tls import is_pem_data
+
+        ca = spec.get("caBundle", "")
+        if ca and not is_pem_data(ca):
+            # Inline PEM only (the K8s caBundle form). A filesystem path
+            # here would make the APISERVER open an arbitrary local file
+            # chosen by whoever may create webhookconfigurations, and
+            # would silently break for remote clients whose path doesn't
+            # exist server-side. make_webhook_config inlines a readable
+            # local path client-side for the legacy convenience.
+            raise Invalid(
+                f"WebhookConfiguration {obj.metadata.name!r}: caBundle "
+                "must be inline PEM data (paths are resolved client-side)"
             )
 
     def _call_webhook(
@@ -453,6 +515,11 @@ class FakeApiServer:
                 self._dispatcher.start()
 
     def _emit(self, event: str, obj: Resource) -> None:
+        # Authoritative fail-stop check, under the lock (every caller
+        # holds it): a writer that slipped past an unlocked precheck
+        # while another thread fail-stopped must not see its event
+        # journaled/delivered with persistence silently gone.
+        self._check_available()
         # Durability first: the WAL append (fsync'd) happens before any
         # watcher can observe the event, so an acked write survives a
         # crash that follows it.
@@ -525,6 +592,7 @@ class FakeApiServer:
         server's current rv (the resume point even when nothing matched
         the filter). Raises Gone when the bookmark predates the journal."""
         with self._lock:
+            self._check_available()
             if resource_version < self._floor:
                 raise Gone(
                     f"resourceVersion {resource_version} predates this "
@@ -587,6 +655,7 @@ class FakeApiServer:
             raise Invalid(str(e)) from e
 
     def create(self, obj: Resource) -> Resource:
+        self._check_available()
         obj = self._normalize_version(obj)
         # Webhook callouts OUTSIDE the lock (an HTTP round trip must not
         # stall writers), before in-process hooks (the K8s mutating →
@@ -616,6 +685,7 @@ class FakeApiServer:
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
         with self._lock:
+            self._check_available()
             obj = self._objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
@@ -628,6 +698,7 @@ class FakeApiServer:
         label_selector: dict[str, str] | None = None,
     ) -> list[Resource]:
         with self._lock:
+            self._check_available()
             out = []
             for (k, ns, _), obj in sorted(self._objects.items()):
                 if k != kind:
@@ -643,6 +714,7 @@ class FakeApiServer:
 
     def _update(self, obj: Resource, *, status_only: bool) -> Resource:
         with self._lock:
+            self._check_available()
             key = obj.key
             current = self._objects.get(key)
             if current is None:
@@ -683,6 +755,10 @@ class FakeApiServer:
         return out
 
     def update(self, obj: Resource) -> Resource:
+        # Fast-fail precheck (authoritative re-check is in _emit, under
+        # the lock): a fail-stopped store must not keep firing webhook
+        # HTTP callouts for writes that can never commit.
+        self._check_available()
         # Same two-phase admission as create: webhooks off-lock first.
         obj = self._webhook_admit(self._normalize_version(obj), "UPDATE")
         with self._lock:  # in-process admission atomic with the write
@@ -693,6 +769,7 @@ class FakeApiServer:
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         with self._lock:
+            self._check_available()
             key = (kind, namespace, name)
             obj = self._objects.get(key)
             if obj is None:

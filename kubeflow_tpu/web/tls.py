@@ -61,6 +61,19 @@ def _expiring_soon(cert_path: str, margin_days: float = 30.0) -> bool:
     )
 
 
+def read_hosts_marker(directory: str) -> tuple[str, ...]:
+    """The host set the directory's cert was minted for, or () when the
+    dir has no minted cert yet. Lets callers that auto-detect hosts keep
+    a durable restart's SANs stable (re-probing a changed DHCP lease
+    would silently re-mint the CA and break every pinned client)."""
+    try:
+        with open(os.path.join(directory, "hosts")) as f:
+            line = f.read().strip()
+    except (FileNotFoundError, NotADirectoryError):
+        return ()
+    return tuple(h for h in line.split(",") if h)
+
+
 def ensure_tls_dir(
     directory: str, hosts: tuple[str, ...] = ("localhost", "127.0.0.1")
 ) -> TlsPaths:
@@ -83,12 +96,11 @@ def ensure_tls_dir(
             os.path.exists(p)
             for p in (paths.ca_cert, paths.server_cert, paths.server_key)
         ):
-            try:
-                with open(hosts_marker) as f:
-                    prior = f.read().strip()
-            except FileNotFoundError:
-                prior = ""
-            if prior == hosts_line and not _expiring_soon(
+            prior = read_hosts_marker(directory)
+            # Set comparison: callers merge prior + flag-supplied names
+            # in varying orders; a reordering is not a reason to re-mint
+            # the CA and break pinned clients.
+            if set(prior) == set(hosts) and not _expiring_soon(
                 paths.server_cert
             ):
                 # Durable restart: same CA, clients stay pinned.
@@ -175,11 +187,27 @@ def server_context(paths: TlsPaths) -> ssl.SSLContext:
     return ctx
 
 
+def is_pem_data(value: str) -> bool:
+    """True when `value` is inline PEM material rather than a file path.
+    The single shared sniff — webhook config building, store-side
+    caBundle validation, and client_context all route through it so the
+    heuristic can never drift between the three."""
+    return "-----BEGIN" in value
+
+
 def client_context(ca_cert: str) -> ssl.SSLContext:
-    """Verify the server against the pinned platform CA only."""
+    """Verify the server against the pinned platform CA only.
+
+    `ca_cert` is either inline PEM data (`is_pem_data` — the K8s
+    `caBundle` form, self-contained and safe to ship in a CR created by
+    a remote client) or a local file path (the legacy/local convenience
+    form; only meaningful when caller and CA file share a filesystem)."""
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     ctx.minimum_version = ssl.TLSVersion.TLSv1_2
     ctx.verify_mode = ssl.CERT_REQUIRED
     ctx.check_hostname = True
-    ctx.load_verify_locations(cafile=ca_cert)
+    if is_pem_data(ca_cert):
+        ctx.load_verify_locations(cadata=ca_cert)
+    else:
+        ctx.load_verify_locations(cafile=ca_cert)
     return ctx

@@ -242,6 +242,10 @@ class App:
             return error_response(409, str(e))
         except storage.Invalid as e:
             return error_response(422, str(e))
+        except storage.Unavailable as e:
+            # Fail-stopped durable store (WAL write failed): etcd-down
+            # semantics, clients should back off/retry elsewhere.
+            return error_response(503, str(e))
         except Exception as e:  # crud_backend's catch-all 500 handler
             log.error("%s: unhandled error: %s", self.name, e)
             log.debug("%s", traceback.format_exc())

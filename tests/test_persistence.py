@@ -248,3 +248,69 @@ def test_acked_write_after_torn_tail_survives_next_restart(
 
     api3 = _server(tmp_path, backend)
     assert {r.metadata.name for r in api3.list("ConfigMap")} == {"a", "c"}
+
+
+def test_wal_failure_fail_stops_the_store(tmp_path, backend):
+    """ADVICE r4: a WAL append that raises must never leave the mutation
+    observable — the client got an error, so the write must not be
+    visible now (divergence from the log) nor vanish-later (a restart
+    dropping state a reader already saw). The store fail-stops: every
+    subsequent op raises Unavailable, and close() must NOT snapshot the
+    divergent in-memory state over the intact log."""
+    from kubeflow_tpu.testing.fake_apiserver import Unavailable
+
+    api = _server(tmp_path, backend)
+    api.create(new_resource("ConfigMap", "good", spec={"k": "v"}))
+
+    class _Boom(RuntimeError):
+        pass
+
+    real_wal = api._wal
+
+    class _BrokenWal:
+        def append(self, line):
+            raise _Boom("disk full")
+
+        def snapshot(self, text):
+            raise _Boom("disk full")
+
+        def close(self):
+            real_wal.close()
+
+    api._wal = _BrokenWal()
+    with pytest.raises(Unavailable):
+        api.create(new_resource("ConfigMap", "lost", spec={"k": "v"}))
+    # Errored write is unobservable: reads refuse rather than serve the
+    # diverged map.
+    for op in (
+        lambda: api.get("ConfigMap", "lost"),
+        lambda: api.get("ConfigMap", "good"),
+        lambda: api.list("ConfigMap"),
+        lambda: api.create(new_resource("ConfigMap", "later")),
+        lambda: api.delete("ConfigMap", "good"),
+    ):
+        with pytest.raises(Unavailable):
+            op()
+    # close() must not legitimize the divergence via a snapshot.
+    api.close()
+    reopened = _server(tmp_path, backend)
+    assert reopened.get("ConfigMap", "good").spec["k"] == "v"
+    with pytest.raises(NotFound):
+        reopened.get("ConfigMap", "lost")
+    reopened.close()
+
+
+def test_writer_racing_a_fail_stop_cannot_commit_unlogged(tmp_path, backend):
+    """A writer that passed create()'s unlocked precheck before another
+    thread fail-stopped must still error (not journal/deliver an event
+    that was never WAL'd): _emit re-checks under the lock."""
+    from kubeflow_tpu.testing.fake_apiserver import Unavailable
+
+    api = _server(tmp_path, backend)
+    api._broken = RuntimeError("disk full")  # as _fail_stop leaves it
+    api._wal.close()
+    api._wal = None
+    with api._lock:
+        with pytest.raises(Unavailable):
+            # Direct _emit: the state a post-precheck writer reaches.
+            api._emit("ADDED", new_resource("ConfigMap", "racy"))

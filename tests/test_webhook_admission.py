@@ -378,3 +378,39 @@ def test_namespace_and_object_selectors_scope_callouts(tls_paths):
         assert "env" not in wrong_labels.spec["containers"][0]
     finally:
         server.shutdown()
+
+
+def test_webhook_config_embeds_inline_pem(tls_paths):
+    """ADVICE r4: caBundle must be self-contained PEM (the K8s caBundle
+    form) — a path in the CR would make the apiserver open arbitrary
+    local files chosen by whoever can create webhookconfigurations, and
+    would break remote clients whose CA path doesn't exist server-side.
+    make_webhook_config inlines a readable path at build time; the store
+    verifies the callout against that embedded PEM."""
+    api = FakeApiServer()
+    server, cfg = _webhook(tls_paths)
+    try:
+        assert "-----BEGIN CERTIFICATE-----" in cfg.spec["caBundle"]
+        api.create(cfg)
+        created = api.create(_pod())
+        env = created.spec["containers"][0]["env"]
+        assert {"name": "INJECTED", "value": "CREATE"} in env
+    finally:
+        server.shutdown()
+
+
+def test_webhook_config_with_path_cabundle_is_rejected(tls_paths):
+    """The STORE enforces inline PEM: a path-form caBundle posted
+    directly (bypassing make_webhook_config) would otherwise make the
+    apiserver open an attacker-chosen local file on every callout."""
+    api = FakeApiServer()
+    cfg = make_webhook_config(
+        "path-webhook", "https://127.0.0.1:1/mutate", tls_paths.ca_cert
+    )
+    cfg.spec["caBundle"] = tls_paths.ca_cert  # raw path, as a raw POST
+    with pytest.raises(Invalid, match="inline PEM"):
+        api.create(cfg)
+    with pytest.raises(ValueError, match="neither PEM"):
+        make_webhook_config(
+            "typo-webhook", "https://127.0.0.1:1/mutate", "/nope/ca.crt"
+        )
