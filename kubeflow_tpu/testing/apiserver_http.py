@@ -34,9 +34,7 @@ separate process against this facade.
 from __future__ import annotations
 
 import json
-import urllib.error
 import urllib.parse
-import urllib.request
 
 import logging
 import threading
@@ -49,6 +47,7 @@ from kubeflow_tpu.api.tokens import TokenRegistry
 from kubeflow_tpu.utils import tracing
 from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
+    ApiError,
     Conflict,
     FakeApiServer,
     Forbidden,
@@ -60,7 +59,14 @@ from kubeflow_tpu.testing.fake_apiserver import (
 )
 
 log = logging.getLogger(__name__)
-from kubeflow_tpu.web.wsgi import App, HttpError, Request, Response, json_response
+from kubeflow_tpu.web.wsgi import (
+    App,
+    HttpError,
+    Request,
+    Response,
+    StreamResponse,
+    json_response,
+)
 
 
 def _ns_seg(namespace: str) -> str:
@@ -168,6 +174,24 @@ class ApiServerApp(App):
                 f"{scope}",
             )
 
+    def _lease_guard(self, req: Request):
+        """Optional write fencing: a leader-elected client arms its
+        lease guard and every write carries it in this header; the store
+        verifies holder+generation atomically with the commit
+        (`fake_apiserver._check_lease_guard`). Correctness fencing
+        against deposed leaders, not an authz boundary — RBAC already
+        gated the write above."""
+        raw = req.headers.get("x-kftpu-lease-guard")
+        if not raw:
+            return None
+        try:
+            ns, name, holder, transitions = json.loads(raw)
+            return (str(ns), str(name), str(holder), int(transitions))
+        except (ValueError, TypeError) as e:
+            raise HttpError(
+                400, f"malformed X-Kftpu-Lease-Guard header: {e}"
+            )
+
     def _may_watch(self, user: str, obj: Resource, cache: dict) -> bool:
         """Per-event watch filter for the multiplexed `_` stream: deliver
         only objects whose (kind, namespace) the identity may watch, so a
@@ -231,15 +255,26 @@ class ApiServerApp(App):
         )
 
     def _watch(self, req: Request) -> Response:
-        """Long-poll watch: block until events land past the bookmark (or
-        timeoutSeconds), return them with the rv to resume from. `_` as
-        the kind watches everything (the client multiplexes one stream
-        across all its registered handlers)."""
+        """Watch transport, two forms.
+
+        Long-poll (default): block until events land past the bookmark
+        (or timeoutSeconds), return them with the rv to resume from.
+        `_` as the kind watches everything (the client multiplexes one
+        stream across all its registered handlers).
+
+        Streaming (`stream=true`): ONE chunked HTTP response held open
+        across events — each line is a JSON event, with BOOKMARK lines
+        marking quiet progress (heartbeat + rv advance) and an ERROR
+        line carrying the would-be HTTP status (410 journal horizon,
+        503 fail-stop) before the stream ends. This is the client-go
+        informer transport (`notebook_controller.go:516` watches ride
+        one shared connection): event latency is delivery latency, not
+        poll cadence, and a keep-alive client re-uses the connection's
+        single TLS handshake for the whole stream."""
         try:
             since = int(req.query.get("resourceVersion", "0"))
         except ValueError:
             raise HttpError(400, "resourceVersion must be an integer")
-        timeout = min(float(req.query.get("timeoutSeconds", "10")), 60.0)
         kind = req.path_params["kind"]
         namespace = req.query.get("namespace")
         if kind != "_":
@@ -251,6 +286,9 @@ class ApiServerApp(App):
                 resource_for_kind(kind),
                 _seg_ns(namespace) if namespace is not None else "",
             )
+        if req.query.get("stream") in ("true", "1"):
+            return self._watch_stream(req, since, kind, namespace)
+        timeout = min(float(req.query.get("timeoutSeconds", "10")), 60.0)
         try:
             events, rv = self.api.wait_events(
                 since,
@@ -260,13 +298,7 @@ class ApiServerApp(App):
             )
         except Gone as e:
             raise HttpError(410, str(e))
-        if self.tokens is not None and kind == "_":
-            cache: dict = {}
-            events = [
-                (ev_rv, ev, obj)
-                for ev_rv, ev, obj in events
-                if self._may_watch(req.user, obj, cache)
-            ]
+        events = self._filter_watchable(req, kind, events)
         return json_response(
             {
                 "events": [
@@ -276,6 +308,84 @@ class ApiServerApp(App):
                 "resourceVersion": rv,
             }
         )
+
+    def _filter_watchable(self, req: Request, kind: str, events):
+        """Per-event SAR filter for the multiplexed `_` stream."""
+        if self.tokens is None or kind != "_":
+            return events
+        cache: dict = {}
+        return [
+            (ev_rv, ev, obj)
+            for ev_rv, ev, obj in events
+            if self._may_watch(req.user, obj, cache)
+        ]
+
+    # How long one streaming response lives before the server ends it
+    # cleanly (the kube-apiserver min-request-timeout analog): bounds a
+    # dead client's grip on its thread; a live client just re-opens on
+    # its pooled (already-handshaken) connection.
+    STREAM_DURATION = 240.0
+    # Bookmark cadence: each quiet slice emits a BOOKMARK line, serving
+    # as heartbeat (the peer detects a dead server in seconds) and rv
+    # advance (a resume after disconnect skips the drained history).
+    STREAM_SLICE = 5.0
+
+    def _watch_stream(
+        self, req: Request, since: int, kind: str, namespace: str | None
+    ) -> StreamResponse:
+        import json as _json
+
+        duration = min(
+            float(req.query.get("timeoutSeconds", self.STREAM_DURATION)),
+            3600.0,
+        )
+
+        def line(payload: dict) -> bytes:
+            return _json.dumps(payload, separators=(",", ":")).encode() + b"\n"
+
+        def gen():
+            # Exceptions here happen AFTER App.handle returned (the
+            # handler thread is mid-chunked-response), so the error
+            # mapping rides the stream as an ERROR line instead of an
+            # HTTP status.
+            import time as _time
+
+            rv = since
+            deadline = _time.monotonic() + duration
+            while True:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return  # clean end; client resumes from its rv
+                try:
+                    events, new_rv = self.api.wait_events(
+                        rv,
+                        kind=None if kind == "_" else kind,
+                        namespace=(
+                            _seg_ns(namespace) if namespace is not None
+                            else None
+                        ),
+                        timeout=min(self.STREAM_SLICE, remaining),
+                    )
+                except Gone as e:
+                    yield line(
+                        {"type": "ERROR", "status": 410, "message": str(e)}
+                    )
+                    return
+                except Exception as e:  # Unavailable, shutdown races
+                    yield line(
+                        {"type": "ERROR", "status": 503, "message": str(e)}
+                    )
+                    return
+                for ev_rv, ev, obj in self._filter_watchable(
+                    req, kind, events
+                ):
+                    yield line(
+                        {"type": ev, "rv": ev_rv, "object": obj.to_dict()}
+                    )
+                rv = new_rv
+                yield line({"type": "BOOKMARK", "resourceVersion": rv})
+
+        return StreamResponse(gen(), content_type="application/json")
 
     def _at_version(self, obj: Resource, req: Request) -> Resource:
         version = req.query.get("version")
@@ -326,9 +436,18 @@ class ApiServerApp(App):
             # Server-side apply: create-or-update with the store's own
             # no-op detection (post-admission, post-conversion compare) so
             # remote reconcilers don't re-trigger their own watches.
-            return json_response(self.api.apply(obj).to_dict())
+            return json_response(
+                self.api.apply(
+                    obj, lease_guard=self._lease_guard(req)
+                ).to_dict()
+            )
         self._authorize(req, "create", resource, namespace)
-        return json_response(self.api.create(obj).to_dict(), status=201)
+        return json_response(
+            self.api.create(
+                obj, lease_guard=self._lease_guard(req)
+            ).to_dict(),
+            status=201,
+        )
 
     def _body_matching_path(self, req: Request) -> Resource:
         """The path is authoritative: a body naming a different object than
@@ -350,7 +469,10 @@ class ApiServerApp(App):
             _seg_ns(req.path_params["ns"]),
         )
         return json_response(
-            self.api.update(self._body_matching_path(req)).to_dict()
+            self.api.update(
+                self._body_matching_path(req),
+                lease_guard=self._lease_guard(req),
+            ).to_dict()
         )
 
     def update_status(self, req: Request) -> Response:
@@ -365,7 +487,10 @@ class ApiServerApp(App):
             _seg_ns(req.path_params["ns"]),
         )
         return json_response(
-            self.api.update_status(self._body_matching_path(req)).to_dict()
+            self.api.update_status(
+                self._body_matching_path(req),
+                lease_guard=self._lease_guard(req),
+            ).to_dict()
         )
 
     def delete(self, req: Request) -> Response:
@@ -379,6 +504,7 @@ class ApiServerApp(App):
             req.path_params["kind"],
             req.path_params["name"],
             _seg_ns(req.path_params["ns"]),
+            lease_guard=self._lease_guard(req),
         )
         return json_response({"deleted": True})
 
@@ -493,44 +619,171 @@ class HttpApiClient:
         self._watch_lock = threading.Lock()
         self._watch_thread: threading.Thread | None = None
         self._closed = threading.Event()
+        # Persistent-connection pool (the client-go shared-transport
+        # analog): requests ride keep-alive connections, so a client
+        # pays O(1) TCP+TLS handshakes for its whole request train
+        # instead of one per request. `handshakes` counts connections
+        # dialed — the load test pins it flat while requests grow.
+        parts = urllib.parse.urlsplit(self.base_url)
+        self._conn_host = parts.hostname or "127.0.0.1"
+        self._conn_port = parts.port or (
+            443 if parts.scheme == "https" else 80
+        )
+        self._conn_https = parts.scheme == "https"
+        self._pool: list = []
+        self._pool_lock = threading.Lock()
+        self.handshakes = 0
+        # Leader-election write fencing: when armed (set_lease_guard),
+        # every write carries the guard and the server rejects it with
+        # Conflict unless the lease still shows this holder+generation.
+        self.lease_guard: tuple[str, str, str, int] | None = None
 
-    def _call(self, method: str, path: str, body: dict | None = None) -> dict:
-        req = urllib.request.Request(
-            self.base_url + path,
-            method=method,
-            data=json.dumps(body).encode() if body is not None else None,
+    def set_lease_guard(
+        self, guard: tuple[str, str, str, int] | None
+    ) -> None:
+        """Arm (or disarm with None) the lease guard on all writes. Pass
+        `LeaderElector.guard` after acquiring leadership — from then on a
+        partition that deposes this leader turns its in-flight writes
+        into Conflicts instead of corruption of the successor's term."""
+        self.lease_guard = guard
+
+    # How many idle connections to keep (a controller process typically
+    # runs one watch stream + a few concurrent reconcile threads).
+    POOL_SIZE = 4
+
+    def _new_conn(self):
+        import http.client as _hc
+
+        if self._conn_https:
+            conn = _hc.HTTPSConnection(
+                self._conn_host,
+                self._conn_port,
+                timeout=self.timeout,
+                context=self._ssl,
+            )
+        else:
+            conn = _hc.HTTPConnection(
+                self._conn_host, self._conn_port, timeout=self.timeout
+            )
+        conn._kftpu_reused = False
+        with self._pool_lock:
+            self.handshakes += 1
+        return conn
+
+    # Discard pooled connections idle longer than this (below the
+    # server's 75 s keep-alive reap, so the client almost never races a
+    # server-side close — the stale-connection window that would
+    # otherwise force ambiguous write retries).
+    POOL_IDLE_MAX = 60.0
+
+    def _get_conn(self):
+        import time as _time
+
+        now = _time.monotonic()
+        with self._pool_lock:
+            while self._pool:
+                conn = self._pool.pop()
+                if now - getattr(conn, "_kftpu_idle_since", now) \
+                        <= self.POOL_IDLE_MAX:
+                    return conn
+                conn.close()  # probably server-reaped already
+        return self._new_conn()
+
+    def _put_conn(self, conn) -> None:
+        import time as _time
+
+        conn._kftpu_reused = True
+        conn._kftpu_idle_since = _time.monotonic()
+        # Restore the default op timeout (a stream may have raised it).
+        if conn.sock is not None:
+            conn.sock.settimeout(self.timeout)
+        with self._pool_lock:
+            if len(self._pool) < self.POOL_SIZE:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def _request_raw(
+        self, method: str, path: str, body: dict | None = None
+    ):
+        """One round trip on a pooled connection; returns (conn, resp)
+        with the response UNREAD (callers stream or slurp).
+
+        Retry policy (the urllib3 rule): only IDEMPOTENT-safe requests
+        (GET) auto-retry when a REUSED connection dies — for a write,
+        the failure is ambiguous (the server may have committed before
+        the connection broke) and a blind replay could double-apply, so
+        writes propagate the error and the caller's level-triggered
+        retry re-reads state first. The stale-connection window writes
+        would otherwise hit is mostly closed by POOL_IDLE_MAX reaping
+        pooled connections before the server's keep-alive timeout can.
+        A fresh-connection failure is real and always propagates."""
+        import http.client as _hc
+
+        data = json.dumps(body).encode() if body is not None else None
+        headers = {
+            "Content-Type": "application/json",
             # An active span's trace id rides along, so a reconcile's
             # apiserver calls land in the same trace (`utils.tracing`).
-            headers={
-                "Content-Type": "application/json",
-                **self._auth_header(),
-                **tracing.trace_header(),
-            },
-        )
+            **self._auth_header(),
+            **tracing.trace_header(),
+        }
+        guard = self.lease_guard
+        if guard is not None and method in ("POST", "PUT", "DELETE", "PATCH"):
+            headers["X-Kftpu-Lease-Guard"] = json.dumps(list(guard))
+        while True:
+            conn = self._get_conn()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+            except (_hc.HTTPException, OSError):
+                reused = getattr(conn, "_kftpu_reused", False)
+                conn.close()
+                if reused and method == "GET":
+                    continue  # stale keep-alive victim: one fresh retry
+                raise
+            return conn, resp
+
+    def _finish(self, conn, resp) -> bytes:
+        """Slurp the body and recycle (or retire) the connection."""
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl
-            ) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
-            if e.code in (401, 403):
-                raise Forbidden(detail)
-            if e.code == 404:
-                raise NotFound(detail)
-            if e.code == 409:
-                # The server folds AlreadyExists and Conflict onto 409;
-                # disambiguate from the message.
-                if "already exists" in detail:
-                    raise AlreadyExists(detail)
-                raise Conflict(detail)
-            if e.code == 410:
-                raise Gone(detail)
-            if e.code == 422:
-                raise Invalid(detail)
-            if e.code == 503:
-                raise Unavailable(detail)
+            data = resp.read()
+        except Exception:
+            conn.close()
             raise
+        if resp.will_close:
+            conn.close()
+        else:
+            self._put_conn(conn)
+        return data
+
+    @staticmethod
+    def _raise_for_status(status: int, detail: str):
+        if status in (401, 403):
+            raise Forbidden(detail)
+        if status == 404:
+            raise NotFound(detail)
+        if status == 409:
+            # The server folds AlreadyExists and Conflict onto 409;
+            # disambiguate from the message.
+            if "already exists" in detail:
+                raise AlreadyExists(detail)
+            raise Conflict(detail)
+        if status == 410:
+            raise Gone(detail)
+        if status == 422:
+            raise Invalid(detail)
+        if status == 503:
+            raise Unavailable(detail)
+        raise ApiError(f"HTTP {status}: {detail}")
+
+    def _call(self, method: str, path: str, body: dict | None = None) -> dict:
+        conn, resp = self._request_raw(method, path, body)
+        status = resp.status
+        data = self._finish(conn, resp)
+        if status >= 400:
+            self._raise_for_status(status, data.decode(errors="replace"))
+        return json.loads(data)
 
     def get(
         self,
@@ -593,28 +846,21 @@ class HttpApiClient:
         self._call("DELETE", f"/apis/{kind}/{_ns_seg(namespace)}/{name}")
 
     def pod_log(self, name: str, namespace: str = "default") -> str:
-        """The pod's captured stdout (raw text; same tracing header and
+        """The pod's captured stdout (raw text; same pooled transport and
         error mapping as every other call)."""
-        req = urllib.request.Request(
-            f"{self.base_url}/apis/Pod/{_ns_seg(namespace)}/{name}/log",
-            headers={**self._auth_header(), **tracing.trace_header()},
+        conn, resp = self._request_raw(
+            "GET", f"/apis/Pod/{_ns_seg(namespace)}/{name}/log"
         )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl
-            ) as resp:
-                return resp.read().decode(errors="replace")
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
+        status = resp.status
+        data = self._finish(conn, resp)
+        if status >= 400:
+            detail = data.decode(errors="replace")
             try:
                 detail = json.loads(detail).get("log", detail)
             except ValueError:
                 pass
-            if e.code in (401, 403):
-                raise Forbidden(detail)
-            if e.code == 404:
-                raise NotFound(detail)
-            raise
+            self._raise_for_status(status, detail)
+        return data.decode(errors="replace")
 
     def _auth_header(self) -> dict[str, str]:
         return (
@@ -695,6 +941,10 @@ class HttpApiClient:
 
     def close(self) -> None:
         self._closed.set()
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            conn.close()
 
     def _dispatch(self, event: str, obj: Resource) -> None:
         for kind, handler in list(self._watchers):
@@ -724,10 +974,22 @@ class HttpApiClient:
 
     def _watch_loop(self) -> None:
         rv = None
+        # Prefer the chunked streaming watch (one held-open response,
+        # event latency = delivery latency); fall back to long-polling
+        # against servers that don't speak it. The fallback is sticky
+        # per process — a server that 400s the stream form once won't
+        # grow the capability mid-life.
+        streaming = True
         while not self._closed.is_set():
             try:
                 if rv is None:
                     rv = self._resync()
+                if streaming:
+                    try:
+                        rv = self._stream_once(rv)
+                        continue
+                    except _StreamUnsupported:
+                        streaming = False
                 params = urllib.parse.urlencode(
                     {
                         "watch": "true",
@@ -758,3 +1020,57 @@ class HttpApiClient:
             rv = data["resourceVersion"]
             for ev in data["events"]:
                 self._dispatch(ev["type"], Resource.from_dict(ev["object"]))
+
+    def _stream_once(self, rv: int) -> int:
+        """Consume one streaming watch response; returns the rv to resume
+        from after the server ends the stream cleanly (its duration cap).
+        Events dispatch as their lines arrive — no poll quantization."""
+        params = urllib.parse.urlencode(
+            {"watch": "true", "stream": "true", "resourceVersion": rv}
+        )
+        conn, resp = self._request_raw("GET", f"/apis/_?{params}")
+        if resp.status == 400:
+            self._finish(conn, resp)
+            raise _StreamUnsupported()
+        if resp.status >= 400:
+            status = resp.status
+            detail = self._finish(conn, resp).decode(errors="replace")
+            self._raise_for_status(status, detail)
+        # Reads block until the next event/bookmark line; the server
+        # bookmarks every STREAM_SLICE (5 s), so a healthy-but-quiet
+        # stream produces a line well inside this read timeout — a
+        # silent peer here is a dead one.
+        if conn.sock is not None:
+            conn.sock.settimeout(30.0)
+        try:
+            while not self._closed.is_set():
+                line = resp.readline()
+                if not line:
+                    # Clean end of stream (terminal chunk consumed): the
+                    # connection is reusable — the next stream/call rides
+                    # the same handshake.
+                    self._put_conn(conn)
+                    return rv
+                ev = json.loads(line)
+                etype = ev["type"]
+                if etype == "BOOKMARK":
+                    rv = ev["resourceVersion"]
+                elif etype == "ERROR":
+                    if ev.get("status") == 410:
+                        raise Gone(ev.get("message", "watch horizon"))
+                    raise ApiError(
+                        f"watch stream error {ev.get('status')}: "
+                        f"{ev.get('message', '')}"
+                    )
+                else:
+                    self._dispatch(etype, Resource.from_dict(ev["object"]))
+                    rv = ev["rv"]
+            conn.close()  # closed mid-stream: response state unusable
+            return rv
+        except BaseException:
+            conn.close()
+            raise
+
+
+class _StreamUnsupported(Exception):
+    """Server rejected `stream=true` (400): stick to long-polling."""

@@ -4,14 +4,17 @@ Plays the role Flask plays for the reference's web backends
 (`crud_backend/serving.py`, `base_app.py:22-175`): path-parameter routing,
 before-request hooks (authn slots in here), JSON request/response helpers,
 and a uniform error surface that maps storage errors onto HTTP statuses.
-Runs under any WSGI server; `serve()` uses the stdlib threading server and
-`TestClient` drives the app in-process for tests (the reference tests its
-Flask apps the same way, via `app.test_client()`).
+`serve()` hosts apps on an HTTP/1.1 threading server with persistent
+connections and chunked streaming responses (the WSGI `__call__` remains
+for external hosts); `TestClient` drives the app in-process for tests
+(the reference tests its Flask apps the same way, via
+`app.test_client()`).
 """
 
 from __future__ import annotations
 
 import http.client
+import http.server
 import json
 import logging
 import mimetypes
@@ -22,7 +25,6 @@ import traceback
 from typing import Any, Callable
 from urllib.parse import parse_qs
 import socketserver
-from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from kubeflow_tpu.testing import fake_apiserver as storage
 from kubeflow_tpu.utils import tracing
@@ -106,6 +108,25 @@ class Response:
 
     def json(self) -> dict:
         return json.loads(self.body)
+
+
+class StreamResponse(Response):
+    """A response whose body is produced incrementally (chunked transfer
+    on the wire). `chunks` is an iterable of bytes; each chunk is framed
+    and flushed as soon as it is produced, so a handler can hold the
+    connection open and push events as they happen — the transport under
+    the streaming watch (client-go's chunked watch stream analog)."""
+
+    def __init__(
+        self,
+        chunks,
+        status: int = 200,
+        content_type: str = "application/json",
+        headers: list[tuple[str, str]] | None = None,
+    ):
+        super().__init__(b"", status=status, content_type=content_type,
+                         headers=headers)
+        self.chunks = chunks
 
 
 def json_response(payload: Any, status: int = 200) -> Response:
@@ -278,28 +299,168 @@ class App:
 
     # -- WSGI --------------------------------------------------------------
 
-    def __call__(self, environ: dict, start_response) -> list[bytes]:
+    def __call__(self, environ: dict, start_response):
+        # WSGI compatibility shim (serve() speaks HTTP/1.1 directly; this
+        # lets the same App run under any external WSGI host).
         resp = self.handle(Request(environ))
         start_response(resp.status_line, resp.headers)
+        if isinstance(resp, StreamResponse):
+            return resp.chunks
         return [resp.body]
 
 
-class _QuietHandler(WSGIRequestHandler):
-    def log_message(self, format, *args):  # noqa: A002 - WSGI signature
+class _Http11Handler(http.server.BaseHTTPRequestHandler):
+    """HTTP/1.1 handler with persistent connections.
+
+    The previous server was wsgiref's (HTTP/1.0, one request per
+    connection), which made every control-plane call — and with the TLS
+    facade, every watch poll of every client — pay a fresh TCP + TLS
+    handshake. Reference controllers hold ONE connection through
+    client-go's shared transport (`notebook_controller.go:516` manager);
+    this handler gives our clients the same: the per-CONNECTION thread
+    loops on `handle_one_request` until the peer closes or idles out."""
+
+    protocol_version = "HTTP/1.1"
+    # One knob, two jobs: reaps idle keep-alive connections (the blocking
+    # readline for the next request times out) and caps a stalled
+    # client's grip on its thread. Streaming responses emit bookmarks
+    # far more often than this, so healthy streams never trip it.
+    timeout = 75.0
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
         log.debug("%s %s", self.address_string(), format % args)
 
+    def _environ(self) -> dict:
+        import io
+        import urllib.parse as _up
 
-class _ThreadingWSGIServer(socketserver.ThreadingMixIn, WSGIServer):
+        path, _, query = self.path.partition("?")
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        body = self.rfile.read(length) if length > 0 else b""
+        environ = {
+            "REQUEST_METHOD": self.command,
+            "PATH_INFO": _up.unquote(path),
+            "QUERY_STRING": query,
+            "CONTENT_LENGTH": str(len(body)),
+            "wsgi.input": io.BytesIO(body),
+            "REMOTE_ADDR": self.client_address[0],
+        }
+        for key, value in self.headers.items():
+            if key.lower() == "content-type":
+                environ["CONTENT_TYPE"] = value
+            else:
+                environ["HTTP_" + key.upper().replace("-", "_")] = value
+        return environ
+
+    def _handle(self) -> None:
+        if "chunked" in self.headers.get("Transfer-Encoding", "").lower():
+            # Bodies are framed by Content-Length only. Silently ignoring
+            # a chunked body would leave its bytes on the persistent
+            # connection to be parsed as the NEXT request — the classic
+            # desync/smuggling shape keep-alive makes possible (the old
+            # per-request server was immune by closing). Refuse it and
+            # drop the connection so the unread framing dies with it.
+            self.send_response(501)
+            self.send_header("Content-Length", "0")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            return
+        server = self.server
+        with server.counter_lock:
+            server.requests_served += 1
+        resp = server.app.handle(Request(self._environ()))
+        try:
+            if isinstance(resp, StreamResponse):
+                self._send_stream(resp)
+            else:
+                self._send(resp)
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            # Peer went away mid-response; nothing to salvage.
+            self.close_connection = True
+
+    def _send(self, resp: Response) -> None:
+        self.send_response(resp.status)
+        body = resp.body
+        for key, value in resp.headers:
+            self.send_header(key, value)
+        # Content-Length is what keeps the connection reusable: without
+        # it an HTTP/1.1 peer can only detect end-of-body by close.
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _send_stream(self, resp: StreamResponse) -> None:
+        """Chunked transfer: each produced chunk is framed and flushed as
+        it arrives (the watch stream's transport). Chunked framing is
+        self-delimiting, so the connection stays reusable after the
+        terminal 0-chunk."""
+        self.send_response(resp.status)
+        for key, value in resp.headers:
+            self.send_header(key, value)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for chunk in resp.chunks:
+                if not chunk:
+                    continue
+                self.wfile.write(
+                    f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                )
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        finally:
+            close = getattr(resp.chunks, "close", None)
+            if close is not None:
+                close()  # generator cleanup runs even on client abort
+
+    do_GET = _handle
+    do_POST = _handle
+    do_PUT = _handle
+    do_DELETE = _handle
+    do_PATCH = _handle
+    # HEAD/OPTIONS route through the app like every other method (the
+    # old WSGI server did the same: routes that don't declare them
+    # answer 405, not a transport-level 501). HEAD responses carry the
+    # headers + Content-Length with the body suppressed (see _send).
+    do_HEAD = _handle
+    do_OPTIONS = _handle
+
+    def handle_one_request(self):
+        try:
+            super().handle_one_request()
+        except (ConnectionResetError, BrokenPipeError, TimeoutError):
+            # Idle keep-alive reap / mid-request disconnects are routine.
+            self.close_connection = True
+
+
+class _HttpServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    """Threading server, one thread per CONNECTION (not per request —
+    keep-alive means a thread serves its peer's whole request train)."""
+
     daemon_threads = True
 
+    def __init__(self, addr, handler, app: App):
+        self.app = app
+        # Observability for the O(1)-handshakes property: the load test
+        # asserts tls_handshakes stays flat while requests_served grows.
+        self.tls_handshakes = 0
+        self.requests_served = 0
+        self.counter_lock = threading.Lock()
+        super().__init__(addr, handler)
 
-class _TlsThreadingWSGIServer(_ThreadingWSGIServer):
-    """TLS server whose handshake runs in the per-request thread, not the
-    accept loop: wrap_socket here defers the handshake
+
+class _TlsHttpServer(_HttpServer):
+    """TLS server whose handshake runs in the per-connection thread, not
+    the accept loop: wrap_socket here defers the handshake
     (do_handshake_on_connect=False; it happens transparently on the
     handler's first read) — otherwise one stalled client parks accept()
     and blocks every request including /healthz, the exact failure the
-    per-request-thread design exists to prevent."""
+    per-connection-thread design exists to prevent."""
 
     ssl_context = None
 
@@ -308,6 +469,10 @@ class _TlsThreadingWSGIServer(_ThreadingWSGIServer):
         conn = self.ssl_context.wrap_socket(
             conn, server_side=True, do_handshake_on_connect=False
         )
+        # One wrapped connection = one handshake (keep-alive then
+        # amortizes it over every request the peer sends on it).
+        with self.counter_lock:
+            self.tls_handshakes += 1
         return conn, addr
 
     def handle_error(self, request, client_address):
@@ -320,25 +485,20 @@ class _TlsThreadingWSGIServer(_ThreadingWSGIServer):
 def serve(app: App, host: str = "0.0.0.0", port: int = 8080, tls=None):
     """Serve on a background thread; returns (server, thread).
 
-    Connections are handled on per-request threads so a stalled client
-    can't block /healthz probes. `server.server_port` gives the bound
-    port (use port=0 in tests).
+    HTTP/1.1 with keep-alive: a client holding its connection pays one
+    TCP (and TLS) handshake for its whole request train. Connections are
+    handled on per-connection threads so a stalled client can't block
+    /healthz probes. `server.server_port` gives the bound port (use
+    port=0 in tests).
 
     `tls` (a `web.tls.TlsPaths`) serves HTTPS: each accepted connection
-    is wrapped server-side (handshake in the request thread), so a
+    is wrapped server-side (handshake in the connection thread), so a
     plaintext client gets a handshake error — never a served request.
     The secure facade always passes this (bearer tokens must not ride
     cleartext; the reference's only custom listener is TLS-only,
     `admission-webhook/main.go:443`)."""
-    server = make_server(
-        host,
-        port,
-        app,
-        server_class=(
-            _ThreadingWSGIServer if tls is None else _TlsThreadingWSGIServer
-        ),
-        handler_class=_QuietHandler,
-    )
+    server_class = _HttpServer if tls is None else _TlsHttpServer
+    server = server_class((host, port), _Http11Handler, app)
     if tls is not None:
         from kubeflow_tpu.web import tls as tlsmod
 
@@ -348,8 +508,8 @@ def serve(app: App, host: str = "0.0.0.0", port: int = 8080, tls=None):
     # the server stops — exactly the e2e shutdown sequence), and a
     # blocking accept on a drained queue then parks the serve loop
     # FOREVER — shutdown() never returns. A listener timeout turns that
-    # into a retried OSError; accepted connections stay blocking (the
-    # accepted socket does not inherit the listener's timeout).
+    # into a retried OSError; accepted connections get their own
+    # (handler-level) timeout instead of inheriting the listener's.
     server.socket.settimeout(5.0)
     thread = threading.Thread(
         target=server.serve_forever, name=f"{app.name}-http", daemon=True

@@ -14,6 +14,7 @@ off-lock dispatcher exists for:
   (resumable-journal semantics hold under concurrency).
 """
 
+import os
 import threading
 import time
 
@@ -217,3 +218,55 @@ def test_durable_facade_write_latency_bounded(tmp_path):
     api.close()
     restored = FakeApiServer(persist_dir=str(tmp_path / "state"))
     assert len(restored.list("DurObj")) == WRITERS * OBJECTS_PER_WRITER
+
+
+def test_tls_handshakes_o1_per_client_under_load(tls_paths):
+    """Round-5 transport property: keep-alive means handshakes scale
+    with CLIENTS, not with requests. The TLS facade serves WRITERS
+    concurrent clients × OBJECTS_PER_WRITER writes each plus a watcher,
+    and the server-side handshake counter stays O(clients) — before
+    keep-alive this was one full TCP+TLS handshake per request and per
+    5-second watch poll."""
+    api = FakeApiServer()
+    server, _ = serve(
+        ApiServerApp(api), host="127.0.0.1", port=0, tls=tls_paths
+    )
+    base = f"https://127.0.0.1:{server.server_port}"
+    os.environ["KFTPU_CA"] = tls_paths.ca_cert
+    try:
+        watcher = HttpApiClient(base, ca=tls_paths.ca_cert)
+        seen = []
+        watcher.watch(lambda ev, obj: seen.append(obj.metadata.name),
+                      "LoadObj")
+
+        def write_one(client, w, i):
+            return (
+                lambda: client.create(
+                    new_resource("LoadObj", f"h-{w}-{i}", "load")
+                ),
+            )
+
+        _run_writers(base, write_one)
+        total_requests = WRITERS * OBJECTS_PER_WRITER
+        deadline = time.monotonic() + 30
+        while len(seen) < total_requests and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(seen) >= total_requests
+        assert server.requests_served >= total_requests
+        # O(1) per client: each writer dials ~1 connection (+1 retry
+        # margin), the watcher 1 stream + 1 CRUD conn. O(requests)
+        # would be ≥ 320 here.
+        budget = 3 * (WRITERS + 1) + 4
+        assert server.tls_handshakes <= budget, (
+            f"{server.tls_handshakes} handshakes for "
+            f"{server.requests_served} requests"
+        )
+        print(
+            f"# tls keep-alive: {server.requests_served} requests over "
+            f"{server.tls_handshakes} handshakes "
+            f"({WRITERS + 1} clients)"
+        )
+    finally:
+        os.environ.pop("KFTPU_CA", None)
+        watcher.close()
+        server.shutdown()

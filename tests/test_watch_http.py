@@ -284,3 +284,106 @@ def test_controller_runtime_over_http_client(served):
     finally:
         stop.set()
         t.join(timeout=5)
+
+
+# -- streaming watch + connection reuse (round-5 transport) -----------------
+
+
+def test_streaming_watch_raw_protocol(served):
+    """One chunked response held open across events: lines arrive as
+    events happen (ADDED mid-stream), BOOKMARK lines advance rv during
+    quiet slices, and the stream survives multiple events — the
+    client-go informer transport (`notebook_controller.go:516`)."""
+    import http.client as hc
+    import json as _json
+
+    api, client = served
+    conn = hc.HTTPConnection("127.0.0.1", client._conn_port, timeout=10)
+    conn.request(
+        "GET", "/apis/Widget?watch=true&stream=true&resourceVersion=0"
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    api.create(mk("s1"))
+    api.create(mk("s2"))
+    seen, bookmarks = [], []
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(seen) < 2:
+        line = resp.readline()
+        assert line, "stream ended prematurely"
+        ev = _json.loads(line)
+        if ev["type"] == "BOOKMARK":
+            bookmarks.append(ev["resourceVersion"])
+        else:
+            seen.append((ev["type"], ev["object"]["metadata"]["name"]))
+    assert seen == [("ADDED", "s1"), ("ADDED", "s2")]
+    conn.close()
+
+
+def test_streaming_watch_gone_rides_error_line(served):
+    """A stale bookmark on a stream can't use an HTTP status (headers
+    are already sent) — the 410 rides the stream as an ERROR line."""
+    import http.client as hc
+    import json as _json
+
+    api, client = served
+    api._journal_size = 2
+    for i in range(6):
+        api.create(mk(f"w{i}"))
+    conn = hc.HTTPConnection("127.0.0.1", client._conn_port, timeout=10)
+    conn.request(
+        "GET", "/apis/Widget?watch=true&stream=true&resourceVersion=1"
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    ev = _json.loads(resp.readline())
+    assert ev["type"] == "ERROR" and ev["status"] == 410
+    assert resp.readline() == b""  # stream ends after the error
+    conn.close()
+
+
+def test_client_reuses_connections_o1_handshakes(served):
+    """The whole point of keep-alive: N CRUD calls on one client dial
+    O(1) connections, not O(N)."""
+    api, client = served
+    for i in range(30):
+        client.create(mk(f"ka{i}"))
+        client.get("Widget", f"ka{i}")
+    assert client.handshakes <= 2, client.handshakes
+    assert api.current_rv >= 30
+
+
+def test_server_counts_tls_handshakes(tls_paths):
+    """Server-side evidence for the O(1) property over TLS: 40 requests
+    from one pinned client cost ≤2 handshakes (the load test pins the
+    same at scale)."""
+    api = FakeApiServer()
+    server, _ = serve(
+        ApiServerApp(api), host="127.0.0.1", port=0, tls=tls_paths
+    )
+    client = HttpApiClient(
+        f"https://127.0.0.1:{server.server_port}", ca=tls_paths.ca_cert
+    )
+    try:
+        for i in range(40):
+            client.create(mk(f"t{i}"))
+        assert server.requests_served >= 40
+        assert server.tls_handshakes <= 2, server.tls_handshakes
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_stream_events_not_quantized_by_poll_cadence(served):
+    """With a pathological long-poll cadence (30 s), a streaming client
+    still sees events within delivery latency — event latency is no
+    longer coupled to watch_poll_timeout."""
+    api, client = served
+    client.watch_poll_timeout = 30.0  # would be the worst-case gap
+    seen = []
+    client.watch(lambda ev, obj: seen.append(obj.metadata.name), "Widget")
+    time.sleep(0.3)  # let the stream open
+    t0 = time.monotonic()
+    api.create(mk("fast"))
+    assert wait_for(lambda: "fast" in seen, timeout=5.0)
+    assert time.monotonic() - t0 < 2.0

@@ -177,3 +177,32 @@ def test_real_http_roundtrip():
             assert json.loads(resp.read())["name"] == "net"
     finally:
         server.shutdown()
+
+
+def test_chunked_request_rejected_not_desynced():
+    """Keep-alive + Content-Length-only framing: a chunked request body
+    must be refused (501) with the connection dropped — ignoring it
+    would leave the chunk framing on the socket to be parsed as the
+    NEXT request (request smuggling)."""
+    import socket
+
+    from kubeflow_tpu.web.wsgi import App, serve
+
+    app = App("chunky")
+    server, _ = serve(app, host="127.0.0.1", port=0)
+    try:
+        s = socket.create_connection(("127.0.0.1", server.server_port),
+                                     timeout=5)
+        s.sendall(
+            b"POST /healthz HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"5\r\nhello\r\n0\r\n\r\n"
+        )
+        data = s.recv(4096)
+        assert b"501" in data.split(b"\r\n", 1)[0]
+        # Connection closed: the unread chunk framing dies with it.
+        s.settimeout(5)
+        assert s.recv(4096) == b""
+        s.close()
+    finally:
+        server.shutdown()
