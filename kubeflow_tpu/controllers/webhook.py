@@ -137,6 +137,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--failure-policy", choices=("Fail", "Ignore"), default="Fail"
     )
+    parser.add_argument(
+        "--leader-elect", action="store_true",
+        help="run as one of N replicas with exactly one active: block "
+        "in standby until the webhook Lease is acquired, then serve and "
+        "register; exit on leadership loss so the supervisor restarts "
+        "fresh (the -enable-leader-election flag every reference "
+        "controller ships, notebook-controller/main.go:51-62)",
+    )
+    parser.add_argument(
+        "--identity", default=None,
+        help="leader-election identity (default: <name>-<pid>)",
+    )
     args = parser.parse_args(argv)
 
     client = HttpApiClient(args.apiserver)
@@ -151,6 +163,27 @@ def main(argv: list[str] | None = None) -> int:
         if args.host not in ("localhost", "127.0.0.1")
         else ("localhost", "127.0.0.1"),
     )
+    from kubeflow_tpu.utils import signals as sigutil
+
+    shutdown = sigutil.install_shutdown_handlers()
+
+    elector = None
+    if args.leader_elect:
+        from kubeflow_tpu.controllers.leader import LeaderElector
+
+        elector = LeaderElector(
+            client,
+            f"{args.name}-webhook-leader",
+            args.identity or f"{args.name}-{os.getpid()}",
+        )
+        print(f"standby {elector.identity}", flush=True)
+        if not elector.acquire(shutdown):
+            return 0  # shut down while in standby
+        # Registration (the write that aims admission traffic at this
+        # replica) is fenced to this term: a deposed replica racing the
+        # successor's re-registration gets a Conflict, not the traffic.
+        client.set_lease_guard(elector.guard)
+
     server, _ = serve(
         MutatingWebhookApp(mutate), host=args.host, port=args.port,
         tls=paths,
@@ -164,9 +197,16 @@ def main(argv: list[str] | None = None) -> int:
             )
         )
     print(f"webhook ready {server.server_port}", flush=True)
-    from kubeflow_tpu.utils import signals as sigutil
-
-    sigutil.wait_for_shutdown(sigutil.install_shutdown_handlers())
+    if elector is not None:
+        elector.hold(shutdown)  # returns on shutdown OR leadership loss
+        lost = not shutdown.is_set()
+        server.shutdown()
+        if lost:
+            print("deposed", flush=True)
+            return 2  # die; the supervisor restarts a fresh standby
+        elector.release()
+        return 0
+    sigutil.wait_for_shutdown(shutdown)
     server.shutdown()
     return 0
 

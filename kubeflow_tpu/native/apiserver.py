@@ -87,10 +87,27 @@ class NativeApiServer:
 
     # -- CRUD -------------------------------------------------------------
 
-    def create(self, obj: Resource) -> Resource:
+
+    def _check_lease_guard(self, guard, kind: str) -> None:
+        """Shared fencing contract (fake_apiserver.check_lease_guard) —
+        caller holds _dispatch_lock, which every mutation including
+        Lease renewals through this server serializes on, so the check
+        is atomic here too."""
+        from kubeflow_tpu.testing.fake_apiserver import check_lease_guard
+
+        def lookup(ns: str, name: str):
+            try:
+                return _to_resource(self._store.get("Lease", ns, name)).spec
+            except core.StoreError:
+                return None
+
+        check_lease_guard(lookup, guard, kind)
+
+    def create(self, obj: Resource, *, lease_guard=None) -> Resource:
         self._reject_webhook_config(obj)
         obj = self._admit(obj)
         with self._dispatch_lock:
+            self._check_lease_guard(lease_guard, obj.kind)
             try:
                 stored = self._store.create(obj.to_dict())
             except core.StoreError as e:
@@ -128,16 +145,23 @@ class NativeApiServer:
                 "FakeApiServer for out-of-process admission"
             )
 
-    def update(self, obj: Resource) -> Resource:
+    def update(self, obj: Resource, *, lease_guard=None) -> Resource:
         self._reject_webhook_config(obj)
         obj = self._admit(obj)
-        return self._update(obj, status_only=False)
+        return self._update(
+            obj, status_only=False, lease_guard=lease_guard
+        )
 
-    def update_status(self, obj: Resource) -> Resource:
-        return self._update(obj, status_only=True)
+    def update_status(self, obj: Resource, *, lease_guard=None) -> Resource:
+        return self._update(
+            obj, status_only=True, lease_guard=lease_guard
+        )
 
-    def _update(self, obj: Resource, *, status_only: bool) -> Resource:
+    def _update(
+        self, obj: Resource, *, status_only: bool, lease_guard=None
+    ) -> Resource:
         with self._dispatch_lock:
+            self._check_lease_guard(lease_guard, obj.kind)
             try:
                 stored = self._store.update(
                     obj.to_dict(), status_only=status_only
@@ -147,8 +171,16 @@ class NativeApiServer:
             self._drain_events()
             return _to_resource(stored)
 
-    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "default",
+        *,
+        lease_guard=None,
+    ) -> None:
         with self._dispatch_lock:
+            self._check_lease_guard(lease_guard, kind)
             try:
                 self._store.delete(kind, namespace, name)
             except core.StoreError as e:
@@ -157,13 +189,13 @@ class NativeApiServer:
 
     # -- conveniences (same contracts as FakeApiServer) -------------------
 
-    def apply(self, obj: Resource) -> Resource:
+    def apply(self, obj: Resource, *, lease_guard=None) -> Resource:
         try:
             current = self.get(
                 obj.kind, obj.metadata.name, obj.metadata.namespace
             )
         except NotFound:
-            return self.create(obj)
+            return self.create(obj, lease_guard=lease_guard)
         obj = self._admit(obj)
         if (
             current.spec == obj.spec
@@ -174,7 +206,7 @@ class NativeApiServer:
         merged = obj.deepcopy()
         merged.metadata.resource_version = current.metadata.resource_version
         merged.metadata.uid = current.metadata.uid
-        return self.update(merged)
+        return self.update(merged, lease_guard=lease_guard)
 
     def record_event(
         self,

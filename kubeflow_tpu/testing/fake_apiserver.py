@@ -83,6 +83,39 @@ def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def check_lease_guard(get_lease_spec, guard, kind: str) -> None:
+    """Write fencing, shared by BOTH store backends (the caller holds
+    its store's commit lock, so the check is atomic with the write): a
+    guarded write lands only while its lease still shows the presented
+    holder AND generation. A deposed leader resurfacing after a
+    partition — even one whose final write was already in flight — gets
+    a Conflict instead of mutating state its successor owns. Lease
+    writes themselves are exempt (the election protocol is
+    self-arbitrating via rv CAS and must stay able to transfer
+    ownership). `get_lease_spec(ns, name)` returns the lease's spec
+    dict, or None when it does not exist."""
+    if guard is None or kind == "Lease":
+        return
+    ns, name, holder, transitions = guard
+    spec = get_lease_spec(ns, name)
+    if (
+        spec is None
+        or spec.get("holderIdentity") != holder
+        or int(spec.get("leaseTransitions", 0)) != int(transitions)
+    ):
+        current = (
+            f"held by {spec.get('holderIdentity')!r} generation "
+            f"{spec.get('leaseTransitions')}"
+            if spec is not None
+            else "gone"
+        )
+        raise Conflict(
+            f"fenced: lease {ns or '_'}/{name} is {current}; writer "
+            f"presented {holder!r} generation {transitions} — a "
+            f"deposed leader must not write into its successor's term"
+        )
+
+
 class FakeApiServer:
     def __init__(
         self,
@@ -226,6 +259,16 @@ class FakeApiServer:
                 f"store fail-stopped after a persistence failure: "
                 f"{self._broken}"
             )
+
+    def _check_lease_guard(self, guard, kind: str) -> None:
+        """Shared fencing contract — see module-level check_lease_guard
+        (caller holds the lock, so check+commit is atomic)."""
+
+        def lookup(ns: str, name: str):
+            lease = self._objects.get(("Lease", ns, name))
+            return lease.spec if lease is not None else None
+
+        check_lease_guard(lookup, guard, kind)
 
     def _persist(self, event: str, obj: Resource) -> None:
         """WAL-append one committed write (caller holds the lock). Runs
@@ -654,7 +697,9 @@ class FakeApiServer:
         except versioning.ConversionError as e:
             raise Invalid(str(e)) from e
 
-    def create(self, obj: Resource) -> Resource:
+    def create(
+        self, obj: Resource, *, lease_guard=None
+    ) -> Resource:
         self._check_available()
         obj = self._normalize_version(obj)
         # Webhook callouts OUTSIDE the lock (an HTTP round trip must not
@@ -662,6 +707,7 @@ class FakeApiServer:
         # validating order, so quota meters the post-mutation object).
         obj = self._webhook_admit(obj, "CREATE")
         with self._lock:
+            self._check_lease_guard(lease_guard, obj.kind)
             # Admission INSIDE the critical section: validating hooks
             # (quota) read current state, and check-then-insert must be
             # atomic or two concurrent creates can both pass a cap.
@@ -691,6 +737,14 @@ class FakeApiServer:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             return obj.deepcopy()
 
+    def kinds(self) -> list[str]:
+        """Distinct kinds with live objects (quota's count/<resource>
+        inverse needs the real kind strings — resource_for_kind is lossy
+        for CamelCase, so there is no static inverse)."""
+        with self._lock:
+            self._check_available()
+            return sorted({k[0] for k in self._objects})
+
     def list(
         self,
         kind: str,
@@ -712,9 +766,12 @@ class FakeApiServer:
                 out.append(obj.deepcopy())
             return out
 
-    def _update(self, obj: Resource, *, status_only: bool) -> Resource:
+    def _update(
+        self, obj: Resource, *, status_only: bool, lease_guard=None
+    ) -> Resource:
         with self._lock:
             self._check_available()
+            self._check_lease_guard(lease_guard, obj.kind)
             key = obj.key
             current = self._objects.get(key)
             if current is None:
@@ -754,7 +811,7 @@ class FakeApiServer:
                 self._emit("MODIFIED", stored)
         return out
 
-    def update(self, obj: Resource) -> Resource:
+    def update(self, obj: Resource, *, lease_guard=None) -> Resource:
         # Fast-fail precheck (authoritative re-check is in _emit, under
         # the lock): a fail-stopped store must not keep firing webhook
         # HTTP callouts for writes that can never commit.
@@ -762,14 +819,25 @@ class FakeApiServer:
         # Same two-phase admission as create: webhooks off-lock first.
         obj = self._webhook_admit(self._normalize_version(obj), "UPDATE")
         with self._lock:  # in-process admission atomic with the write
-            return self._update(self._admit(obj), status_only=False)
+            return self._update(
+                self._admit(obj), status_only=False,
+                lease_guard=lease_guard,
+            )
 
-    def update_status(self, obj: Resource) -> Resource:
-        return self._update(obj, status_only=True)
+    def update_status(self, obj: Resource, *, lease_guard=None) -> Resource:
+        return self._update(obj, status_only=True, lease_guard=lease_guard)
 
-    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "default",
+        *,
+        lease_guard=None,
+    ) -> None:
         with self._lock:
             self._check_available()
+            self._check_lease_guard(lease_guard, kind)
             key = (kind, namespace, name)
             obj = self._objects.get(key)
             if obj is None:
@@ -840,7 +908,7 @@ class FakeApiServer:
 
     # -- conveniences ------------------------------------------------------
 
-    def apply(self, obj: Resource) -> Resource:
+    def apply(self, obj: Resource, *, lease_guard=None) -> Resource:
         """Create-or-update by (kind, ns, name) — the reconcilehelper
         pattern (`components/common/reconcilehelper/util.go:18-105`):
         no-op when the desired fields already match, so level-triggered
@@ -848,7 +916,7 @@ class FakeApiServer:
         try:
             current = self.get(obj.kind, obj.metadata.name, obj.metadata.namespace)
         except NotFound:
-            return self.create(obj)
+            return self.create(obj, lease_guard=lease_guard)
         # Compare post-conversion, post-admission desired state against
         # stored state — otherwise an apply() of a spoke-version or
         # pre-admission spec would never no-op (or strip injected
@@ -872,7 +940,10 @@ class FakeApiServer:
         # HTTPS round trip a second time. In-process hooks re-run under
         # the lock (quota's atomicity; they're cheap and idempotent).
         with self._lock:
-            return self._update(self._admit(merged), status_only=False)
+            return self._update(
+                self._admit(merged), status_only=False,
+                lease_guard=lease_guard,
+            )
 
     def record_event(
         self,

@@ -1,0 +1,107 @@
+"""Leader-elected controller replica: the HA controller binary.
+
+Run N copies of this worker against one facade and exactly one
+reconciles at a time (the others are hot standbys parked in the lease
+acquire loop) — the `-enable-leader-election` deployment shape every
+reference controller ships (`notebook-controller/main.go:51-62`). On
+acquiring the lease the worker arms the client's lease guard, so if it
+is ever deposed mid-write (partition, GC pause) the write is fenced
+server-side instead of landing in the successor's term.
+
+Reconciles `HAJob` CRs: ensure one labeled child Pod exists (generated
+name — the duplicate-detection surface: two concurrently-active
+replicas would both list-empty-then-create, yielding two pods), then
+mark status.phase=Done with the worker's identity. KFTPU_RECONCILE_DELAY
+widens the read→write window so the e2e can SIGKILL mid-reconcile.
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+from kubeflow_tpu.api.objects import new_resource  # noqa: E402
+from kubeflow_tpu.controllers.leader import LeaderElector  # noqa: E402
+from kubeflow_tpu.controllers.runtime import Controller, Result  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+from kubeflow_tpu.testing.fake_apiserver import (  # noqa: E402
+    Conflict,
+    NotFound,
+)
+
+IDENTITY = os.environ["KFTPU_IDENTITY"]
+DELAY = float(os.environ.get("KFTPU_RECONCILE_DELAY", "0"))
+
+
+def reconcile(capi, key):
+    ns, name = key
+    try:
+        job = capi.get("HAJob", name, ns)
+    except NotFound:
+        return Result()
+    if job.status.get("phase") == "Done":
+        return Result()
+    if DELAY:
+        time.sleep(DELAY)  # the SIGKILL-mid-reconcile window
+    pods = capi.list("Pod", namespace=ns, label_selector={"hajob": name})
+    if not pods:
+        pod = new_resource(
+            "Pod", f"{name}-{os.urandom(4).hex()}", ns,
+            spec={"containers": [{"name": "w"}], "createdBy": IDENTITY},
+        )
+        pod.metadata.labels["hajob"] = name
+        capi.create(pod)
+    fresh = capi.get("HAJob", name, ns)
+    fresh.status["phase"] = "Done"
+    fresh.status["by"] = IDENTITY
+    capi.update_status(fresh)
+    return Result()
+
+
+def main() -> None:
+    client = HttpApiClient(
+        os.environ["KFTPU_APISERVER"],
+        watch_poll_timeout=2.0,
+        watch_retry=0.1,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    elector = LeaderElector(
+        client,
+        "hajob-controller",
+        IDENTITY,
+        lease_duration=float(os.environ.get("KFTPU_LEASE_DURATION", "3")),
+        renew_deadline=float(os.environ.get("KFTPU_RENEW_DEADLINE", "2")),
+        retry_period=0.25,
+    )
+    print(f"standby {IDENTITY}", flush=True)
+
+    def start_leading(el):
+        # Fencing armed BEFORE the first reconcile: every write this
+        # term makes carries (lease, holder, generation).
+        client.set_lease_guard(el.guard)
+        print(f"leading {IDENTITY} gen {el.transitions}", flush=True)
+        ctl = Controller(client, "HAJob", reconcile, name="hajob-controller")
+        t = threading.Thread(
+            target=ctl.run, args=(stop,), daemon=True
+        )
+        t.start()
+
+    try:
+        lost = elector.run(stop, start_leading)
+    except Conflict:
+        lost = True
+    if lost:
+        # Deposed: the only safe continuation is none (client-go's
+        # RunOrDie posture). The supervisor restarts us fresh.
+        print(f"deposed {IDENTITY}", flush=True)
+        sys.exit(2)
+    client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,233 @@
+"""HA control plane e2e: leader election across real processes.
+
+Round-4 verdict item 1: durability (round 4) made crash recovery real,
+but one-of-everything meant a crash still took the platform down until a
+restart. Here two controller REPLICAS run as separate OS processes
+against the durable TLS facade; exactly one reconciles (the Lease), a
+SIGKILL of the leader mid-reconcile fails over to the standby within the
+lease TTL with zero duplicate side effects, and a deposed leader's
+in-flight write is fenced. Reference shape:
+`notebook-controller/main.go:51-62` (-enable-leader-election).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import make_cluster_role, make_cluster_role_binding
+from kubeflow_tpu.api.tokens import TokenRegistry, service_account
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.web.wsgi import serve
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+WORKER = os.path.join(REPO, "tests", "e2e", "ha_controller_worker.py")
+
+# Least-privilege for the HA worker: its kinds, its status subresource,
+# events, plus get/create/update on leases — the coordination grant every
+# reference controller's RBAC adds for -enable-leader-election.
+RULES = [
+    {"verbs": ["get", "list", "watch"], "resources": ["hajobs"]},
+    {"verbs": ["update"], "resources": ["hajobs/status"]},
+    {"verbs": ["get", "list", "watch", "create", "delete"],
+     "resources": ["pods"]},
+    {"verbs": ["get", "create", "update"], "resources": ["leases"]},
+    {"verbs": ["create"], "resources": ["events"]},
+]
+
+LEASE_DURATION = 3.0
+
+
+def _spawn(identity, base_url, token, ca, delay="0"):
+    return subprocess.Popen(
+        [sys.executable, WORKER],
+        env={
+            **os.environ,
+            "KFTPU_REPO": REPO,
+            "KFTPU_APISERVER": base_url,
+            "KFTPU_TOKEN": token,
+            "KFTPU_CA": ca,
+            "KFTPU_IDENTITY": identity,
+            "KFTPU_LEASE_DURATION": str(LEASE_DURATION),
+            "KFTPU_RENEW_DEADLINE": "2",
+            "KFTPU_RECONCILE_DELAY": delay,
+        },
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _read_until(proc, prefix, timeout=30.0):
+    """Read stdout lines until one starts with `prefix`; returns it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        if line.strip().startswith(prefix):
+            return line.strip()
+    raise AssertionError(f"no {prefix!r} line from worker in {timeout}s")
+
+
+def _wait(pred, timeout=30.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_leader_failover_no_duplicate_side_effects(tmp_path, tls_paths):
+    """Two replicas, one active; SIGKILL the leader mid-reconcile; the
+    standby acquires within the lease TTL and finishes ALL work; every
+    job ends with exactly ONE child pod (generated names — concurrent
+    actives would have created two) and a Done status."""
+    api = FakeApiServer(persist_dir=str(tmp_path / "state"))
+    tokens = TokenRegistry()
+    user = service_account("kubeflow", "hajob-controller")
+    api.create(make_cluster_role("hajob-controller", RULES))
+    api.create(
+        make_cluster_role_binding("hajob-controller", "hajob-controller",
+                                  user)
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
+    )
+    base = f"https://127.0.0.1:{server.server_port}"
+    token = tokens.issue(user)
+
+    # Replica A first (wins the lease), B second (hot standby). A
+    # reconciles slowly so the SIGKILL lands mid-reconcile.
+    a = _spawn("replica-a", base, token, tls_paths.ca_cert, delay="0.5")
+    b = None
+    try:
+        _read_until(a, "standby replica-a")
+        _read_until(a, "leading replica-a")
+        b = _spawn("replica-b", base, token, tls_paths.ca_cert)
+        _read_until(b, "standby replica-b")
+
+        for i in range(6):
+            api.create(new_resource("HAJob", f"job{i}", "default",
+                                    spec={"i": i}))
+        # A is mid-stream (0.5 s per reconcile): wait for evidence it is
+        # actively working (≥1 done, not all) then kill it -9.
+        assert _wait(
+            lambda: sum(
+                1 for j in api.list("HAJob", "default")
+                if j.status.get("phase") == "Done"
+            ) >= 1
+        )
+        done_before = sum(
+            1 for j in api.list("HAJob", "default")
+            if j.status.get("phase") == "Done"
+        )
+        assert done_before < 6, "leader finished too fast to kill mid-work"
+        a.kill()  # SIGKILL: no release, standby must wait out the TTL
+        t_kill = time.monotonic()
+        _read_until(b, "leading replica-b", timeout=LEASE_DURATION + 10)
+        failover = time.monotonic() - t_kill
+        # TTL bound: the standby polls every 0.25 s, so takeover lands
+        # within lease_duration + a poll + CI slack.
+        assert failover < LEASE_DURATION + 5, f"failover took {failover:.1f}s"
+
+        assert _wait(
+            lambda: all(
+                j.status.get("phase") == "Done"
+                for j in api.list("HAJob", "default")
+            )
+        ), [j.status for j in api.list("HAJob", "default")]
+        # No duplicate side effects across the handover: exactly one
+        # child pod per job (two concurrent actives would both have
+        # list-empty-then-created), and the standby finished the rest.
+        for i in range(6):
+            pods = api.list("Pod", "default",
+                            label_selector={"hajob": f"job{i}"})
+            assert len(pods) == 1, (
+                f"job{i}: {len(pods)} pods — duplicate side effects"
+            )
+        finishers = {
+            j.status["by"] for j in api.list("HAJob", "default")
+        }
+        assert "replica-b" in finishers  # the standby did real work
+        print(f"# failover after SIGKILL: {failover:.2f}s "
+              f"(lease TTL {LEASE_DURATION}s)")
+    finally:
+        for p in (a, b):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=10)
+        server.shutdown()
+        api.close()
+
+
+def test_partitioned_stale_leader_writes_are_fenced(tmp_path, tls_paths):
+    """The split-brain half: SIGSTOP the leader (a network partition /
+    GC pause it never notices), let the standby take over, then SIGCONT.
+    The stale leader's in-flight guarded write is rejected by lease
+    fencing and the worker exits deposed; the store shows only the
+    successor's term."""
+    api = FakeApiServer(persist_dir=str(tmp_path / "state"))
+    tokens = TokenRegistry()
+    user = service_account("kubeflow", "hajob-controller")
+    api.create(make_cluster_role("hajob-controller", RULES))
+    api.create(
+        make_cluster_role_binding("hajob-controller", "hajob-controller",
+                                  user)
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
+    )
+    base = f"https://127.0.0.1:{server.server_port}"
+    token = tokens.issue(user)
+
+    # The stale leader reconciles VERY slowly: its in-flight write will
+    # resume only after the successor owns the term.
+    a = _spawn("replica-a", base, token, tls_paths.ca_cert, delay="8")
+    b = None
+    try:
+        _read_until(a, "leading replica-a")
+        b = _spawn("replica-b", base, token, tls_paths.ca_cert)
+        _read_until(b, "standby replica-b")
+
+        api.create(new_resource("HAJob", "contested", "default", spec={}))
+        time.sleep(1.0)  # a is now inside its 8 s reconcile sleep
+        os.kill(a.pid, 19)  # SIGSTOP: the partition begins
+        _read_until(b, "leading replica-b", timeout=LEASE_DURATION + 10)
+        assert _wait(
+            lambda: api.get("HAJob", "contested", "default")
+            .status.get("phase") == "Done"
+        )
+        os.kill(a.pid, 18)  # SIGCONT: the stale leader resumes mid-write
+        # Its guarded create/update is fenced server-side; the elector
+        # then fails renewal and the worker exits deposed.
+        assert a.wait(timeout=30) == 2, "stale leader did not exit deposed"
+
+        # Only the successor's side effects exist.
+        pods = api.list("Pod", "default",
+                        label_selector={"hajob": "contested"})
+        assert len(pods) == 1
+        assert pods[0].spec["createdBy"] == "replica-b"
+        assert (
+            api.get("HAJob", "contested", "default").status["by"]
+            == "replica-b"
+        )
+    finally:
+        for p in (a, b):
+            if p is not None:
+                try:
+                    os.kill(p.pid, 18)  # un-stop before kill
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.kill()
+                p.wait(timeout=10)
+        server.shutdown()
+        api.close()

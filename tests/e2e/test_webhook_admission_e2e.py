@@ -139,3 +139,110 @@ def test_poddefault_mutation_via_separate_process(tmp_path, tls_paths):
             proc.kill()
             proc.communicate()
         server.shutdown()
+
+
+def test_leader_elected_webhook_failover(tmp_path, tls_paths):
+    """--leader-elect: two webhook replicas, exactly one serving +
+    registered. Kill the leader; the standby acquires the lease,
+    registers ITS OWN url (re-aiming admission traffic), and mutation
+    keeps working through the new replica."""
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    tokens = TokenRegistry()
+    admin_token = tokens.issue("system:admin")
+    api.create(
+        make_cluster_role_binding("adm", "kubeflow-admin", "system:admin")
+    )
+    wh_user = service_account("kubeflow", "poddefault-webhook")
+    rules = WEBHOOK_RULES + [
+        {"verbs": ["get", "create", "update"], "resources": ["leases"]},
+    ]
+    api.create(make_cluster_role("poddefault-webhook", rules))
+    api.create(
+        make_cluster_role_binding(
+            "poddefault-webhook", "poddefault-webhook", wh_user
+        )
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
+    )
+    base_url = f"https://127.0.0.1:{server.server_port}"
+    admin = HttpApiClient(base_url, token=admin_token,
+                          ca=tls_paths.ca_cert)
+    admin.create(new_resource(
+        "PodDefault", "add-proxy", "default",
+        spec={
+            "selector": {"matchLabels": {"inject": "yes"}},
+            "env": [{"name": "HTTP_PROXY", "value": "http://proxy:80"}],
+        },
+    ))
+
+    def spawn(identity, tls_sub):
+        return subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.controllers.webhook",
+             "--apiserver", base_url,
+             "--tls-dir", str(tmp_path / tls_sub),
+             "--register", "--leader-elect", "--identity", identity],
+            env={
+                **os.environ,
+                "PYTHONPATH": REPO,
+                "KFTPU_TOKEN": tokens.issue(wh_user),
+                "KFTPU_CA": tls_paths.ca_cert,
+            },
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+
+    def read_until(proc, prefix, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line and line.strip().startswith(prefix):
+                return line.strip()
+        raise AssertionError(f"no {prefix!r} from webhook in {timeout}s")
+
+    a = spawn("wh-a", "tls-a")
+    b = None
+    try:
+        read_until(a, "standby wh-a")
+        read_until(a, "webhook ready")
+        url_a = api.get(
+            "WebhookConfiguration", "poddefault-webhook", ""
+        ).spec["url"]
+        b = spawn("wh-b", "tls-b")
+        read_until(b, "standby wh-b")
+
+        # Leader serves; standby is NOT serving (registration points at
+        # exactly one replica).
+        pod = admin.create(new_resource(
+            "Pod", "via-leader", "default",
+            spec={"containers": [{"name": "w"}]},
+            labels={"inject": "yes"},
+        ))
+        assert {"name": "HTTP_PROXY", "value": "http://proxy:80"} in (
+            pod.spec["containers"][0].get("env", [])
+        )
+
+        a.kill()  # SIGKILL: the lease must expire on its own
+        read_until(b, "webhook ready", timeout=40)
+        url_b = api.get(
+            "WebhookConfiguration", "poddefault-webhook", ""
+        ).spec["url"]
+        assert url_b != url_a  # re-aimed at the survivor
+        pod2 = admin.create(new_resource(
+            "Pod", "via-standby", "default",
+            spec={"containers": [{"name": "w"}]},
+            labels={"inject": "yes"},
+        ))
+        assert {"name": "HTTP_PROXY", "value": "http://proxy:80"} in (
+            pod2.spec["containers"][0].get("env", [])
+        )
+    finally:
+        for p in (a, b):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=10)
+        admin.close()
+        server.shutdown()

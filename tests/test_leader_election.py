@@ -1,0 +1,254 @@
+"""Leader election + write fencing.
+
+The reference gets this from controller-runtime for free — every
+controller ships `-enable-leader-election`
+(`notebook-controller/main.go:51-62`, `profile-controller/main.go:52-69`)
+so N replicas run with exactly one active. These tests pin our
+equivalent: Lease CAS acquisition (two candidates can never both win a
+term), expiry-driven takeover within the lease TTL, graceful release,
+step-down on renewal failure, and the part K8s itself does NOT give you —
+lease-generation write fencing at the storage boundary, so a deposed
+leader's in-flight writes land as Conflicts, not corruption. The
+process-level half (SIGKILL the leader, standby takes over, no duplicate
+side effects) lives in tests/e2e/test_leader_ha_e2e.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.controllers.leader import LEASE_KIND, LeaderElector
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.testing.fake_apiserver import Conflict, FakeApiServer
+from kubeflow_tpu.web.wsgi import serve
+
+
+def _elector(api, identity, **kw):
+    kw.setdefault("lease_duration", 0.6)
+    kw.setdefault("renew_deadline", 0.4)
+    kw.setdefault("retry_period", 0.05)
+    return LeaderElector(api, "test-controller", identity, **kw)
+
+
+def _backdate(api, name="test-controller", by=10.0):
+    """Simulate the holder going silent for `by` seconds (crash or
+    partition) without waiting wall-clock time."""
+    lease = api.get(LEASE_KIND, name, "")
+    lease.spec = dict(lease.spec)
+    lease.spec["renewTime"] = time.time() - by
+    api.update(lease)
+
+
+def test_first_candidate_creates_and_holds():
+    api = FakeApiServer()
+    a = _elector(api, "replica-a")
+    assert a._try_acquire_or_renew()
+    assert a.transitions == 1
+    lease = api.get(LEASE_KIND, "test-controller", "")
+    assert lease.spec["holderIdentity"] == "replica-a"
+
+
+def test_standby_cannot_steal_live_lease():
+    api = FakeApiServer()
+    a, b = _elector(api, "a"), _elector(api, "b")
+    assert a._try_acquire_or_renew()
+    assert not b._try_acquire_or_renew()
+    # Holder renews freely; generation is stable within a term.
+    assert a._try_acquire_or_renew()
+    assert a.transitions == 1
+
+
+def test_expired_lease_transfers_with_new_generation():
+    api = FakeApiServer()
+    a, b = _elector(api, "a"), _elector(api, "b")
+    assert a._try_acquire_or_renew()
+    _backdate(api)
+    assert b._try_acquire_or_renew()
+    assert b.transitions == 2  # new term = new fencing token
+    # The deposed holder cannot renew into the new term.
+    assert not a._try_acquire_or_renew()
+
+
+def test_concurrent_candidates_one_winner():
+    """CAS property: N candidates racing for an expired lease produce
+    exactly one winner per round (resourceVersion preconditions)."""
+    api = FakeApiServer()
+    seed = _elector(api, "seed")
+    assert seed._try_acquire_or_renew()
+    _backdate(api)
+    candidates = [_elector(api, f"c{i}") for i in range(8)]
+    barrier = threading.Barrier(len(candidates))
+    wins = []
+
+    def race(e):
+        barrier.wait()
+        if e._try_acquire_or_renew():
+            wins.append(e.identity)
+
+    threads = [threading.Thread(target=race, args=(e,)) for e in candidates]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert len(wins) == 1, wins
+
+
+def test_hold_steps_down_when_deposed():
+    api = FakeApiServer()
+    a, b = _elector(api, "a"), _elector(api, "b")
+    stop = threading.Event()
+    assert a.acquire(stop)
+    _backdate(api)
+    assert b._try_acquire_or_renew()
+    t0 = time.monotonic()
+    a.hold(stop)  # returns only on loss (stop never set)
+    assert not a.is_leading()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_release_enables_instant_takeover():
+    api = FakeApiServer()
+    a, b = _elector(api, "a"), _elector(api, "b")
+    stop = threading.Event()
+    assert a.acquire(stop)
+    a.release()
+    # No TTL wait: the cleared holder is immediately acquirable.
+    assert b._try_acquire_or_renew()
+    assert b.transitions == 2
+
+
+def test_run_reports_loss_vs_clean_stop():
+    api = FakeApiServer()
+    a = _elector(api, "a")
+    stop = threading.Event()
+    started = threading.Event()
+    result = {}
+
+    def runner():
+        result["lost"] = a.run(stop, lambda e: started.set())
+
+    t = threading.Thread(target=runner)
+    t.start()
+    assert started.wait(5)
+    stop.set()
+    t.join(timeout=5)
+    assert result["lost"] is False  # clean stop, not deposition
+
+    b = _elector(api, "b")
+    stop2 = threading.Event()
+    started2 = threading.Event()
+
+    def runner2():
+        result["lost2"] = b.run(stop2, lambda e: started2.set())
+
+    t2 = threading.Thread(target=runner2)
+    t2.start()
+    assert started2.wait(5)
+    _backdate(api)
+    c = _elector(api, "c")
+    assert c._try_acquire_or_renew()
+    t2.join(timeout=10)
+    assert result["lost2"] is True  # deposed → caller must exit
+
+
+# -- fencing ---------------------------------------------------------------
+
+
+def test_fenced_write_rejected_in_process():
+    api = FakeApiServer()
+    a = _elector(api, "a")
+    assert a._try_acquire_or_renew()
+    guard = ("", "test-controller", "a", a.transitions)
+    # Guarded writes land while the term is live.
+    api.create(new_resource("Widget", "w1"), lease_guard=guard)
+    # Depose a; the old guard now fences every write form.
+    _backdate(api)
+    b = _elector(api, "b")
+    assert b._try_acquire_or_renew()
+    with pytest.raises(Conflict, match="fenced"):
+        api.create(new_resource("Widget", "w2"), lease_guard=guard)
+    w1 = api.get("Widget", "w1")
+    w1.spec["touched"] = True
+    with pytest.raises(Conflict, match="fenced"):
+        api.update(w1, lease_guard=guard)
+    with pytest.raises(Conflict, match="fenced"):
+        api.update_status(w1, lease_guard=guard)
+    with pytest.raises(Conflict, match="fenced"):
+        api.delete("Widget", "w1", lease_guard=guard)
+    with pytest.raises(Conflict, match="fenced"):
+        api.apply(new_resource("Widget", "w1", spec={"v": 2}),
+                  lease_guard=guard)
+    # The new term's guard works.
+    guard_b = ("", "test-controller", "b", b.transitions)
+    api.create(new_resource("Widget", "w2"), lease_guard=guard_b)
+
+
+def test_lease_writes_exempt_from_fencing():
+    """The election protocol must stay able to transfer ownership: a
+    renewal/acquisition is never fenced by a stale guard the same client
+    still has armed."""
+    api = FakeApiServer()
+    a = _elector(api, "a")
+    assert a._try_acquire_or_renew()
+    lease = api.get(LEASE_KIND, "test-controller", "")
+    lease.spec = dict(lease.spec)
+    lease.spec["renewTime"] = time.time()
+    # Stale guard on a Lease write: exempt, must succeed.
+    api.update(lease, lease_guard=("", "test-controller", "zombie", 99))
+
+
+def test_fencing_over_http_facade():
+    """The partition story end-to-end over the real transport: leader A
+    arms its guard on the client; A goes silent (backdated lease); B
+    acquires; A's resumed write is rejected with Conflict while B's
+    writes land."""
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{server.server_port}"
+    client_a = HttpApiClient(base)
+    client_b = HttpApiClient(base)
+    try:
+        a = _elector(client_a, "a")
+        assert a._try_acquire_or_renew()
+        client_a.set_lease_guard(("", "test-controller", "a",
+                                  a.transitions))
+        client_a.create(new_resource("Widget", "pre-partition"))
+        _backdate(api)
+        b = _elector(client_b, "b")
+        assert b._try_acquire_or_renew()
+        client_b.set_lease_guard(("", "test-controller", "b",
+                                  b.transitions))
+        with pytest.raises(Conflict, match="fenced"):
+            client_a.create(new_resource("Widget", "stale-write"))
+        client_b.create(new_resource("Widget", "successor-write"))
+        names = {w.metadata.name for w in api.list("Widget")}
+        assert names == {"pre-partition", "successor-write"}
+    finally:
+        client_a.close()
+        client_b.close()
+        server.shutdown()
+
+
+def test_hold_treats_term_change_as_loss():
+    """A leader that silently lost and RE-acquired (new generation)
+    while parked must step down, not carry on: its armed fencing guard
+    is from the dead term and every guarded write would Conflict forever
+    — a livelock, since its renewals (exempt) would keep the lease."""
+    api = FakeApiServer()
+    a = _elector(api, "a")
+    stop = threading.Event()
+    assert a.acquire(stop)
+    first_term = a.transitions
+    # Simulate the parked leader's world moving on: b takes an expired
+    # lease (gen+1), then releases; a's next renewal re-acquires gen+2.
+    _backdate(api)
+    b = _elector(api, "b")
+    assert b._try_acquire_or_renew()
+    b.release()
+    t0 = time.monotonic()
+    a.hold(stop)  # must return as LOSS despite successful re-acquisition
+    assert not a.is_leading()
+    assert a.transitions != first_term
+    assert time.monotonic() - t0 < 5.0
