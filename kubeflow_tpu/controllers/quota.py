@@ -1,47 +1,70 @@
 """ResourceQuota enforcement — the quota admission controller.
 
-The profile controller materializes `ResourceQuota` objects with a
-`google.com/tpu` hard cap per tenant namespace
-(`profile.py:166-173`, mirroring `profile_controller.go`'s
+The profile controller materializes `ResourceQuota` objects per tenant
+namespace (`profile.py`, mirroring `profile_controller.go`'s
 resourceQuotaSpec handling), but the reference leaned on the REAL
 apiserver's built-in quota admission to enforce them — our in-process
 apiserver has no such built-in, so without this module the caps were
 decorative. `register(api)` installs the enforcement at the same
-boundary K8s does: pod admission.
+boundary K8s does: admission.
 
-Semantics (K8s quota, scoped to the resources the platform meters):
-- on Pod create, for each hard-capped resource, current namespace usage
-  (live pods' container limits, terminal pods excluded) + the new pod's
-  ask must fit under the cap, else the create is rejected;
-- updates re-admit the object, so the pod's own existing usage is
-  excluded from "current" (no self-double-count);
-- namespaces without a ResourceQuota are unmetered.
+Scope (the full corev1 ResourceQuotaSpec the reference's Profile carries,
+`profile-controller/api/v1/profile_types.go:36-44`):
 
-The TpuJob operator turns a quota rejection into a `QuotaExceeded`
-Pending episode instead of a crash-looping partial gang (all-or-nothing
-cuts both ways: if one worker doesn't fit the budget, none start).
+- **Compute, requests vs limits**: `requests.cpu` / `limits.cpu` (same
+  for `memory` and `google.com/tpu`) meter exactly that figure per
+  container; bare `cpu` / `memory` / `google.com/tpu` are the corev1
+  shorthands for the requests form. Defaulting per container follows
+  K8s (absent requests inherit the container's limits) plus one
+  deliberate relaxation both ways (absent limits fall back to requests
+  — K8s leaves that to LimitRanger, which we don't ship, and the
+  round-4 gap was precisely pods sized via requests-only slipping
+  `limits.*`-style caps). A pod naming NEITHER figure for an
+  explicitly-prefixed metered resource is rejected, as K8s does ("must
+  specify requests.cpu"); bare-key caps tolerate it (back-compat: a
+  chips-only gang pod is admissible under a bare cpu cap).
+- **Object counts**: `pods` (non-terminal), `persistentvolumeclaims`,
+  and the generic `count/<resource>` form (lowercase-plural, e.g.
+  `count/notebooks`).
+- **Storage**: `requests.storage` sums live PVCs'
+  spec.resources.requests.storage.
+- **status.used** is published on the quota object after every change
+  the way the K8s quota controller does, so `kubectl get resourcequota`
+  (our CLI) shows hard next to used.
+
+Semantics: on create of a metered kind, current namespace usage + the
+new object's ask must fit under every named cap, else 422
+(QuotaExceeded); updates re-admit excluding the object's own usage (no
+self-double-count); namespaces without a ResourceQuota are unmetered.
+All arithmetic is integer milli-units (binary floats would spuriously
+reject exact fits). The TpuJob operator turns a quota rejection into a
+`QuotaExceeded` Pending episode instead of a crash-looping partial gang.
 """
 
 from __future__ import annotations
 
+import logging
+
 from kubeflow_tpu.api.objects import (
     Resource,
-    container_limits_total,
+    container_resource_total,
     parse_quantity,
 )
+from kubeflow_tpu.api.rbac import resource_for_kind
 from kubeflow_tpu.testing.fake_apiserver import (
     FakeApiServer,
     Invalid,
     NotFound,
 )
 
-# Resources the platform meters — the full set a Profile's
-# resourceQuotaSpec can cap (the reference's ResourceQuotaSpec is the
-# corev1 type enforced for ALL listed resources by the real apiserver,
-# `profile-controller/api/v1/profile_types.go:36-44`). cpu/memory values
-# are K8s quantities ("500m", "128Gi"); the TPU resource is an integer
-# chip count.
-METERED = ("google.com/tpu", "cpu", "memory")
+log = logging.getLogger(__name__)
+
+QUOTA_NAME = "kf-resource-quota"
+
+# Compute resources meterable per pod (bare key = corev1 shorthand for
+# the requests form).
+COMPUTE = ("cpu", "memory", "google.com/tpu")
+METERED = COMPUTE  # historical alias (round-4 public name)
 
 
 class QuotaExceeded(Invalid):
@@ -57,83 +80,321 @@ def _milli(value) -> int:
     return round(parse_quantity(value) * 1000)
 
 
+def _classify(key: str):
+    """One hard-cap key → ("pod"|"pvc"|"count", detail).
+
+    pod  → (resource, source, strict): compute metering over containers;
+           strict = explicitly-prefixed key → every container must name
+           the figure (K8s "must specify requests.cpu").
+    pvc  → ("count" | "storage")
+    count→ resource string (lowercase plural) counted over live objects.
+    Unknown keys return None — stored but unenforced, like K8s with a
+    quota for a resource class the cluster doesn't run."""
+    if key == "pods":
+        return ("count", "pods")
+    if key == "persistentvolumeclaims":
+        return ("count", "persistentvolumeclaims")
+    if key.startswith("count/"):
+        return ("count", key[len("count/"):])
+    if key == "requests.storage":
+        return ("pvc", "storage")
+    if key in COMPUTE:
+        return ("pod", (key, "requests", False))
+    for prefix, source in (("requests.", "requests"), ("limits.", "limits")):
+        if key.startswith(prefix) and key[len(prefix):] in COMPUTE:
+            return ("pod", (key[len(prefix):], source, True))
+    return None
+
+
+def _pod_compute_ask(pod: Resource, resource: str, source: str,
+                     strict: bool) -> int:
+    """A pod's milli-ask for one compute cap."""
+    if strict:
+        for c in pod.spec.get("containers", []):
+            res = c.get("resources", {})
+            if (
+                res.get("requests", {}).get(resource) is None
+                and res.get("limits", {}).get(resource) is None
+            ):
+                raise Invalid(
+                    f"container {c.get('name')!r} must specify "
+                    f"{source}.{resource}: the namespace quota meters it "
+                    f"(K8s quota admission semantics)"
+                )
+    return round(container_resource_total(pod, resource, source=source) * 1000)
+
+
+def _pvc_storage_milli(pvc: Resource) -> int:
+    ask = (
+        pvc.spec.get("resources", {}).get("requests", {}).get("storage", 0)
+    )
+    return round(parse_quantity(ask) * 1000)
+
+
+def _live(obj: Resource) -> bool:
+    return obj.status.get("phase") not in ("Succeeded", "Failed")
+
+
+def _hard_keys(hard: dict, kind: str) -> list[tuple[str, tuple]]:
+    """The cap keys that meter objects of `kind`, classified. Count
+    classifications are re-bound to the ADMISSION OBJECT'S kind — the
+    one string guaranteed to round-trip (resource_for_kind is lossy for
+    CamelCase kinds, so deriving the kind back from the resource string
+    is not generally possible)."""
+    resource = resource_for_kind(kind)
+    out = []
+    for key in hard:
+        cls = _classify(key)
+        if cls is None:
+            continue
+        family, detail = cls
+        if family == "pod" and kind == "Pod":
+            out.append((key, cls))
+        elif family == "pvc" and kind == "PersistentVolumeClaim":
+            out.append((key, cls))
+        elif family == "count" and detail == resource:
+            out.append((key, ("count", kind)))
+    return out
+
+
+def _object_ask(obj: Resource, cls) -> int:
+    family, detail = cls
+    if family == "count":
+        return 1000  # one object, in millis
+    if family == "pvc":
+        return _pvc_storage_milli(obj)
+    resource, source, strict = detail
+    return _pod_compute_ask(obj, resource, source, strict)
+
+
 def _usage_milli(
     api: FakeApiServer,
     namespace: str,
-    resources: list[str],
-    exclude: str,
+    keys: list[tuple[str, tuple]],
+    exclude_kind: str,
+    exclude_name: str | None,
 ) -> dict[str, int]:
-    """Live usage per metered resource — ONE pod scan for all of them
-    (each list() deepcopies every pod under the store lock; per-resource
-    scans would triple the admission cost)."""
-    used = dict.fromkeys(resources, 0)
-    for pod in api.list("Pod", namespace):
-        if pod.metadata.name == exclude:
-            continue
-        if pod.status.get("phase") in ("Succeeded", "Failed"):
-            continue
-        for resource in resources:
-            try:
-                used[resource] += round(
-                    container_limits_total(pod, resource) * 1000
-                )
-            except ValueError as e:
-                # Name the culprit: a garbage limit on a PRE-EXISTING
-                # pod (admitted before the quota existed) must not be
-                # an anonymous 500 on every later admission.
-                raise ValueError(
-                    f"existing pod {pod.metadata.name!r} has an "
-                    f"unusable {resource!r} limit: {e}"
-                ) from e
+    """Live usage per cap key — one list() per involved kind, not per
+    key (each list() deepcopies every object under the store lock)."""
+    used = {key: 0 for key, _ in keys}
+    by_kind: dict[str, list[tuple[str, tuple]]] = {}
+    for key, cls in keys:
+        family, detail = cls
+        if family == "pod":
+            kind = "Pod"
+        elif family == "pvc":
+            kind = "PersistentVolumeClaim"
+        else:
+            kind = detail  # bound to a stored kind by the caller
+        by_kind.setdefault(kind, []).append((key, cls))
+    for kind, kind_keys in by_kind.items():
+        for obj in api.list(kind, namespace):
+            if kind == exclude_kind and obj.metadata.name == exclude_name:
+                continue
+            if kind == "Pod" and not _live(obj):
+                continue
+            for key, cls in kind_keys:
+                try:
+                    family, detail = cls
+                    if family == "pod":
+                        resource, source, _strict = detail
+                        # Usage never re-applies strictness: a
+                        # pre-existing unmarked pod contributes 0, it
+                        # doesn't wedge every later admission.
+                        used[key] += _pod_compute_ask(
+                            obj, resource, source, False
+                        )
+                    else:
+                        used[key] += _object_ask(obj, cls)
+                except ValueError as e:
+                    raise ValueError(
+                        f"existing {kind} {obj.metadata.name!r} has an "
+                        f"unusable {key!r} figure: {e}"
+                    ) from e
     return used
 
 
-def check_pod(api: FakeApiServer, pod: Resource) -> Resource:
-    """Admission hook: reject the pod if it busts any hard cap."""
-    namespace = pod.metadata.namespace
+def _kinds_for_resource(api, resource: str) -> list[str]:
+    """Stored kinds whose RBAC resource string is `resource` — the
+    count/<resource> inverse, derived from the kinds LIVE in the store
+    (resource_for_kind is lossy for CamelCase, so no static inverse
+    exists). A resource with zero live objects maps to no kind, which
+    is exactly usage 0."""
+    kinds_fn = getattr(api, "kinds", None)
+    kinds = kinds_fn() if kinds_fn is not None else ("Pod",)
+    return [k for k in kinds if resource_for_kind(k) == resource]
+
+
+def check_object(api: FakeApiServer, obj: Resource) -> Resource:
+    """Admission hook: reject the object if it busts any hard cap."""
+    namespace = obj.metadata.namespace
+    if obj.kind == "Pod" and not _live(obj):
+        # Terminal pods contribute zero usage, so they consume zero
+        # quota — K8s excludes them from every pod scope. Without this,
+        # an UPDATE to a finished pod (label edit, status touch) would
+        # be charged as if it were a new live pod while usage correctly
+        # excludes it: a phantom 422 in a full namespace.
+        return obj
     try:
-        rq = api.get("ResourceQuota", "kf-resource-quota", namespace)
+        rq = api.get("ResourceQuota", QUOTA_NAME, namespace)
     except NotFound:
-        return pod  # unmetered namespace
+        return obj  # unmetered namespace
     # Any OTHER read failure propagates: silently skipping the check
     # would turn the caps decorative again — fail closed, not open.
     hard = rq.spec.get("hard", {})
+    keys = _hard_keys(hard, obj.kind)
+    if not keys:
+        return obj
     try:
-        asks = {
-            resource: round(container_limits_total(pod, resource) * 1000)
-            for resource in METERED
-            if resource in hard
-        }
+        asks = {key: _object_ask(obj, cls) for key, cls in keys}
     except ValueError as e:
-        # Garbage/negative limits in a metered namespace are a client
-        # error (422), not an internal one: a negative "limit" would
+        # Garbage/negative figures in a metered namespace are a client
+        # error (422), not an internal one: a negative "request" would
         # SUBTRACT from usage — a quota bypass.
-        raise Invalid(f"pod {pod.metadata.name!r}: {e}") from e
-    asks = {r: a for r, a in asks.items() if a > 0}
-    if not asks:
-        return pod
+        raise Invalid(f"{obj.kind} {obj.metadata.name!r}: {e}") from e
+    active = [(k, cls) for k, cls in keys if asks[k] > 0]
+    if not active:
+        return obj
     try:
         used = _usage_milli(
-            api, namespace, list(asks), exclude=pod.metadata.name
+            api, namespace, active,
+            exclude_kind=obj.kind, exclude_name=obj.metadata.name,
         )
-        caps = {r: _milli(hard[r]) for r in asks}
+        caps = {key: _milli(hard[key]) for key, _ in active}
     except ValueError as e:
         # A malformed CAP (the profile's resourceQuotaSpec passes
-        # through verbatim) or a garbage stored limit: still a 422
+        # through verbatim) or a garbage stored figure: still a 422
         # with the culprit named — never a raw 500 crash-loop.
         raise Invalid(f"quota evaluation in {namespace!r}: {e}") from e
-    for resource, ask in asks.items():
-        if used[resource] + ask > caps[resource]:
+    for key, _cls in active:
+        if used[key] + asks[key] > caps[key]:
             raise QuotaExceeded(
-                f"pod {pod.metadata.name!r} exceeds ResourceQuota "
-                f"{resource!r} in namespace {namespace!r}: "
-                f"used {used[resource] / 1000:g} + requested "
-                f"{ask / 1000:g} > hard cap {hard[resource]}"
+                f"{obj.kind} {obj.metadata.name!r} exceeds ResourceQuota "
+                f"{key!r} in namespace {namespace!r}: "
+                f"used {used[key] / 1000:g} + requested "
+                f"{asks[key] / 1000:g} > hard cap {hard[key]}"
             )
-    return pod
+    return obj
+
+
+def check_pod(api: FakeApiServer, pod: Resource) -> Resource:
+    """Round-4 public name; pods are now one case of check_object."""
+    return check_object(api, pod)
+
+
+def compute_used(api: FakeApiServer, namespace: str, hard: dict) -> dict:
+    """The status.used the K8s quota controller publishes: live usage
+    for every enforceable cap key, in base units (counts as ints, milli
+    figures rendered exactly)."""
+    keys = []
+    count_parts: dict[str, list[str]] = {}
+    for key in hard:
+        cls = _classify(key)
+        if cls is None:
+            continue
+        family, detail = cls
+        if family == "count":
+            # One count cap may need sums over several live kinds that
+            # pluralize to the same resource (normally exactly one).
+            bound = _kinds_for_resource(api, detail)
+            count_parts[key] = [f"{key}\u0000{k}" for k in bound]
+            for k in bound:
+                keys.append((f"{key}\u0000{k}", ("count", k)))
+        else:
+            keys.append((key, cls))
+    used_milli = _usage_milli(api, namespace, keys, "", None)
+    for key, parts in count_parts.items():
+        used_milli[key] = sum(used_milli.pop(p) for p in parts)
+    out = {}
+    for key in list(used_milli):
+        millis = used_milli[key]
+        out[key] = (
+            millis // 1000 if millis % 1000 == 0 else f"{millis}m"
+        )
+    return out
+
+
+def publish_used(api: FakeApiServer, namespace: str) -> None:
+    """Recompute and publish status.used on the namespace's quota (no-op
+    without one, or when unchanged — the handler runs on every pod/PVC
+    event and must not self-amplify)."""
+    try:
+        rq = api.get("ResourceQuota", QUOTA_NAME, namespace)
+    except NotFound:
+        return
+    try:
+        used = compute_used(api, namespace, rq.spec.get("hard", {}))
+    except ValueError:
+        log.debug("unpublishable quota usage in %r", namespace,
+                  exc_info=True)
+        return
+    if rq.status.get("used") == used and "hard" in rq.status:
+        return
+    rq.status["hard"] = dict(rq.spec.get("hard", {}))
+    rq.status["used"] = used
+    try:
+        api.update_status(rq)
+    except Exception:
+        log.debug("quota status publish lost a race", exc_info=True)
 
 
 def register(api: FakeApiServer) -> None:
     """Install quota admission on the store (idempotent hooks are the
-    admission contract; this one only reads)."""
-    api.register_admission(lambda pod: check_pod(api, pod), kind="Pod")
+    admission contract; the check hooks only read) and the status.used
+    publisher (watch-driven, like the K8s quota controller)."""
+    import threading
+    import weakref
+
+    # kind=None: count/<resource> caps can meter ANY stored kind (K8s
+    # object-count quotas do); the per-create cost in an unmetered
+    # namespace is one dict lookup (NotFound on the quota get).
+    api.register_admission(lambda o: check_object(api, o))
+
+    # status.used publishing is DEBOUNCED onto its own thread: the watch
+    # handler only marks the namespace dirty. Publishing inline on the
+    # store's dispatcher thread would run a full O(objects) recompute
+    # per event — a 64-pod gang create would pay O(N^2) quota
+    # bookkeeping while every other controller's events queue behind it
+    # (the K8s quota controller is likewise an async, coalescing
+    # worker). The dirty-set dedupe also absorbs the publisher's own
+    # ResourceQuota MODIFIED echo: the follow-up recompute no-ops.
+    dirty: set[str] = set()
+    cv = threading.Condition()
+    # The thread must not keep the store alive: it holds only a weakref
+    # and exits once every outside reference drops (tests build many
+    # stores; an immortal closure would pin each one plus its thread).
+    api_ref = weakref.ref(api)
+
+    def _republish(event: str, obj: Resource) -> None:
+        # Any metered kind can move usage (count/<resource> caps cover
+        # arbitrary kinds), so listen to everything and let publish_used
+        # no-op fast for unmetered namespaces. Events are excluded: they
+        # are never meterable (record_event names collide) and are the
+        # one high-volume kind.
+        if obj.kind != "Event" and obj.metadata.namespace:
+            with cv:
+                dirty.add(obj.metadata.namespace)
+                cv.notify()
+
+    def _publisher() -> None:
+        while True:
+            with cv:
+                if not dirty:
+                    cv.wait(1.0)  # bounded: liveness check below
+                batch = sorted(dirty)
+                dirty.clear()
+            target = api_ref()
+            if target is None:
+                return  # store was released; let the thread die with it
+            for ns in batch:
+                try:
+                    publish_used(target, ns)
+                except Exception:
+                    log.debug("quota status publish failed for %r", ns,
+                              exc_info=True)
+            del target
+
+    threading.Thread(
+        target=_publisher, name="quota-status-publisher", daemon=True
+    ).start()
+    api.watch(_republish)

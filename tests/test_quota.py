@@ -351,3 +351,187 @@ def test_garbage_cap_or_stored_limit_is_422_not_500():
     api.delete("Pod", "old", "team")
     with pytest.raises(Invalid, match="lots"):
         api.create(_host_pod("new2", cpu="1"))
+
+
+# -- round 5: full ResourceQuotaSpec scope ----------------------------------
+
+
+def _wait_used(api, pred, ns="team", timeout=5.0):
+    """status.used publishes asynchronously (debounced publisher thread);
+    poll for the expected value."""
+    import time as _t
+
+    deadline = _t.monotonic() + timeout
+    while _t.monotonic() < deadline:
+        api.flush()
+        rq = api.get("ResourceQuota", "kf-resource-quota", ns)
+        if pred(rq.status):
+            return rq
+        _t.sleep(0.02)
+    raise AssertionError(f"status.used never converged: {rq.status}")
+
+
+def _hard(api, hard, ns="team"):
+    api.create(new_resource(
+        "ResourceQuota", "kf-resource-quota", ns, spec={"hard": hard},
+    ))
+
+
+def _pvc(name, storage="10Gi", ns="team"):
+    return new_resource(
+        "PersistentVolumeClaim", name, ns,
+        spec={"resources": {"requests": {"storage": storage}}},
+    )
+
+
+def _pod_rr(name, ns="team", requests=None, limits=None):
+    res = {}
+    if requests:
+        res["requests"] = requests
+    if limits:
+        res["limits"] = limits
+    return new_resource(
+        "Pod", name, ns,
+        spec={"containers": [{"name": "w", "resources": res}]},
+    )
+
+
+def test_requests_only_pod_is_metered():
+    """THE round-4 hole: a pod sized via requests (no limits) slipped
+    every cap. Bare keys are the corev1 requests shorthand and meter it."""
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"cpu": "2"})
+    api.create(_pod_rr("a", requests={"cpu": "1500m"}))
+    with pytest.raises(QuotaExceeded, match="used 1.5 \\+ requested 1"):
+        api.create(_pod_rr("b", requests={"cpu": "1"}))
+
+
+def test_requests_default_from_limits():
+    """K8s defaulting: a limits-only pod counts against requests caps
+    (absent requests inherit limits) — round-4 behavior preserved."""
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"requests.memory": "1Gi"})
+    api.create(_pod_rr("a", limits={"memory": "768Mi"}))
+    with pytest.raises(QuotaExceeded):
+        api.create(_pod_rr("b", limits={"memory": "512Mi"}))
+
+
+def test_limits_cap_meters_limits_and_requests_fallback():
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"limits.cpu": "4"})
+    api.create(_pod_rr("a", limits={"cpu": "3"}))
+    # requests-only pod still counts against a limits cap (the symmetric
+    # bypass, closed via the documented fallback relaxation).
+    with pytest.raises(QuotaExceeded):
+        api.create(_pod_rr("b", requests={"cpu": "2"}))
+
+
+def test_prefixed_cap_requires_specification():
+    """K8s quota admission: under an explicit requests.cpu cap, a pod
+    naming neither requests nor limits for cpu is rejected outright —
+    unmeterable pods can't fly under the cap."""
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"requests.cpu": "4"})
+    with pytest.raises(Invalid, match="must specify requests.cpu"):
+        api.create(_pod_rr("naked"))
+    # Bare-key caps tolerate it (chips-only gang pods under a cpu cap).
+    api2 = FakeApiServer()
+    quota.register(api2)
+    _hard(api2, {"cpu": "4"}, ns="team")
+    api2.create(_pod_rr("naked"))
+
+
+def test_pod_count_quota():
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"pods": 2})
+    api.create(_pod_rr("a"))
+    api.create(_pod_rr("b"))
+    with pytest.raises(QuotaExceeded, match="'pods'"):
+        api.create(_pod_rr("c"))
+    # Terminal pods release count budget.
+    done = api.get("Pod", "a", "team")
+    done.status["phase"] = "Failed"
+    api.update_status(done)
+    api.create(_pod_rr("c"))
+
+
+def test_pvc_count_quota_rejects_nplus1():
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"persistentvolumeclaims": 2})
+    api.create(_pvc("v1"))
+    api.create(_pvc("v2"))
+    with pytest.raises(QuotaExceeded, match="persistentvolumeclaims"):
+        api.create(_pvc("v3"))
+    api.delete("PersistentVolumeClaim", "v1", "team")
+    api.create(_pvc("v3"))  # freed
+
+
+def test_requests_storage_quota():
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"requests.storage": "30Gi"})
+    api.create(_pvc("v1", "20Gi"))
+    with pytest.raises(QuotaExceeded, match="requests.storage"):
+        api.create(_pvc("v2", "20Gi"))
+    api.create(_pvc("v2", "10Gi"))  # exact fit
+
+
+def test_generic_count_quota():
+    """count/<resource> meters any stored kind (K8s object-count
+    quotas), including CamelCase kinds via the explicit inverse map."""
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"count/notebooks": 1, "count/tpujobs": 1})
+    api.create(new_resource("Notebook", "nb1", "team", spec={}))
+    with pytest.raises(QuotaExceeded, match="count/notebooks"):
+        api.create(new_resource("Notebook", "nb2", "team", spec={}))
+    api.create(make_tpujob("j1", replicas=1, namespace="team"))
+    with pytest.raises(QuotaExceeded, match="count/tpujobs"):
+        api.create(make_tpujob("j2", replicas=1, namespace="team"))
+
+
+def test_status_used_published():
+    """The K8s quota controller's status surface: hard + used appear on
+    the quota object and track pod/PVC lifecycle."""
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"cpu": "4", "pods": 5, "requests.storage": "100Gi",
+                "persistentvolumeclaims": 3})
+    api.create(_pod_rr("a", requests={"cpu": "1500m"}))
+    api.create(_pvc("v1", "10Gi"))
+    rq = _wait_used(
+        api,
+        lambda st: st.get("used", {}).get("pods") == 1
+        and st.get("used", {}).get("persistentvolumeclaims") == 1,
+    )
+    assert rq.status["hard"]["pods"] == 5
+    assert rq.status["used"]["cpu"] == "1500m"
+    assert rq.status["used"]["persistentvolumeclaims"] == 1
+    assert rq.status["used"]["requests.storage"] == 10 * 1024 ** 3
+    api.delete("Pod", "a", "team")
+    rq = _wait_used(api, lambda st: st.get("used", {}).get("pods") == 0)
+    assert rq.status["used"]["cpu"] == 0
+
+
+def test_update_to_terminal_pod_is_not_charged():
+    """K8s excludes terminal pods from every quota scope: an UPDATE to a
+    finished pod in a FULL namespace must not be rejected as if it were
+    a new live pod (usage correctly excludes it; the ask must too)."""
+    api = FakeApiServer()
+    quota.register(api)
+    _hard(api, {"pods": 1, "cpu": "1"})
+    api.create(_pod_rr("live", requests={"cpu": "1"}))
+    done = _pod_rr("done", requests={"cpu": "1"})
+    done.status["phase"] = "Succeeded"
+    # Create of an already-terminal pod (runtime materialization) and
+    # updates to it are both exempt.
+    api.create(done)
+    fresh = api.get("Pod", "done", "team")
+    fresh.metadata.labels["archived"] = "yes"
+    api.update(fresh)
