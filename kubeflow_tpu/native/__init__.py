@@ -1,1 +1,6 @@
-from kubeflow_tpu.native.scheduler import GangScheduler, PlacementError
+from kubeflow_tpu.native.scheduler import (
+    GangScheduler,
+    PlacementError,
+    PyGangScheduler,
+    make_gang_scheduler,
+)

@@ -32,7 +32,10 @@ def world():
 
 
 def _add_node(api, name, ready=True):
-    node = new_resource("Node", name, spec={"pool": "v5e", "chips": 4})
+    # 8 chips: since round 5 even topology-less gangs place through the
+    # compiled scheduler, so a RECREATED 2x4-chip gang must actually fit
+    # on the surviving node(s) — phantom unplaced pods are gone.
+    node = new_resource("Node", name, spec={"pool": "v5e", "chips": 8})
     node.status["ready"] = ready
     created = api.create(node)
     fresh = api.get("Node", name)
@@ -174,11 +177,23 @@ def test_exhausted_restarts_terminal(world):
                 api.update_status(fresh)
         _drain(health, jobs)
 
+    def hosts():
+        # Where the gang actually runs — placement (compiled scheduler)
+        # chooses, so the test kills whatever node hosts pods instead of
+        # assuming a binding.
+        return sorted({
+            p.spec["nodeName"]
+            for p in api.list("Pod", "ml",
+                              label_selector={LABEL_JOB: "train"})
+            if p.spec.get("nodeName")
+        })
+
     _add_node(api, "spare")
-    kill_and_drain("n1")      # restart 1 (pods land on n0 + spare)
+    kill_and_drain(hosts()[0])  # restart 1 (gang re-places on survivors)
     assert api.get("TpuJob", "train", "ml").status["restarts"] == 1
-    kill_and_drain("spare")   # restart 2 — at maxRestarts
+    kill_and_drain(hosts()[0])  # restart 2 — at maxRestarts
     assert api.get("TpuJob", "train", "ml").status["restarts"] == 2
-    api.delete("Node", "n0")  # no budget left
+    for node in hosts():        # no budget left
+        api.delete("Node", node)
     _drain(health, jobs)
     assert api.get("TpuJob", "train", "ml").status["phase"] == "Failed"
