@@ -41,11 +41,27 @@ class Servable:
     variables: Any
     version: int = 1
     max_batch: int = 64
+    # Pin execution to a specific device (e.g. jax.devices("cpu")[0] for
+    # a frontend-co-located executor, or benchmarking the serving stack
+    # without a tunneled accelerator in the loop). None = default device.
+    device: Any = None
 
     def __post_init__(self):
-        self.variables = jax.device_put(self.variables)
+        self.variables = (
+            jax.device_put(self.variables, self.device)
+            if self.device is not None
+            else jax.device_put(self.variables)
+        )
         self._jitted = jax.jit(self.apply_fn)
         self._bucket_sizes = _buckets(self.max_batch)
+
+    def _to_device(self, batch) -> jax.Array:
+        if self.device is not None:
+            # Straight host→device placement: jnp.asarray first would
+            # round-trip through the DEFAULT device (the tunneled TPU)
+            # before landing on the pinned one.
+            return jax.device_put(batch, self.device)
+        return jnp.asarray(batch)
 
     @classmethod
     def from_module(
@@ -57,6 +73,7 @@ class Servable:
         version: int = 1,
         max_batch: int = 64,
         warmup_example=None,
+        device=None,
         **apply_kwargs,
     ) -> "Servable":
         """Wrap a flax module (``module.apply``) as a servable. Pass
@@ -67,7 +84,8 @@ class Servable:
             return module.apply(variables, batch, **apply_kwargs)
 
         servable = cls(
-            name, apply_fn, variables, version=version, max_batch=max_batch
+            name, apply_fn, variables, version=version,
+            max_batch=max_batch, device=device,
         )
         if warmup_example is not None:
             servable.warmup_with(warmup_example)
@@ -136,7 +154,7 @@ class Servable:
         if bucket != n:
             pad = np.zeros((bucket - n, *batch.shape[1:]), batch.dtype)
             batch = np.concatenate([batch, pad], axis=0)
-        out = self._jitted(self.variables, jnp.asarray(batch))
+        out = self._jitted(self.variables, self._to_device(batch))
         return np.asarray(out)[:n]
 
     def warmup_with(self, example_instance) -> None:
@@ -145,4 +163,6 @@ class Servable:
         one = np.asarray(example_instance)[None]
         for b in self._bucket_sizes:
             batch = np.repeat(one, b, axis=0)
-            self._jitted(self.variables, jnp.asarray(batch)).block_until_ready()
+            self._jitted(
+                self.variables, self._to_device(batch)
+            ).block_until_ready()
