@@ -63,11 +63,41 @@ ALIASES = {
     "deployment": "Deployment", "deployments": "Deployment",
     "statefulset": "StatefulSet", "statefulsets": "StatefulSet",
     "sts": "StatefulSet",
+    "configmap": "ConfigMap", "configmaps": "ConfigMap", "cm": "ConfigMap",
+    "secret": "Secret", "secrets": "Secret",
+    "namespace": "Namespace", "namespaces": "Namespace", "ns": "Namespace",
+    "serviceaccount": "ServiceAccount", "serviceaccounts": "ServiceAccount",
+    "sa": "ServiceAccount",
+    "resourcequota": "ResourceQuota", "resourcequotas": "ResourceQuota",
+    "quota": "ResourceQuota",
+    "lease": "Lease", "leases": "Lease",
+    "virtualservice": "VirtualService", "virtualservices": "VirtualService",
+    "vs": "VirtualService",
+    "role": "Role", "roles": "Role",
+    "rolebinding": "RoleBinding", "rolebindings": "RoleBinding",
+    "clusterrole": "ClusterRole", "clusterroles": "ClusterRole",
+    "clusterrolebinding": "ClusterRoleBinding",
+    "clusterrolebindings": "ClusterRoleBinding",
 }
 
 
 def resolve_kind(raw: str) -> str:
-    return ALIASES.get(raw.lower(), raw)
+    """kubectl-style kind resolution: aliases/plurals first, then a
+    generic lowercase-plural fallback (`somethings` → `Something`) so a
+    kind missing from the table still lists as SOME cased guess instead
+    of silently querying an empty lowercase kind — a `get configmaps`
+    watching the nonexistent kind "configmaps" looks exactly like a
+    quiet cluster."""
+    lower = raw.lower()
+    if lower in ALIASES:
+        return ALIASES[lower]
+    if raw != lower or not raw:
+        return raw  # already cased (a Kind name) or empty
+    if lower.endswith("ies"):
+        return lower[:-3].capitalize() + "y"
+    if lower.endswith("s"):
+        return lower[:-1].capitalize()
+    return lower.capitalize()
 
 
 def _emit(obj, fmt: str) -> None:
