@@ -81,15 +81,18 @@ def main() -> None:
     parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument(
         "--remat-policy",
-        choices=("auto", "full", "dots", "attn", "mlp"),
+        choices=("auto", "none", "full", "dots", "attn", "mlp"),
         default="auto",
-        help="lm only: per-block checkpoint policy. auto = mlp (remat "
-        "only the MLP half; attention residuals saved, so the flash "
-        "forward is never re-run in the backward — measured fastest at "
-        "EVERY seq length: 58.0%% MFU at 2k, 55.9%% at 8k, 52.2%% at "
-        "16k with bs=2 after lse slimming — the bs=1 policy-comparison "
-        "run measured 50.7%% — vs 57.2/47.2/42.2 for the old dots/full "
-        "auto). dots spills at long S; full re-runs flash fwd in bwd",
+        help="lm only: per-block checkpoint policy. auto = none (no "
+        "remat at all — every activation saved) at S<=8192 with the "
+        "default measured-best batches, where it measures fastest "
+        "(63.2%% MFU at 2k bs=8, 59.5%% at 4k bs=4, 58.0%% at 8k bs=2 "
+        "with bf16 adam mu), and mlp otherwise (remat only the MLP "
+        "half; attention residuals saved so the flash forward never "
+        "re-runs in the backward) — at 16k no-remat's saved "
+        "activations crowd out the batch (51.9%% mlp vs 50.8%% none "
+        "at bs=2). dots spills at long S; full re-runs flash fwd in "
+        "bwd",
     )
     parser.add_argument(
         "--flash-block-q", type=int, default=None,
@@ -655,7 +658,14 @@ def bench_lm(args) -> None:
         d_ff=4096,
         attention_impl="auto",  # flash on TPU at these shapes
         remat_policy=(
-            "mlp" if args.remat_policy == "auto" else args.remat_policy
+            # no-remat is only validated at the measured-best default
+            # batches (8@2k/4@4k/2@8k); a user-chosen batch keeps the
+            # memory-safe mlp policy rather than trading their run for
+            # an HBM OOM.
+            ("none" if args.seq_len <= 8192 and args.batch_size is None
+             else "mlp")
+            if args.remat_policy == "auto"
+            else args.remat_policy
         ),
         **(
             {"flash_block_q": args.flash_block_q}

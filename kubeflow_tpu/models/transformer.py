@@ -45,12 +45,20 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     # Rematerialization policy for the per-block checkpoint:
-    #   "full" — save only block boundaries, recompute everything (lowest
-    #            memory; the long-context default);
+    #   "none" — no remat anywhere, every activation saved (fastest
+    #            WHEN it fits HBM: +7% over "mlp" at S<=8192 with the
+    #            bench's measured-best batches);
+    #   "mlp"  — remat only the MLP half; attention residuals (q/k/v,
+    #            o, lse) stay saved so the flash forward never re-runs
+    #            in the backward (the long-context winner at 16k);
+    #   "full" — save only block boundaries, recompute everything
+    #            (lowest memory);
     #   "dots" — save matmul outputs, recompute elementwise/norm only
     #            (jax.checkpoint_policies.dots_with_no_batch_dims_saveable;
-    #            ~1.1x step speedup when activations fit — see
-    #            docs/architecture.md LM roofline).
+    #            spills at long S);
+    #   "attn" — pin only the attention output (measured-neutral: the
+    #            custom-VJP's lse residual is out of the policy's
+    #            reach). See docs/architecture.md LM roofline.
     remat_policy: str = "full"
     # Attention kernel for the non-ring path: "auto" uses the Pallas flash
     # kernel on TPU when the shapes divide into flash blocks, else the
@@ -76,7 +84,13 @@ class TransformerConfig:
 
 def _block_cls(cfg: "TransformerConfig"):
     """Block, wrapped per the config's remat policy."""
-    if not cfg.remat:
+    if not cfg.remat or cfg.remat_policy == "none":
+        # No rematerialization anywhere: every activation is saved. The
+        # fastest policy WHEN the activations fit HBM — measured +7%
+        # tokens/s over "mlp" at S=2048/bs=8 through S=8192/bs=2 on
+        # 1xv5e (the recompute tax "mlp" still pays on its MLP half);
+        # "mlp" retakes the lead at S=16384 where the saved activations
+        # crowd out the batch (docs/architecture.md roofline).
         return Block
     if cfg.remat_policy == "dots":
         return nn.remat(

@@ -64,6 +64,13 @@ class TrainConfig:
     # logits readback per step, which production LM trainers skip);
     # "loss" returns the objective only. Eval always computes both.
     train_metrics: str = "full"
+    # adamw first-moment dtype. The optimizer step is pure HBM
+    # bandwidth (measured 677 GB/s = 83% of v5e peak on the 350M LM
+    # bench); storing mu in bf16 halves its read+write traffic for a
+    # measured +1.1% step throughput with no observable loss impact —
+    # the MaxText default. The second moment stays f32 (it accumulates
+    # squares; bf16 there costs real precision). "float32" opts out.
+    adam_mu_dtype: str = "bfloat16"
 
     def __post_init__(self) -> None:
         # A typo ("Full", "all") would silently behave as "loss" and drop
@@ -75,6 +82,11 @@ class TrainConfig:
             )
         if self.optimizer not in ("sgd", "adamw"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.adam_mu_dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"adam_mu_dtype must be 'bfloat16' or 'float32', got "
+                f"{self.adam_mu_dtype!r}"
+            )
 
 
 def decay_mask(params) -> Any:
@@ -96,7 +108,13 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
             optax.sgd(schedule, momentum=config.momentum, nesterov=True),
         )
     if config.optimizer == "adamw":
-        return optax.adamw(schedule, weight_decay=config.weight_decay)
+        return optax.adamw(
+            schedule,
+            weight_decay=config.weight_decay,
+            mu_dtype=jnp.bfloat16
+            if config.adam_mu_dtype == "bfloat16"
+            else jnp.float32,
+        )
     raise ValueError(f"unknown optimizer {config.optimizer!r}")
 
 

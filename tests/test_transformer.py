@@ -168,8 +168,10 @@ def test_fused_cross_entropy_matches_onehot_formulation():
 
 
 def test_remat_policies_agree():
-    """Remat policies ('dots', 'attn', 'mlp') are performance knobs, not
-    semantics: same logits, same grads, same param tree as 'full'."""
+    """Remat policies ('none', 'dots', 'attn', 'mlp') are performance
+    knobs, not semantics: same logits, same grads, same param tree as
+    'full'. 'none' matters most — it is bench auto's short-context
+    default."""
     cfg_full = TransformerConfig(
         vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
         d_ff=64, remat_policy="full", attention_impl="dense",
@@ -177,7 +179,7 @@ def test_remat_policies_agree():
     tokens = jnp.arange(2 * 8, dtype=jnp.int32).reshape(2, 8) % 64
 
     out = {}
-    for name in ("full", "dots", "attn", "mlp"):
+    for name in ("full", "none", "dots", "attn", "mlp"):
         cfg = dataclasses.replace(cfg_full, remat_policy=name)
         model = TransformerLM(cfg)
         params = model.init(jax.random.PRNGKey(0), tokens)
@@ -191,7 +193,7 @@ def test_remat_policies_agree():
     ref_paths = [
         p for p, _ in jax.tree_util.tree_leaves_with_path(ref_grads)
     ]
-    for name in ("dots", "attn", "mlp"):
+    for name in ("none", "dots", "attn", "mlp"):
         assert jnp.allclose(ref_loss, out[name][0], atol=1e-4), name
         # The lifted transforms must not move params ('mlp' wraps a
         # submodule — a renamed path would orphan every checkpoint).
