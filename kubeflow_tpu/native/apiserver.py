@@ -35,7 +35,7 @@ def _to_resource(d: dict) -> Resource:
 
 
 class NativeApiServer:
-    def __init__(self):
+    def __init__(self, journal_size: int = 10_000):
         self._store = core.NativeStore()
         self._cursor = 0
         self._watchers: list[tuple[str | None, WatchHandler]] = []
@@ -44,6 +44,16 @@ class NativeApiServer:
         # with concurrent controller threads (the C++ store is itself
         # thread-safe; this lock is only about dispatch ordering).
         self._dispatch_lock = threading.RLock()
+        # Resumable event journal — the same bounded
+        # (resourceVersion, event, object) surface FakeApiServer keeps,
+        # fed from the C++ store's journal in _drain_events, so the HTTP
+        # facade's watch endpoints (long-poll AND streaming) serve this
+        # backend identically (drop-in means behind the facade too).
+        self._journal: list[tuple[int, str, Resource]] = []
+        self._journal_size = journal_size
+        self._journal_cv = threading.Condition(self._dispatch_lock)
+        self._rv = 0
+        self._floor = 0
 
     # -- admission --------------------------------------------------------
 
@@ -71,9 +81,65 @@ class NativeApiServer:
         self._store.trim(cursor)
         for ev in events:
             obj = _to_resource(ev["object"])
+            with self._journal_cv:
+                rv = obj.metadata.resource_version
+                self._rv = max(self._rv, rv)
+                # obj is exclusively ours (fresh _to_resource; handlers
+                # and journal readers each get their own deepcopy) — no
+                # defensive copy on the mutation hot path.
+                self._journal.append((rv, ev["type"], obj))
+                if len(self._journal) > self._journal_size:
+                    del self._journal[: -self._journal_size]
+                self._journal_cv.notify_all()
             for kind, handler in list(self._watchers):
                 if kind is None or kind == obj.kind:
                     handler(ev["type"], obj.deepcopy())
+
+    @property
+    def current_rv(self) -> int:
+        with self._dispatch_lock:
+            return self._rv
+
+    def events_since(
+        self,
+        resource_version: int,
+        kind: str | None = None,
+        namespace: str | None = None,
+    ) -> tuple[list[tuple[int, str, Resource]], int]:
+        """FakeApiServer's journal contract — the shared
+        select_journal_events, so the 410 horizon math is one
+        implementation across backends."""
+        from kubeflow_tpu.testing.fake_apiserver import (
+            select_journal_events,
+        )
+
+        with self._dispatch_lock:
+            return select_journal_events(
+                self._journal, self._floor, self._rv,
+                resource_version, kind, namespace,
+            )
+
+    def wait_events(
+        self,
+        resource_version: int,
+        kind: str | None = None,
+        namespace: str | None = None,
+        timeout: float = 10.0,
+    ) -> tuple[list[tuple[int, str, Resource]], int]:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._journal_cv:
+            while True:
+                events, rv = self.events_since(
+                    resource_version, kind=kind, namespace=namespace
+                )
+                if events:
+                    return events, rv
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return [], rv
+                self._journal_cv.wait(remaining)
 
     def _translate(self, err: core.StoreError) -> Exception:
         msg = str(err)

@@ -207,7 +207,11 @@ class NativeStore:
             self._lib.kftpu_store_list(
                 self._handle,
                 kind.encode(),
-                (namespace or "").encode(),
+                # None = all namespaces (NULL at the ABI); "" = exactly
+                # the cluster scope — the two must stay distinct or
+                # list("Lease", namespace="") returns every tenant's
+                # leases (FakeApiServer parity).
+                None if namespace is None else namespace.encode(),
                 _json.dumps(label_selector).encode() if label_selector else None,
             )
         )

@@ -83,6 +83,40 @@ def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def select_journal_events(
+    journal,
+    floor: int,
+    current_rv: int,
+    resource_version: int,
+    kind: str | None,
+    namespace: str | None,
+):
+    """The journal read contract, shared by BOTH store backends (the
+    caller holds its store's lock): entries with rv > resource_version,
+    filtered by kind/namespace, plus the rv to resume from; Gone when
+    the bookmark predates the floor or the journal's trimmed horizon.
+    One implementation so the 410 math can never drift between the
+    Python and native apiservers."""
+    if resource_version < floor:
+        raise Gone(
+            f"resourceVersion {resource_version} predates this "
+            f"server's history (floor {floor}) — relist"
+        )
+    if journal and resource_version < journal[0][0] - 1:
+        raise Gone(
+            f"resourceVersion {resource_version} is too old "
+            f"(journal begins at {journal[0][0]})"
+        )
+    out = [
+        (rv, event, obj.deepcopy())
+        for rv, event, obj in journal
+        if rv > resource_version
+        and (kind is None or obj.kind == kind)
+        and (namespace is None or obj.metadata.namespace == namespace)
+    ]
+    return out, current_rv
+
+
 def check_lease_guard(get_lease_spec, guard, kind: str) -> None:
     """Write fencing, shared by BOTH store backends (the caller holds
     its store's commit lock, so the check is atomic with the write): a
@@ -636,27 +670,10 @@ class FakeApiServer:
         the filter). Raises Gone when the bookmark predates the journal."""
         with self._lock:
             self._check_available()
-            if resource_version < self._floor:
-                raise Gone(
-                    f"resourceVersion {resource_version} predates this "
-                    f"server's history (floor {self._floor}) — relist"
-                )
-            if self._journal and resource_version < self._journal[0][0] - 1:
-                raise Gone(
-                    f"resourceVersion {resource_version} is too old "
-                    f"(journal begins at {self._journal[0][0]})"
-                )
-            out = [
-                (rv, event, obj.deepcopy())
-                for rv, event, obj in self._journal
-                if rv > resource_version
-                and (kind is None or obj.kind == kind)
-                and (
-                    namespace is None
-                    or obj.metadata.namespace == namespace
-                )
-            ]
-            return out, self._rv
+            return select_journal_events(
+                self._journal, self._floor, self._rv,
+                resource_version, kind, namespace,
+            )
 
     def wait_events(
         self,

@@ -152,6 +152,23 @@ static void TestStoreCrud() {
   CHECK(out && MustParse(out).get("spec").get("workers").as_number() == 4);
   CHECK(kftpu_store_get(s, "TpuJob", "ml", "nope") == nullptr);
   CHECK(kftpu_store_status() == KFTPU_STORE_NOT_FOUND);
+  // Cluster scope: namespace "" is a real scope of its own (Leases,
+  // Nodes, ClusterRoles), NOT an alias for "default" — get/delete must
+  // round-trip it exactly (FakeApiServer parity).
+  CHECK(kftpu_store_create(
+            s, R"({"kind":"Lease","metadata":{"name":"ha","namespace":""}})") !=
+        nullptr);
+  CHECK(kftpu_store_get(s, "Lease", "", "ha") != nullptr);
+  CHECK(kftpu_store_get(s, "Lease", "default", "ha") == nullptr);
+  CHECK(kftpu_store_status() == KFTPU_STORE_NOT_FOUND);
+  // List: "" selects ONLY cluster scope; nullptr selects everything.
+  out = kftpu_store_list(s, "Lease", "", nullptr);
+  CHECK(out && MustParse(out).as_array().size() == 1);
+  out = kftpu_store_list(s, "TpuJob", "", nullptr);
+  CHECK(out && MustParse(out).as_array().size() == 0);
+  out = kftpu_store_list(s, "TpuJob", nullptr, nullptr);
+  CHECK(out && MustParse(out).as_array().size() == 1);
+  CHECK(kftpu_store_delete(s, "Lease", "", "ha") == 0);
   // Spec update bumps generation + rv; stale rv conflicts. Metadata
   // fields are replaced from the incoming object, so labels must ride
   // along (same replace semantics as the Python store).

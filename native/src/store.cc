@@ -135,7 +135,10 @@ class Store {
   }
 
   const char* Get(const char* kind, const char* ns, const char* name) {
-    Key key{kind ? kind : "", ns && *ns ? ns : "default", name ? name : ""};
+    // Exact namespace match: "" IS the cluster scope (FakeApiServer
+    // parity) — coercing it to "default" made cluster-scoped objects
+    // (Leases, Nodes, ClusterRoles) unreachable by get/delete.
+    Key key{kind ? kind : "", ns ? ns : "", name ? name : ""};
     std::lock_guard<std::mutex> lock(mu_);
     auto it = objects_.find(key);
     if (it == objects_.end())
@@ -211,13 +214,15 @@ class Store {
       if (!Json::Parse(selector_json, &selector, &err))
         return Err(KFTPU_STORE_BAD_OBJECT, "selector parse: " + err);
     }
-    std::string want_ns = ns ? ns : "";
+    // ns == nullptr means ALL namespaces; ns == "" is the cluster
+    // scope, matched exactly like any other namespace (Get/Delete
+    // semantics; FakeApiServer parity).
     JsonArray out;
     {
       std::lock_guard<std::mutex> lock(mu_);
       for (const auto& [key, obj] : objects_) {
         if (std::get<0>(key) != (kind ? kind : "")) continue;
-        if (!want_ns.empty() && std::get<1>(key) != want_ns) continue;
+        if (ns != nullptr && std::get<1>(key) != ns) continue;
         if (!LabelsMatch(obj, selector)) continue;
         out.push_back(obj);
       }
@@ -226,7 +231,7 @@ class Store {
   }
 
   int32_t Delete(const char* kind, const char* ns, const char* name) {
-    Key key{kind ? kind : "", ns && *ns ? ns : "default", name ? name : ""};
+    Key key{kind ? kind : "", ns ? ns : "", name ? name : ""};
     std::lock_guard<std::mutex> lock(mu_);
     return DeleteLocked(key);
   }
@@ -292,7 +297,9 @@ class Store {
       last_removed_ = stored;
       Remove(key, /*emit_delete=*/false);
       // The caller's update cleared the last finalizer of a
-      // deletion-pending object: that update IS the deletion.
+      // deletion-pending object: that update IS the deletion. The
+      // finalizing update already bumped rv onto last_removed_, so the
+      // DELETED event is journal-ordered without another bump.
       Append("DELETED", last_removed_);
       return true;
     }
@@ -302,7 +309,17 @@ class Store {
   void Remove(const Key& key, bool emit_delete) {
     Json obj = objects_.at(key);
     objects_.erase(key);
-    if (emit_delete) Append("DELETED", obj);
+    if (emit_delete) {
+      // Deletion is a state transition of its own: stamp the DELETED
+      // event with a FRESH rv (FakeApiServer._remove parity) so a
+      // watcher resuming from the object's last-seen version still
+      // observes the removal — with the stale rv, events_since(rv)
+      // would silently skip it and the watcher caches the object
+      // forever.
+      Meta(obj).as_object()["resourceVersion"] =
+          Json(static_cast<int64_t>(++rv_));
+      Append("DELETED", obj);
+    }
     Cascade(obj);
     if (std::get<0>(key) == "Namespace") DrainNamespace(std::get<2>(key));
   }
