@@ -1,0 +1,145 @@
+"""The controller-manager binary: every platform controller in one
+process against a remote facade, with optional leader election.
+
+The reference deploys each controller as a manager binary built by
+kubebuilder (`notebook-controller/main.go:51-62` — flags, metrics,
+`-enable-leader-election`); our platform launcher runs the same
+controllers in-process for the single-binary dev experience. This module
+is the PRODUCTION shape in between: N replicas of
+
+    python -m kubeflow_tpu.controllers \
+        --apiserver https://<facade> --leader-elect
+
+run with exactly one active (Lease + fencing, `controllers/leader.py`),
+reconciling over the keep-alive HTTP client's streaming watch. On
+leadership loss the process exits 2 — a deposed manager's in-flight
+state belongs to a dead term, so the supervisor restarts a fresh
+standby (client-go's RunOrDie posture).
+
+Credentials ride the launcher env contract: KFTPU_TOKEN + KFTPU_CA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from kubeflow_tpu.controllers.cronworkflow import CronWorkflowController
+from kubeflow_tpu.controllers.nodehealth import NodeHealthController
+from kubeflow_tpu.controllers.notebook import NotebookController
+from kubeflow_tpu.controllers.profile import ProfileController
+from kubeflow_tpu.controllers.runtime import ControllerManager
+from kubeflow_tpu.controllers.study import StudyController
+from kubeflow_tpu.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.controllers.tpujob import TpuJobController
+from kubeflow_tpu.controllers.workflow import WorkflowController
+
+CONTROLLERS = {
+    "profile": ProfileController,
+    "notebook": NotebookController,
+    "tensorboard": TensorboardController,
+    "tpujob": TpuJobController,
+    "nodehealth": NodeHealthController,
+    "study": StudyController,
+    "workflow": WorkflowController,
+    "cronworkflow": CronWorkflowController,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    from kubeflow_tpu.controllers.leader import LeaderElector
+    from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+    from kubeflow_tpu.utils import signals as sigutil
+
+    parser = argparse.ArgumentParser(prog="kubeflow-tpu-controllers")
+    parser.add_argument(
+        "--apiserver", required=True,
+        help="facade URL (token via KFTPU_TOKEN, CA via KFTPU_CA)",
+    )
+    parser.add_argument(
+        "--controllers", default=",".join(CONTROLLERS),
+        help="comma-separated subset to run (default: all)",
+    )
+    parser.add_argument(
+        "--leader-elect", action="store_true",
+        help="N replicas, one active: block in standby until the Lease "
+        "is acquired; arm write fencing; exit 2 on leadership loss so "
+        "the supervisor restarts fresh",
+    )
+    parser.add_argument("--lease-name", default="controller-manager")
+    parser.add_argument(
+        "--identity", default=None,
+        help="leader-election identity (default: controllers-<pid>)",
+    )
+    parser.add_argument("--lease-duration", type=float, default=15.0)
+    parser.add_argument("--renew-deadline", type=float, default=10.0)
+    parser.add_argument("--retry-period", type=float, default=2.0)
+    args = parser.parse_args(argv)
+
+    names = [n.strip() for n in args.controllers.split(",") if n.strip()]
+    unknown = [n for n in names if n not in CONTROLLERS]
+    if unknown:
+        parser.error(
+            f"unknown controllers {unknown}; valid: {sorted(CONTROLLERS)}"
+        )
+
+    client = HttpApiClient(
+        args.apiserver, watch_poll_timeout=2.0, watch_retry=0.1
+    )
+    shutdown = sigutil.install_shutdown_handlers()
+
+    def start_manager() -> ControllerManager:
+        # Controllers are constructed only once this replica is ACTIVE:
+        # construction registers watches and runs the initial list-sync,
+        # and a hot standby must cause zero API traffic beyond its lease
+        # poll (and zero reconciles, ever).
+        manager = ControllerManager()
+        for name in names:
+            manager.add(CONTROLLERS[name](client).controller)
+        manager.start()
+        print(f"manager ready {','.join(names)}", flush=True)
+        return manager
+
+    if not args.leader_elect:
+        manager = start_manager()
+        sigutil.wait_for_shutdown(shutdown)
+        manager.stop()
+        client.close()
+        return 0
+
+    elector = LeaderElector(
+        client,
+        args.lease_name,
+        args.identity or f"controllers-{os.getpid()}",
+        lease_duration=args.lease_duration,
+        renew_deadline=args.renew_deadline,
+        retry_period=args.retry_period,
+    )
+    print(f"standby {elector.identity}", flush=True)
+    manager = None
+
+    def on_lead(el):
+        nonlocal manager
+        client.set_lease_guard(el.guard)
+        print(f"leading {el.identity} gen {el.transitions}", flush=True)
+        manager = start_manager()
+
+    lost = elector.run(shutdown, on_lead)
+    if manager is not None:
+        manager.stop()
+    if lost:
+        print(f"deposed {elector.identity}", flush=True)
+        return 2  # die; the supervisor restarts a fresh standby
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    logging.basicConfig(
+        level=logging.INFO
+        if os.environ.get("KFTPU_DEBUG")
+        else logging.WARNING
+    )
+    sys.exit(main())

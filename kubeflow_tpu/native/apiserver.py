@@ -17,6 +17,7 @@ component), exactly as in FakeApiServer.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable
 
@@ -28,6 +29,9 @@ from kubeflow_tpu.testing.fake_apiserver import (
     NotFound,
     WatchHandler,
 )
+
+
+_log = logging.getLogger(__name__)
 
 
 def _to_resource(d: dict) -> Resource:
@@ -79,21 +83,35 @@ class NativeApiServer:
         events, cursor = self._store.events(self._cursor)
         self._cursor = cursor
         self._store.trim(cursor)
-        for ev in events:
-            obj = _to_resource(ev["object"])
-            with self._journal_cv:
+        # Journal the WHOLE batch before any handler runs: the C++
+        # cursor is already advanced and trimmed, so an event that
+        # misses the journal here is gone forever — a raising handler
+        # must not cost later events their only remaining record (or
+        # surface to a writer whose write already committed).
+        batch = []
+        with self._journal_cv:
+            for ev in events:
+                obj = _to_resource(ev["object"])
                 rv = obj.metadata.resource_version
                 self._rv = max(self._rv, rv)
                 # obj is exclusively ours (fresh _to_resource; handlers
                 # and journal readers each get their own deepcopy) — no
                 # defensive copy on the mutation hot path.
                 self._journal.append((rv, ev["type"], obj))
-                if len(self._journal) > self._journal_size:
-                    del self._journal[: -self._journal_size]
-                self._journal_cv.notify_all()
+                batch.append((ev["type"], obj))
+            if len(self._journal) > self._journal_size:
+                del self._journal[: -self._journal_size]
+            self._journal_cv.notify_all()
+        for etype, obj in batch:
             for kind, handler in list(self._watchers):
                 if kind is None or kind == obj.kind:
-                    handler(ev["type"], obj.deepcopy())
+                    try:
+                        handler(etype, obj.deepcopy())
+                    except Exception:
+                        _log.exception(
+                            "watch handler failed for %s %s",
+                            etype, obj.key,
+                        )
 
     @property
     def current_rv(self) -> int:
@@ -126,20 +144,12 @@ class NativeApiServer:
         namespace: str | None = None,
         timeout: float = 10.0,
     ) -> tuple[list[tuple[int, str, Resource]], int]:
-        import time as _time
+        from kubeflow_tpu.testing.fake_apiserver import wait_journal_events
 
-        deadline = _time.monotonic() + timeout
-        with self._journal_cv:
-            while True:
-                events, rv = self.events_since(
-                    resource_version, kind=kind, namespace=namespace
-                )
-                if events:
-                    return events, rv
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    return [], rv
-                self._journal_cv.wait(remaining)
+        return wait_journal_events(
+            self._journal_cv, self.events_since,
+            resource_version, kind, namespace, timeout,
+        )
 
     def _translate(self, err: core.StoreError) -> Exception:
         msg = str(err)

@@ -117,6 +117,32 @@ def select_journal_events(
     return out, current_rv
 
 
+def wait_journal_events(
+    cv,
+    events_since,
+    resource_version: int,
+    kind: str | None,
+    namespace: str | None,
+    timeout: float,
+):
+    """The long-poll half of the journal contract, shared by both
+    backends: block on `cv` until events land past the bookmark or the
+    timeout passes (empty batch + current rv). `events_since` must be
+    callable under `cv`'s lock."""
+    deadline = time.monotonic() + timeout
+    with cv:
+        while True:
+            events, rv = events_since(
+                resource_version, kind=kind, namespace=namespace
+            )
+            if events:
+                return events, rv
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return [], rv
+            cv.wait(remaining)
+
+
 def check_lease_guard(get_lease_spec, guard, kind: str) -> None:
     """Write fencing, shared by BOTH store backends (the caller holds
     its store's commit lock, so the check is atomic with the write): a
@@ -682,19 +708,12 @@ class FakeApiServer:
         namespace: str | None = None,
         timeout: float = 10.0,
     ) -> tuple[list[tuple[int, str, Resource]], int]:
-        """Long-poll form of events_since: block until at least one
-        matching event lands past the bookmark, or the timeout passes
-        (returning an empty batch with the current rv)."""
-        deadline = time.monotonic() + timeout
-        with self._journal_cv:
-            while True:
-                events, rv = self.events_since(resource_version, kind, namespace)
-                if events:
-                    return events, rv
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return [], rv
-                self._journal_cv.wait(remaining)
+        """Long-poll form of events_since — the shared
+        wait_journal_events loop (one implementation across backends)."""
+        return wait_journal_events(
+            self._journal_cv, self.events_since,
+            resource_version, kind, namespace, timeout,
+        )
 
     # -- CRUD -------------------------------------------------------------
 

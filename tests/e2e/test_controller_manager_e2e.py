@@ -1,0 +1,136 @@
+"""Controller-manager binary e2e: the production deployment shape.
+
+`python -m kubeflow_tpu.controllers --leader-elect` is the reference's
+kubebuilder manager binary with `-enable-leader-election`
+(`notebook-controller/main.go:51-62`): two replicas against the secure
+facade, exactly one reconciling; SIGKILL the leader and the standby
+takes over within the lease TTL and keeps reconciling.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.rbac import make_cluster_role_binding, seed_cluster_roles
+from kubeflow_tpu.api.tokens import TokenRegistry
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.web.wsgi import serve
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+LEASE_DURATION = "3"
+
+
+def _spawn(identity, base, token, ca):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.controllers",
+         "--apiserver", base,
+         "--controllers", "notebook,tensorboard",
+         "--leader-elect", "--identity", identity,
+         "--lease-duration", LEASE_DURATION,
+         "--renew-deadline", "2", "--retry-period", "0.25"],
+        env={
+            **os.environ,
+            "PYTHONPATH": REPO,
+            "KFTPU_TOKEN": token,
+            "KFTPU_CA": ca,
+        },
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _read_until(proc, prefix, timeout=30.0):
+    """Read stdout lines until one starts with `prefix`. select()-gated:
+    a spawned binary that hangs SILENT must fail this assertion at the
+    deadline, not block readline forever and hang the whole run."""
+    import select as _select
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ready, _, _ = _select.select(
+            [proc.stdout], [], [], min(0.5, max(0.0, deadline - time.monotonic()))
+        )
+        if not ready:
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        if line.strip().startswith(prefix):
+            return line.strip()
+    raise AssertionError(f"no {prefix!r} line from worker in {timeout}s")
+
+
+def _wait(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_manager_binary_leader_elected_failover(tls_paths):
+    api = FakeApiServer()
+    seed_cluster_roles(api)
+    tokens = TokenRegistry()
+    token = tokens.issue("system:manager")
+    api.create(
+        make_cluster_role_binding("mgr", "kubeflow-admin", "system:manager")
+    )
+    server, _ = serve(
+        ApiServerApp(api, tokens=tokens), host="127.0.0.1", port=0,
+        tls=tls_paths,
+    )
+    base = f"https://127.0.0.1:{server.server_port}"
+    admin = HttpApiClient(base, token=token, ca=tls_paths.ca_cert)
+
+    a = _spawn("mgr-a", base, token, tls_paths.ca_cert)
+    b = None
+    try:
+        _read_until(a, "leading mgr-a")
+        _read_until(a, "manager ready")
+        b = _spawn("mgr-b", base, token, tls_paths.ca_cert)
+        _read_until(b, "standby mgr-b")
+
+        # The ACTIVE replica reconciles: Notebook → StatefulSet.
+        admin.create(new_resource(
+            "Notebook", "nb1", "default",
+            spec={"template": {"spec": {"containers": [
+                {"name": "nb", "image": "jax"}]}}},
+        ))
+        assert _wait(
+            lambda: any(
+                s.metadata.name == "nb1"
+                for s in api.list("StatefulSet", "default")
+            )
+        ), "leader never reconciled the Notebook"
+
+        a.kill()  # SIGKILL: standby must wait out the lease TTL
+        _read_until(b, "leading mgr-b", timeout=20)
+        _read_until(b, "manager ready", timeout=20)
+        admin.create(new_resource(
+            "Notebook", "nb2", "default",
+            spec={"template": {"spec": {"containers": [
+                {"name": "nb", "image": "jax"}]}}},
+        ))
+        assert _wait(
+            lambda: any(
+                s.metadata.name == "nb2"
+                for s in api.list("StatefulSet", "default")
+            )
+        ), "standby never reconciled after takeover"
+    finally:
+        for p in (a, b):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=10)
+        admin.close()
+        server.shutdown()

@@ -63,9 +63,18 @@ def _spawn(identity, base_url, token, ca, delay="0"):
 
 
 def _read_until(proc, prefix, timeout=30.0):
-    """Read stdout lines until one starts with `prefix`; returns it."""
+    """Read stdout lines until one starts with `prefix`. select()-gated:
+    a spawned binary that hangs SILENT must fail this assertion at the
+    deadline, not block readline forever and hang the whole run."""
+    import select as _select
+
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
+        ready, _, _ = _select.select(
+            [proc.stdout], [], [], min(0.5, max(0.0, deadline - time.monotonic()))
+        )
+        if not ready:
+            continue
         line = proc.stdout.readline()
         if not line:
             time.sleep(0.05)

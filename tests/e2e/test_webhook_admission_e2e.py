@@ -196,8 +196,16 @@ def test_leader_elected_webhook_failover(tmp_path, tls_paths):
         )
 
     def read_until(proc, prefix, timeout=30.0):
+        import select as _select
+
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            ready, _, _ = _select.select(
+                [proc.stdout], [], [],
+                min(0.5, max(0.0, deadline - time.monotonic())),
+            )
+            if not ready:
+                continue
             line = proc.stdout.readline()
             if line and line.strip().startswith(prefix):
                 return line.strip()
