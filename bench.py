@@ -61,14 +61,24 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--workload",
-        choices=("all", "resnet", "lm", "serving", "study"),
+        choices=("all", "resnet", "lm", "serving", "study", "chaos"),
         default="all",
         help="all (default) = resnet then lm, so the driver artifact "
         "carries both headline numbers; resnet = the driver's parsed "
         "metric; lm = transformer-LM tokens/sec with the flash-attention "
         "kernel; serving = TPU-backed model-server predictions/sec + "
         "latency percentiles; study = HP sweep trials/hour through the "
-        "full control plane",
+        "full control plane; chaos = the nightly seeded fault-injection "
+        "soak (prints the seed so any failure reproduces with "
+        "KFTPU_CHAOS_SEED=<seed>)",
+    )
+    parser.add_argument(
+        "--chaos-seed",
+        type=int,
+        default=None,
+        help="chaos only: fault-schedule seed (default: fresh random, "
+        "printed; pass a failed run's seed to reproduce its exact "
+        "schedule)",
     )
     parser.add_argument(
         "--batch-size",
@@ -138,6 +148,8 @@ def main() -> None:
         return bench_serving(args)
     if args.workload == "study":
         return bench_study(args)
+    if args.workload == "chaos":
+        return bench_chaos(args)
     bench_resnet(args)
     if args.workload == "all":
         # ResNet line first (the driver parses it), LM headline after.
@@ -527,6 +539,75 @@ def bench_serving(args) -> None:
         f"measured): batcher on p50={co_p50:.1f}ms p99={co_p99:.1f}ms "
         f"{co_rps:.0f} req/s vs off p50={co_off_p50:.1f}ms "
         f"p99={co_off_p99:.1f}ms {co_off_rps:.0f} req/s",
+        file=sys.stderr,
+    )
+
+
+def bench_chaos(args) -> None:
+    """Nightly chaos soak (the robustness headline): run the slow-tier
+    seeded fault-injection soak (`tests/e2e/test_chaos_soak_e2e.py::
+    test_chaos_soak_nightly`) against both store backends and report
+    wall-clock. The contract that makes soak failures actionable: the
+    seed is chosen HERE, printed up front AND on failure, and re-running
+    with `--chaos-seed <seed>` (or KFTPU_CHAOS_SEED=<seed>) replays the
+    byte-identical fault schedule.
+    """
+    import os
+    import random
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    seed = (
+        args.chaos_seed
+        if args.chaos_seed is not None
+        else random.randrange(2**31)
+    )
+    print(f"# chaos soak seed={seed}", file=sys.stderr)
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/e2e/test_chaos_soak_e2e.py::test_chaos_soak_nightly",
+            "-q", "-rs", "-p", "no:cacheprovider", "-p", "no:randomly",
+        ],
+        cwd=repo,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "KFTPU_CHAOS_SEED": str(seed),
+        },
+        capture_output=True,
+        text=True,
+    )
+    elapsed = time.perf_counter() - t0
+    sys.stderr.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        print(
+            f"# chaos soak FAILED (seed {seed}) — reproduce the exact "
+            f"fault schedule with:\n"
+            f"#   KFTPU_CHAOS_SEED={seed} python bench.py "
+            f"--workload chaos --chaos-seed {seed}",
+            file=sys.stderr,
+        )
+        raise SystemExit(proc.returncode)
+    # A backend whose toolchain is absent SKIPS — the metric must not
+    # claim dual-backend coverage the run didn't have.
+    skipped = "skipped" in proc.stdout
+    backends = "python only; native skipped" if skipped else "both backends"
+    print(
+        json.dumps(
+            {
+                "metric": "chaos_soak_seconds",
+                "value": round(elapsed, 1),
+                "unit": f"seconds ({backends}, full fault coverage)",
+                "vs_baseline": None,  # reference had no fault injection
+            }
+        )
+    )
+    print(
+        f"# chaos soak converged in {elapsed:.1f}s (seed {seed}, "
+        f"{backends})",
         file=sys.stderr,
     )
 

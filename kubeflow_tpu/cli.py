@@ -81,23 +81,74 @@ ALIASES = {
 }
 
 
-def resolve_kind(raw: str) -> str:
+def resolve_kind(
+    raw: str,
+    client: HttpApiClient | None = None,
+    *,
+    warn_empty: bool = True,
+) -> str:
     """kubectl-style kind resolution: aliases/plurals first, then a
     generic lowercase-plural fallback (`somethings` → `Something`) so a
     kind missing from the table still lists as SOME cased guess instead
     of silently querying an empty lowercase kind — a `get configmaps`
     watching the nonexistent kind "configmaps" looks exactly like a
-    quiet cluster."""
+    quiet cluster.
+
+    The fallback singularizer understands `-es` sibilant plurals
+    (`statuses` → `Status`, `classes` → `Class`, `boxes` → `Box`) — the
+    naive strip-one-s produced `Statuse`/`Classe`, kinds that cannot
+    exist. English makes some plurals genuinely ambiguous (`caches` is
+    cache+s, `churches` is church+es), so derivation returns ranked
+    CANDIDATES and a `client` disambiguates: the first candidate with
+    live objects wins. When no candidate has any, the best guess is
+    used and (unless warn_empty=False — watch mode, where an empty kind
+    is routinely what the operator is waiting on) a warning says which
+    question was actually asked."""
     lower = raw.lower()
     if lower in ALIASES:
         return ALIASES[lower]
     if raw != lower or not raw:
         return raw  # already cased (a Kind name) or empty
+    candidates = _singular_candidates(lower)
+    kind = candidates[0]
+    if client is not None:
+        try:
+            live = [k for k in candidates if client.list(k)]
+        except Exception:
+            live = [kind]  # can't tell; don't add noise to a real error
+        if live:
+            kind = live[0]
+        elif warn_empty:
+            print(
+                f"warning: no live {kind!r} objects (kind derived from "
+                f"{raw!r} — if that guess is wrong, use the exact "
+                f"CamelCase kind)",
+                file=sys.stderr,
+            )
+    return kind
+
+
+def _singular_candidates(lower: str) -> list[str]:
+    """Lowercase plural → CamelCase-ish singular kind guesses, best
+    first. Suffix policy: -ies is unambiguous; for -es after a sibilant
+    the es-strip leads where a silent-e stem is implausible
+    (`statuses`, `classes`, `boxes`, `dishes`) and trails where it is
+    the likelier reading (`caches`, `sizes` — stems ending -che/-ze);
+    the runner-up stays a candidate so a live-object probe can overrule
+    the heuristic either way."""
     if lower.endswith("ies"):
-        return lower[:-3].capitalize() + "y"
-    if lower.endswith("s"):
-        return lower[:-1].capitalize()
-    return lower.capitalize()
+        return [lower[:-3].capitalize() + "y"]
+    strip_s = lower[:-1].capitalize() if lower.endswith("s") else None
+    strip_es = lower[:-2].capitalize() if lower.endswith("es") else None
+    if strip_es and strip_s:
+        for suffix in ("sses", "uses", "xes", "shes"):
+            if lower.endswith(suffix):
+                return [strip_es, strip_s]
+        if lower.endswith(("ches", "zes", "ses")):
+            return [strip_s, strip_es]
+    if strip_s:
+        return [strip_s]
+    return [lower.capitalize()]
 
 
 def _emit(obj, fmt: str) -> None:
@@ -129,7 +180,12 @@ def _phase(res: Resource) -> str:
 
 
 def cmd_get(client: HttpApiClient, args) -> int:
-    kind = resolve_kind(args.kind)
+    # Listing is the command where a wrongly-derived kind is silent (an
+    # empty table): pass the client so ambiguous derivations resolve
+    # against live objects and empty guesses warn. Watch mode skips the
+    # warning — an empty kind is routinely what `-w` is waiting on.
+    # By-name commands (describe/delete) fail loudly with NotFound.
+    kind = resolve_kind(args.kind, client, warn_empty=not args.watch)
     if args.watch:
         return _watch_kind(client, kind, args)
     if args.name:
@@ -246,7 +302,10 @@ def cmd_describe(client: HttpApiClient, args) -> int:
     mirrored Event timeline in one view (controllers emit Events the way
     `notebook_controller.go:87-103` mirrors them; the store keeps them as
     Event objects with spec.involvedObject back-references)."""
-    kind = resolve_kind(args.kind)
+    # client passed so ambiguous plural derivations (`caches` vs
+    # `churches`) resolve against live objects; no emptiness warning —
+    # a wrong by-name kind already fails loudly with NotFound.
+    kind = resolve_kind(args.kind, client, warn_empty=False)
     res = _get_scoped(client, kind, args.name, args.namespace)
     ns = res.metadata.namespace
     meta = res.metadata
@@ -404,7 +463,7 @@ def cmd_apply(client: HttpApiClient, args) -> int:
 
 
 def cmd_delete(client: HttpApiClient, args) -> int:
-    kind = resolve_kind(args.kind)
+    kind = resolve_kind(args.kind, client, warn_empty=False)
     client.delete(kind, args.name, args.namespace)
     print(f"{kind.lower()}/{args.name} deleted")
     return 0

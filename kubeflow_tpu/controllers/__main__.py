@@ -76,6 +76,26 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--lease-duration", type=float, default=15.0)
     parser.add_argument("--renew-deadline", type=float, default=10.0)
     parser.add_argument("--retry-period", type=float, default=2.0)
+    # Fault-tolerance knobs (the chaos-soak-hardened client): bounded
+    # write retries with jitter, per-endpoint circuit breakers, and the
+    # streaming watch's degraded-mode/re-probe cadence. Defaults match
+    # HttpApiClient's; deployments under flaky networks tune them the
+    # way the reference tunes client-go's rate limiters.
+    parser.add_argument(
+        "--write-retries", type=int, default=3,
+        help="extra attempts for transient write failures (guarded by "
+        "resourceVersion preconditions — never double-applies)",
+    )
+    parser.add_argument(
+        "--breaker-cooldown", type=float, default=2.0,
+        help="seconds a tripped per-endpoint circuit sheds load before "
+        "probing the endpoint again",
+    )
+    parser.add_argument(
+        "--stream-reprobe", type=float, default=60.0,
+        help="seconds between re-probes of the streaming watch after "
+        "the server rejects it (long-poll fallback is never sticky)",
+    )
     args = parser.parse_args(argv)
 
     names = [n.strip() for n in args.controllers.split(",") if n.strip()]
@@ -86,7 +106,12 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     client = HttpApiClient(
-        args.apiserver, watch_poll_timeout=2.0, watch_retry=0.1
+        args.apiserver,
+        watch_poll_timeout=2.0,
+        watch_retry=0.1,
+        write_retries=args.write_retries,
+        breaker_cooldown=args.breaker_cooldown,
+        stream_reprobe_seconds=args.stream_reprobe,
     )
     shutdown = sigutil.install_shutdown_handlers()
 

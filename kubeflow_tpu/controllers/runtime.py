@@ -37,6 +37,34 @@ class Result:
     requeue_after: float | None = None
 
 
+def retry_on_conflict(
+    fn: Callable[[], object],
+    *,
+    attempts: int = 4,
+    base_delay: float = 0.01,
+):
+    """client-go's RetryOnConflict for read-modify-write status updates:
+    `fn` must RE-READ the object each call (a conflict means the cached
+    copy is stale — replaying the same body would just conflict again).
+    Retries only `Conflict`, with short jittered backoff; the final
+    conflict propagates so the workqueue's error backoff takes over.
+    Under fault injection this keeps routine rv races from burning
+    whole reconcile passes."""
+    import random as _random
+
+    from kubeflow_tpu.testing.fake_apiserver import Conflict
+
+    delay = base_delay
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Conflict:
+            if attempt == attempts - 1:
+                raise
+            time.sleep(_random.uniform(0, delay))
+            delay = min(delay * 2, 0.25)
+
+
 class _PyWorkQueue:
     """Python fallback with the native workqueue's exact interface and
     semantics (keyed dedup, sooner-wins supersede, in-flight dirty set,
@@ -292,8 +320,17 @@ class Controller:
 
     def run(self, stop: threading.Event, poll: float = 0.05) -> None:
         while not stop.is_set():
-            # Blocking get parks in native code (ctypes drops the GIL).
-            self.process_one(timeout=poll)
+            try:
+                # Blocking get parks in native code (ctypes drops GIL).
+                self.process_one(timeout=poll)
+            except Exception:
+                # process_one already contains the reconcile; anything
+                # escaping it is queue/runtime trouble. A controller
+                # thread must survive it — under fault injection a dead
+                # worker looks exactly like a converged one until the
+                # soak's deadline expires.
+                log.exception("%s: worker loop error; continuing", self.name)
+                stop.wait(poll)
 
 
 class ControllerManager:

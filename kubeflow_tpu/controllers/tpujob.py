@@ -30,7 +30,12 @@ from kubeflow_tpu.api.objects import (
     owner_ref,
 )
 from kubeflow_tpu.api.tpujob import COORDINATOR_PORT, KIND, TpuJobSpec
-from kubeflow_tpu.controllers.runtime import Controller, Key, Result
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Key,
+    Result,
+    retry_on_conflict,
+)
 from kubeflow_tpu.parallel import distributed as dist
 from kubeflow_tpu.testing.fake_apiserver import (
     FakeApiServer,
@@ -697,22 +702,28 @@ class TpuJobController:
         counts: dict | None = None,
         restarts: int | None = None,
     ) -> Result:
-        fresh = api.get(KIND, job.metadata.name, job.metadata.namespace)
-        new_status = dict(fresh.status)
-        if counts is not None:
-            new_status["replicaStatuses"] = counts
-        if restarts is not None:
-            new_status["restarts"] = restarts
-        if new_status.get("phase") != phase:
-            new_status["phase"] = phase
-            new_status["conditions"] = list(
-                new_status.get("conditions", [])
-            ) + [{"type": phase}]
-        if new_status != fresh.status:
-            # Only write on real change — an unconditional write would
-            # re-trigger our own watch and hot-loop the queue.
-            fresh.status = new_status
-            api.update_status(fresh)
+        def write() -> None:
+            fresh = api.get(KIND, job.metadata.name, job.metadata.namespace)
+            new_status = dict(fresh.status)
+            if counts is not None:
+                new_status["replicaStatuses"] = counts
+            if restarts is not None:
+                new_status["restarts"] = restarts
+            if new_status.get("phase") != phase:
+                new_status["phase"] = phase
+                new_status["conditions"] = list(
+                    new_status.get("conditions", [])
+                ) + [{"type": phase}]
+            if new_status != fresh.status:
+                # Only write on real change — an unconditional write
+                # would re-trigger our own watch and hot-loop the queue.
+                fresh.status = new_status
+                api.update_status(fresh)
+
+        # rv races with our own pod-event-driven passes are routine under
+        # load; re-read-and-retry beats burning a whole error-backoff
+        # cycle (client-go's RetryOnConflict).
+        retry_on_conflict(write)
         # Census gauge (the reference's scrape-time pattern,
         # notebook-controller metrics.go:74-99): always exact, immune to
         # missed transitions.

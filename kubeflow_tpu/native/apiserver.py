@@ -21,7 +21,7 @@ import logging
 import threading
 from typing import Callable
 
-from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid
+from kubeflow_tpu.api.objects import Resource
 from kubeflow_tpu.native import core
 from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
@@ -58,6 +58,9 @@ class NativeApiServer:
         self._journal_cv = threading.Condition(self._dispatch_lock)
         self._rv = 0
         self._floor = 0
+        # Kinds ever stored through this wrapper — kinds() candidates
+        # (the compiled store has no enumerate-kinds ABI).
+        self._kinds_seen: set[str] = set()
 
     # -- admission --------------------------------------------------------
 
@@ -188,6 +191,7 @@ class NativeApiServer:
                 stored = self._store.create(obj.to_dict())
             except core.StoreError as e:
                 raise self._translate(e) from None
+            self._kinds_seen.add(obj.kind)
             self._drain_events()
             return _to_resource(stored)
 
@@ -292,22 +296,54 @@ class NativeApiServer:
         *,
         type_: str = "Normal",
     ) -> Resource:
-        name = f"{about.metadata.name}.{fresh_uid()[:8]}"
-        ev = Resource(
-            kind="Event",
-            metadata=ObjectMeta(
-                name=name, namespace=about.metadata.namespace
-            ),
-            spec={
-                "involvedObject": {
-                    "kind": about.kind,
-                    "name": about.metadata.name,
-                    "uid": about.metadata.uid,
-                },
-                "reason": reason,
-                "message": message,
-                "type": type_,
-            },
-            status={},
-        )
-        return self.create(ev)
+        from kubeflow_tpu.testing.fake_apiserver import event_resource
+
+        ev = event_resource(about, reason, message, type_=type_)
+        try:
+            return self.create(ev)
+        except AlreadyExists:
+            return self.get(
+                "Event", ev.metadata.name, about.metadata.namespace
+            )
+
+    # -- facade parity -----------------------------------------------------
+    #
+    # Drop-in for FakeApiServer means drop-in BEHIND THE FACADE and under
+    # the controller runtime too: the HTTP app calls convert_to for
+    # `?version=` reads, run_until_idle calls flush() as its dispatch
+    # barrier, and the CLI's kind disambiguation asks kinds(). The chaos
+    # soak is the first suite to drive this backend as the spine rather
+    # than a parity exhibit, and these are the seams it crossed.
+
+    def convert_to(self, obj: Resource, version: str) -> Resource:
+        """Read-side conversion at a served version — the same
+        versioning registry FakeApiServer consults."""
+        from kubeflow_tpu.api import versioning
+        from kubeflow_tpu.testing.fake_apiserver import Invalid
+
+        try:
+            return versioning.registry.convert(obj, version)
+        except versioning.ConversionError as e:
+            raise Invalid(str(e)) from e
+
+    def kinds(self) -> list[str]:
+        """Distinct kinds with live objects (quota's count/<resource>
+        inverse — same contract as FakeApiServer.kinds). The C++ ABI has
+        no list-all-kinds call, so candidates are the kinds this wrapper
+        has ever stored, verified live with one per-kind list."""
+        with self._dispatch_lock:
+            seen = sorted(self._kinds_seen)
+        return [k for k in seen if self._store.list(k)]
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Dispatch barrier. Watch delivery on this backend is
+        synchronous with the mutating call (see _drain_events), so by
+        the time any mutator returns, its events have been handled —
+        the barrier is trivially satisfied."""
+
+    def checkpoint(self) -> None:
+        """No durable tier on this backend (the WAL lives in the Python
+        store); a no-op keeps shutdown paths backend-agnostic."""
+
+    def close(self) -> None:
+        """See checkpoint()."""

@@ -37,11 +37,13 @@ import json
 import urllib.parse
 
 import logging
+import random
 import threading
+import time
 
 import os
 
-from kubeflow_tpu.api.objects import ObjectMeta, Resource, fresh_uid
+from kubeflow_tpu.api.objects import Resource
 from kubeflow_tpu.api.rbac import resource_for_kind, subject_access_review
 from kubeflow_tpu.api.tokens import TokenRegistry
 from kubeflow_tpu.utils import tracing
@@ -543,6 +545,58 @@ class ApiServerApp(App):
         return Response(path.read_bytes(), content_type="text/plain")
 
 
+class CircuitBreaker:
+    """Per-endpoint circuit breaker (the client-go rate-limiter posture,
+    plus fail-fast): `threshold` consecutive transport-class failures
+    open the circuit for `cooldown` seconds, during which calls shed
+    immediately instead of hammering a struggling endpoint; after the
+    cooldown one probe per window is allowed (half-open), and a single
+    success closes the circuit. Functional error statuses (404/409/422)
+    are successes here — the endpoint answered."""
+
+    def __init__(self, threshold: int = 5, cooldown: float = 2.0):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.failures = 0
+        self.trips = 0  # observability: times the circuit opened
+        self._probe_at = 0.0
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self.failures < self.threshold:
+                return True
+            now = time.monotonic()
+            if now >= self._probe_at:
+                # Half-open: claim this window's single probe slot.
+                self._probe_at = now + self.cooldown
+                return True
+            return False
+
+    def success(self) -> None:
+        with self._lock:
+            self.failures = 0
+
+    def failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if self.failures >= self.threshold:
+                # Crossing the threshold opens the circuit (one trip); a
+                # failed half-open probe re-trips it and restarts the
+                # cooldown, so a flapping endpoint shows its full
+                # history in `trips` rather than one eternal episode.
+                self.trips += 1
+                self._probe_at = time.monotonic() + self.cooldown
+
+    @property
+    def open(self) -> bool:
+        with self._lock:
+            return (
+                self.failures >= self.threshold
+                and time.monotonic() < self._probe_at
+            )
+
+
 class HttpApiClient:
     """Remote twin of FakeApiServer's CRUD + watch surface.
 
@@ -562,6 +616,14 @@ class HttpApiClient:
         token: str | None = None,
         ca: str | None = None,
         allow_plaintext_token: bool | None = None,
+        write_retries: int = 3,
+        retry_base: float = 0.05,
+        retry_cap: float = 1.0,
+        breaker_threshold: int = 5,
+        breaker_cooldown: float = 2.0,
+        stream_failure_threshold: int = 3,
+        stream_degraded_seconds: float = 5.0,
+        stream_reprobe_seconds: float = 60.0,
     ):
         self.base_url = base_url.rstrip("/")
         # The identity credential (serviceaccount-token analog). Falls
@@ -637,6 +699,36 @@ class HttpApiClient:
         # every write carries the guard and the server rejects it with
         # Conflict unless the lease still shows this holder+generation.
         self.lease_guard: tuple[str, str, str, int] | None = None
+        # -- fault tolerance (the chaos-soak contract) ---------------------
+        # Bounded retry-with-jitter for transient write failures. Safe
+        # only because every retried write is guarded: updates carry a
+        # resourceVersion precondition, creates recover AlreadyExists by
+        # comparing the stored object, deletes treat NotFound as done —
+        # so an ambiguous failure (connection died after send) can never
+        # double-apply.
+        self.write_retries = write_retries
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
+        self.retries_total = 0  # write attempts beyond the first
+        # Per-endpoint circuit breakers: repeated transport failures at
+        # one endpoint shed load (fail fast) instead of stacking threads
+        # behind a dead socket, then probe their way closed again.
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breakers_lock = threading.Lock()
+        # Streaming-watch health: consecutive stream failures past the
+        # threshold shed the watch to long-poll DEGRADED mode for
+        # `stream_degraded_seconds`, then re-probe the stream; a server
+        # that affirmatively rejects the stream form (distinguishable
+        # 400) is re-probed on the slower `stream_reprobe_seconds`
+        # cadence instead of being written off for the process lifetime.
+        self._stream_breaker = CircuitBreaker(
+            threshold=stream_failure_threshold,
+            cooldown=stream_degraded_seconds,
+        )
+        self.stream_reprobe_seconds = stream_reprobe_seconds
+        self._stream_unsupported_until = 0.0
 
     def set_lease_guard(
         self, guard: tuple[str, str, str, int] | None
@@ -777,13 +869,93 @@ class HttpApiClient:
             raise Unavailable(detail)
         raise ApiError(f"HTTP {status}: {detail}")
 
+    def _breaker_for(self, method: str, path: str) -> CircuitBreaker:
+        """One breaker per endpoint class: method + the first two path
+        segments ("/apis/<kind>"), query stripped — fine enough that a
+        sick watch endpoint doesn't open the circuit for writes, coarse
+        enough that per-object paths share state."""
+        bare = path.partition("?")[0]
+        key = f"{method} /" + "/".join(bare.split("/")[1:3])
+        with self._breakers_lock:
+            breaker = self._breakers.get(key)
+            if breaker is None:
+                breaker = self._breakers[key] = CircuitBreaker(
+                    threshold=self.breaker_threshold,
+                    cooldown=self.breaker_cooldown,
+                )
+            return breaker
+
+    def breaker_state(self) -> dict[str, tuple[int, bool]]:
+        """Observability: endpoint → (trips, currently_open)."""
+        with self._breakers_lock:
+            return {
+                k: (b.trips, b.open) for k, b in self._breakers.items()
+            }
+
     def _call(self, method: str, path: str, body: dict | None = None) -> dict:
-        conn, resp = self._request_raw(method, path, body)
-        status = resp.status
-        data = self._finish(conn, resp)
+        import http.client as _hc
+
+        breaker = self._breaker_for(method, path)
+        if not breaker.allow():
+            raise Unavailable(
+                f"circuit open for {method} {path.partition('?')[0]} "
+                "(failing fast after repeated endpoint failures)"
+            )
+        try:
+            conn, resp = self._request_raw(method, path, body)
+            status = resp.status
+            data = self._finish(conn, resp)
+        except (_hc.HTTPException, OSError):
+            breaker.failure()
+            raise
+        # 5xx counts against the endpoint; everything else — including
+        # functional errors like 404/409/422 — proves it is answering.
+        if status >= 500:
+            breaker.failure()
+        else:
+            breaker.success()
         if status >= 400:
             self._raise_for_status(status, data.decode(errors="replace"))
         return json.loads(data)
+
+    def _write_with_retry(self, attempt, *, recover_committed=None):
+        """Bounded retry with exponential backoff + full jitter for
+        transient WRITE failures (`Unavailable`/transport errors — the
+        chaos soak's 5xx bursts, resets, and crash-before-ack class).
+
+        A transport failure is AMBIGUOUS: the server may have committed
+        before the connection died. After any ambiguous failure,
+        `recover_committed(exc)` is consulted when a later attempt fails
+        with an already-happened-shaped error (AlreadyExists / NotFound
+        / Conflict): it returns the recovered result, or None to
+        re-raise — which is what keeps a retried write from ever
+        double-applying."""
+        import http.client as _hc
+
+        delay = self.retry_base
+        ambiguous = False
+        attempts = 0
+        while True:
+            try:
+                return attempt()
+            except (Unavailable, _hc.HTTPException, OSError) as e:
+                # 503 means the store refused before committing;
+                # a dead connection means we simply don't know.
+                ambiguous = ambiguous or not isinstance(e, Unavailable)
+                attempts += 1
+                if attempts > self.write_retries or self._closed.is_set():
+                    raise
+                self.retries_total += 1
+                # Full jitter: decorrelates a fleet of clients retrying
+                # into the same recovering endpoint.
+                self._closed.wait(random.uniform(0, delay))
+                delay = min(delay * 2, self.retry_cap)
+            except (AlreadyExists, NotFound, Conflict) as e:
+                if ambiguous and recover_committed is not None:
+                    out = recover_committed(e)
+                    if out is not None:
+                        return out
+                raise
 
     def get(
         self,
@@ -818,32 +990,75 @@ class HttpApiClient:
         return [Resource.from_dict(d) for d in data["items"]]
 
     def create(self, obj: Resource) -> Resource:
-        return Resource.from_dict(
-            self._call("POST", f"/apis/{obj.kind}", obj.to_dict())
-        )
+        def attempt() -> Resource:
+            return Resource.from_dict(
+                self._call("POST", f"/apis/{obj.kind}", obj.to_dict())
+            )
+
+        def recover(e: ApiError) -> Resource | None:
+            # AlreadyExists after an ambiguous failure: OUR create may be
+            # the one that landed. Claim it only if the stored object
+            # contains what we sent (mutating admission may have ADDED
+            # defaulted fields; spec-equality would disown our own
+            # committed write) — a genuinely different pre-existing
+            # object stays an error.
+            if not isinstance(e, AlreadyExists):
+                return None
+            try:
+                stored = self.get(
+                    obj.kind, obj.metadata.name, obj.metadata.namespace
+                )
+            except ApiError:
+                return None
+            if (
+                _subsumes(stored.spec, obj.spec)
+                and stored.metadata.labels == obj.metadata.labels
+            ):
+                return stored
+            return None
+
+        return self._write_with_retry(attempt, recover_committed=recover)
 
     def update(self, obj: Resource) -> Resource:
-        return Resource.from_dict(
-            self._call(
-                "PUT",
-                f"/apis/{obj.kind}/{_ns_seg(obj.metadata.namespace)}/"
-                f"{obj.metadata.name}",
-                obj.to_dict(),
+        # Safe to retry: the body's resourceVersion precondition means a
+        # first attempt that actually committed turns the replay into a
+        # Conflict (the caller re-reads), never a silent double-apply.
+        return self._write_with_retry(
+            lambda: Resource.from_dict(
+                self._call(
+                    "PUT",
+                    f"/apis/{obj.kind}/{_ns_seg(obj.metadata.namespace)}/"
+                    f"{obj.metadata.name}",
+                    obj.to_dict(),
+                )
             )
         )
 
     def update_status(self, obj: Resource) -> Resource:
-        return Resource.from_dict(
-            self._call(
-                "PUT",
-                f"/apis/{obj.kind}/{_ns_seg(obj.metadata.namespace)}/"
-                f"{obj.metadata.name}/status",
-                obj.to_dict(),
+        return self._write_with_retry(
+            lambda: Resource.from_dict(
+                self._call(
+                    "PUT",
+                    f"/apis/{obj.kind}/{_ns_seg(obj.metadata.namespace)}/"
+                    f"{obj.metadata.name}/status",
+                    obj.to_dict(),
+                )
             )
         )
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
-        self._call("DELETE", f"/apis/{kind}/{_ns_seg(namespace)}/{name}")
+        def recover(e: ApiError):
+            # NotFound after an ambiguous failure: our delete landed (or
+            # someone else's did — either way the object is gone, which
+            # is all a delete promises).
+            return {"deleted": True} if isinstance(e, NotFound) else None
+
+        self._write_with_retry(
+            lambda: self._call(
+                "DELETE", f"/apis/{kind}/{_ns_seg(namespace)}/{name}"
+            ),
+            recover_committed=recover,
+        )
 
     def pod_log(self, name: str, namespace: str = "default") -> str:
         """The pod's captured stdout (raw text; same pooled transport and
@@ -870,9 +1085,14 @@ class HttpApiClient:
     def apply(self, obj: Resource) -> Resource:
         """Create-or-update, evaluated server-side (the store's compare is
         post-admission/post-conversion, so a remote reconciler's apply
-        no-ops exactly when an in-process one would)."""
-        return Resource.from_dict(
-            self._call("POST", f"/apis/{obj.kind}?apply=true", obj.to_dict())
+        no-ops exactly when an in-process one would). Declaratively
+        idempotent, so the transient-failure retry needs no recovery."""
+        return self._write_with_retry(
+            lambda: Resource.from_dict(
+                self._call(
+                    "POST", f"/apis/{obj.kind}?apply=true", obj.to_dict()
+                )
+            )
         )
 
     def record_event(
@@ -885,26 +1105,24 @@ class HttpApiClient:
     ) -> Resource:
         """Same Event shape FakeApiServer.record_event emits
         (`notebook_controller.go:87-103` event mirroring works unchanged
-        from a remote controller)."""
-        ev = Resource(
-            kind="Event",
-            metadata=ObjectMeta(
-                name=f"{about.metadata.name}.{fresh_uid()[:8]}",
-                namespace=about.metadata.namespace,
-            ),
-            spec={
-                "involvedObject": {
-                    "kind": about.kind,
-                    "name": about.metadata.name,
-                    "uid": about.metadata.uid,
-                },
-                "reason": reason,
-                "message": message,
-                "type": type_,
-            },
-            status={},
+        from a remote controller). The content-derived name (see
+        `fake_apiserver.event_name`) makes a retried emission collide
+        with its first attempt instead of duplicating it."""
+        from kubeflow_tpu.testing.fake_apiserver import (
+            event_name,
+            event_resource,
         )
-        return self.create(ev)
+
+        ev = event_resource(about, reason, message, type_=type_)
+        try:
+            return self.create(ev)
+        except AlreadyExists:
+            # The same logical event is already recorded (a retried
+            # emission, or a repeat occurrence K8s would aggregate).
+            return self.get(
+                "Event", event_name(about, reason, message, type_),
+                about.metadata.namespace,
+            )
 
     # -- watch (informer client) ------------------------------------------
 
@@ -972,24 +1190,67 @@ class HttpApiClient:
                 self._dispatch("MODIFIED", Resource.from_dict(item))
         return rv if rv is not None else 0
 
+    def _stream_allowed(self) -> bool:
+        """Whether this pass should attempt the streaming watch form.
+        False while the server has affirmatively rejected it (until the
+        periodic re-probe) or while the stream circuit is open (shed to
+        long-poll degraded mode)."""
+        if time.monotonic() < self._stream_unsupported_until:
+            return False
+        return self._stream_breaker.allow()
+
+    @property
+    def stream_degraded(self) -> bool:
+        """Observability: True while the watch runs in long-poll
+        degraded mode instead of streaming."""
+        return (
+            time.monotonic() < self._stream_unsupported_until
+            or self._stream_breaker.open
+        )
+
     def _watch_loop(self) -> None:
         rv = None
         # Prefer the chunked streaming watch (one held-open response,
         # event latency = delivery latency); fall back to long-polling
-        # against servers that don't speak it. The fallback is sticky
-        # per process — a server that 400s the stream form once won't
-        # grow the capability mid-life.
-        streaming = True
+        # when the server rejects the stream form or the stream circuit
+        # opens. NEITHER fallback is sticky: an affirmative rejection is
+        # re-probed every stream_reprobe_seconds (the server may gain
+        # the capability mid-life), and repeated stream failures shed to
+        # long-poll only for the breaker's cooldown — the chaos soak's
+        # truncated/slow streams degrade the transport, never disable
+        # it.
         while not self._closed.is_set():
             try:
                 if rv is None:
                     rv = self._resync()
-                if streaming:
+                if self._stream_allowed():
                     try:
                         rv = self._stream_once(rv)
+                        self._stream_breaker.success()
                         continue
-                    except _StreamUnsupported:
-                        streaming = False
+                    except _StreamUnsupported as e:
+                        log.info(
+                            "server rejected streaming watch (%s); "
+                            "long-polling, re-probe in %.0fs",
+                            e, self.stream_reprobe_seconds,
+                        )
+                        self._stream_unsupported_until = (
+                            time.monotonic() + self.stream_reprobe_seconds
+                        )
+                    except (Gone, PermissionError):
+                        raise
+                    except Exception:
+                        if self._closed.is_set():
+                            return
+                        # Count against the stream circuit; fall through
+                        # to one long-poll round so progress continues
+                        # even while the stream endpoint is sick.
+                        self._stream_breaker.failure()
+                        log.debug(
+                            "stream watch failed (%d consecutive); "
+                            "long-poll round",
+                            self._stream_breaker.failures, exc_info=True,
+                        )
                 params = urllib.parse.urlencode(
                     {
                         "watch": "true",
@@ -1030,8 +1291,14 @@ class HttpApiClient:
         )
         conn, resp = self._request_raw("GET", f"/apis/_?{params}")
         if resp.status == 400:
-            self._finish(conn, resp)
-            raise _StreamUnsupported()
+            detail = self._finish(conn, resp).decode(errors="replace")
+            if _stream_rejected(detail):
+                raise _StreamUnsupported(detail)
+            # A stray 400 (fault injection, a confused intermediary, a
+            # malformed bookmark) is NOT evidence the server lacks the
+            # stream form — treating it as such permanently degraded the
+            # transport (the round-5 apiserver_http.py:1032 bug).
+            raise ApiError(f"watch stream HTTP 400: {detail}")
         if resp.status >= 400:
             status = resp.status
             detail = self._finish(conn, resp).decode(errors="replace")
@@ -1072,5 +1339,56 @@ class HttpApiClient:
             raise
 
 
+def _subsumes(stored, sent) -> bool:
+    """Whether `stored` contains everything in `sent`: dicts may carry
+    EXTRA keys (admission-defaulted fields), everything else must match
+    exactly. The create-recovery ownership test — conservative enough
+    that admission mutations which REWRITE sent values (or splice lists,
+    e.g. PodDefault injection) fall back to surfacing AlreadyExists
+    rather than mis-claiming a stranger's object."""
+    if isinstance(sent, dict):
+        if not isinstance(stored, dict):
+            return False
+        return all(
+            k in stored and _subsumes(stored[k], v) for k, v in sent.items()
+        )
+    return stored == sent
+
+
+def _stream_rejected(detail: str) -> bool:
+    """Whether a 400 body is an AFFIRMATIVE streaming-watch rejection.
+
+    A server that doesn't speak `stream=true` names the parameter in its
+    complaint ("unknown/unsupported parameter `stream`"); an unrelated
+    400 — an injected fault, a proxy in the path, a bad bookmark — does
+    not. Only the former may put the client in long-poll fallback; the
+    latter is a transient error like any other (the non-sticky contract
+    tested by the chaos soak). Two conditions must hold: the stream
+    token at a word start (so an intermediary's "upstream" never
+    matches) AND rejection language (so "stream timeout"/"stream reset"
+    transients never match)."""
+    import re
+
+    message = detail
+    try:
+        parsed = json.loads(detail)
+        if isinstance(parsed, dict):
+            message = parsed.get("log", detail)
+    except ValueError:
+        pass
+    message = str(message)
+    return (
+        re.search(r"\bstream", message, re.IGNORECASE) is not None
+        and re.search(
+            r"unsupported|not supported|unknown|unrecognized|invalid"
+            r"|parameter",
+            message,
+            re.IGNORECASE,
+        )
+        is not None
+    )
+
+
 class _StreamUnsupported(Exception):
-    """Server rejected `stream=true` (400): stick to long-polling."""
+    """Server affirmatively rejected `stream=true`: long-poll, re-probe
+    periodically (`stream_reprobe_seconds`) — never sticky for life."""

@@ -83,6 +83,59 @@ def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def event_name(
+    about: Resource, reason: str, message: str, type_: str = "Normal"
+) -> str:
+    """Content-derived Event name, shared by every event emitter (both
+    stores and the HTTP client). The same logical occurrence always maps
+    to the same name, so a RETRIED emission — a controller replaying a
+    write whose ack was lost — collides with its first attempt
+    (AlreadyExists, absorbed by the emitters) instead of duplicating it.
+    Repeat occurrences with identical text collapse the same way, which
+    is K8s's own event-aggregation posture."""
+    import hashlib
+
+    digest = hashlib.sha1(
+        "\x00".join(
+            (
+                about.kind,
+                about.metadata.namespace or "",
+                about.metadata.name,
+                str(about.metadata.uid),
+                reason,
+                message,
+                type_,
+            )
+        ).encode()
+    ).hexdigest()[:10]
+    return f"{about.metadata.name}.{digest}"
+
+
+def event_resource(
+    about: Resource, reason: str, message: str, *, type_: str = "Normal"
+) -> Resource:
+    """The K8s-style Event object every emitter records (the reference
+    mirrors these onto CR statuses, `notebook_controller.go:87-103`)."""
+    return Resource(
+        kind="Event",
+        metadata=ObjectMeta(
+            name=event_name(about, reason, message, type_),
+            namespace=about.metadata.namespace,
+        ),
+        spec={
+            "involvedObject": {
+                "kind": about.kind,
+                "name": about.metadata.name,
+                "uid": about.metadata.uid,
+            },
+            "reason": reason,
+            "message": message,
+            "type": type_,
+        },
+        status={},
+    )
+
+
 def select_journal_events(
     journal,
     floor: int,
@@ -990,24 +1043,13 @@ class FakeApiServer:
         type_: str = "Normal",
     ) -> Resource:
         """Emit a K8s-style Event object (the reference mirrors these onto
-        CR statuses, `notebook_controller.go:87-103`)."""
-        name = f"{about.metadata.name}.{fresh_uid()[:8]}"
-        ev = Resource(
-            kind="Event",
-            metadata=ObjectMeta(
-                name=name, namespace=about.metadata.namespace
-            ),
-            spec={},
-            status={},
-        )
-        ev.spec = {
-            "involvedObject": {
-                "kind": about.kind,
-                "name": about.metadata.name,
-                "uid": about.metadata.uid,
-            },
-            "reason": reason,
-            "message": message,
-            "type": type_,
-        }
-        return self.create(ev)
+        CR statuses, `notebook_controller.go:87-103`). Content-derived
+        name: replayed/repeat emissions land on the existing Event
+        instead of multiplying (see `event_name`)."""
+        ev = event_resource(about, reason, message, type_=type_)
+        try:
+            return self.create(ev)
+        except AlreadyExists:
+            return self.get(
+                "Event", ev.metadata.name, about.metadata.namespace
+            )

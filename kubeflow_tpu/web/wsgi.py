@@ -385,11 +385,19 @@ class _Http11Handler(http.server.BaseHTTPRequestHandler):
     def _send(self, resp: Response) -> None:
         self.send_response(resp.status)
         body = resp.body
+        framed = False
         for key, value in resp.headers:
+            if key.lower() in ("content-length", "transfer-encoding"):
+                framed = True
             self.send_header(key, value)
         # Content-Length is what keeps the connection reusable: without
-        # it an HTTP/1.1 peer can only detect end-of-body by close.
-        self.send_header("Content-Length", str(len(body)))
+        # it an HTTP/1.1 peer can only detect end-of-body by close. A
+        # handler that set its own framing header keeps it — emitting a
+        # second Content-Length (or one beside Transfer-Encoding) gives
+        # the two framings a keep-alive peer could disagree on, the
+        # request-smuggling shape persistent connections make dangerous.
+        if not framed:
+            self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(body)

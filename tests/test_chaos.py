@@ -1,0 +1,409 @@
+"""Chaos layer units: seeded schedules, the fault proxy, and the client
+hardening each fault class forced (`kubeflow_tpu/testing/chaos.py`).
+
+The full fleet-under-faults story is tests/e2e/test_chaos_soak_e2e.py;
+these tests pin each mechanism in isolation so a soak failure bisects.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api.objects import ObjectMeta, Resource
+from kubeflow_tpu.controllers.runtime import retry_on_conflict
+from kubeflow_tpu.testing.apiserver_http import (
+    ApiServerApp,
+    CircuitBreaker,
+    HttpApiClient,
+    _stream_rejected,
+)
+from kubeflow_tpu.testing.chaos import (
+    FAULT_CLASSES,
+    ChaosProxy,
+    Fault,
+    FaultSchedule,
+)
+from kubeflow_tpu.testing.fake_apiserver import (
+    Conflict,
+    FakeApiServer,
+    Unavailable,
+)
+from kubeflow_tpu.web.wsgi import Response, serve
+
+
+def mk(name, kind="Widget", ns="default", spec=None):
+    return Resource(
+        kind=kind, metadata=ObjectMeta(name=name, namespace=ns),
+        spec=spec or {"size": 1},
+    )
+
+
+def wait_for(pred, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- schedule ---------------------------------------------------------------
+
+
+def test_schedule_reproducible_from_seed():
+    """The soak's repro contract: one integer reproduces the plan."""
+    a, b = FaultSchedule(1234), FaultSchedule(1234)
+    assert a.plan == b.plan
+    assert a.plan != FaultSchedule(1235).plan
+    # The first round carries one entry of EVERY class, so even a short
+    # soak can reach 100% class coverage.
+    first_round = {f.cls for f in a.plan[: len(FAULT_CLASSES)]}
+    assert first_round == set(FAULT_CLASSES)
+
+
+def test_schedule_eligibility_routing_and_coverage():
+    sched = FaultSchedule(7, faults_per_class=1, max_gap=1)
+    requests = [
+        ("POST", "/apis/Pod", ""),
+        ("GET", "/apis/_", "watch=true&stream=true&resourceVersion=0"),
+        ("GET", "/apis/_", "watch=true&resourceVersion=0"),
+        ("GET", "/apis/Pod", ""),
+    ]
+    seen: list[tuple[str, str]] = []
+    for _ in range(200):
+        if sched.exhausted:
+            break
+        for method, path, query in requests:
+            fault = sched.next_fault(method, path, query)
+            if fault is not None:
+                seen.append((fault.cls, method))
+                sched.mark_injected(fault)  # the proxy's effect report
+    assert sched.exhausted, sched
+    assert sched.coverage() == {c: 1 for c in FAULT_CLASSES}
+    for cls, method in seen:
+        if cls in ("delay_write", "crash_before_ack"):
+            assert method == "POST"
+        if cls in ("slow_stream", "truncate_stream", "stale_gone"):
+            assert method == "GET"
+
+
+def test_schedule_requeue_keeps_coverage_honest():
+    """A consumed-but-ineffective fault goes back in the plan: coverage
+    counts wire effects, never mere consumption, and the schedule is
+    not exhausted while an injection is pending or in flight."""
+    sched = FaultSchedule(3, faults_per_class=1, max_gap=1)
+    fault = None
+    while fault is None:  # skip gap cooldowns
+        fault = sched.next_fault(
+            "GET", "/apis/_", "watch=true&stream=true&resourceVersion=0"
+        )
+    assert not sched.exhausted  # in flight
+    sched.requeue(fault)
+    assert sched.coverage()[fault.cls] == 0
+    assert not sched.exhausted
+    again = None
+    while again is None:
+        again = sched.next_fault(
+            "GET", "/apis/_", "watch=true&stream=true&resourceVersion=0"
+        )
+    assert again == fault  # requeued at the head
+    sched.mark_injected(again)
+    assert sched.coverage()[fault.cls] == 1
+
+
+def test_empty_schedule_injects_nothing():
+    sched = FaultSchedule(0, faults_per_class=0)
+    assert sched.plan == ()
+    assert sched.next_fault("GET", "/apis/Pod", "") is None
+    assert sched.exhausted
+
+
+# -- proxy ------------------------------------------------------------------
+
+
+@pytest.fixture()
+def proxied():
+    """FakeApiServer behind the facade behind a chaos proxy, plus a
+    hardened client pointed at the proxy. The schedule starts EMPTY;
+    tests stage targeted faults via stage()."""
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    schedule = FaultSchedule(0, faults_per_class=0)
+    proxy = ChaosProxy(
+        "127.0.0.1", server.server_port, schedule
+    ).start()
+    client = HttpApiClient(
+        proxy.base_url,
+        timeout=5.0,
+        watch_poll_timeout=1.0,
+        watch_retry=0.05,
+        retry_base=0.02,
+        breaker_cooldown=0.2,
+        stream_degraded_seconds=0.3,
+    )
+
+    def stage(*faults):
+        schedule._pending.extend(faults)
+
+    yield api, client, stage, schedule
+    client.close()
+    proxy.stop()
+    server.shutdown()
+
+
+def test_proxy_passthrough_keepalive(proxied):
+    """No faults staged: the proxy is invisible — CRUD works and the
+    client's pooled connections survive end-to-end."""
+    api, client, _, _ = proxied
+    for i in range(10):
+        client.create(mk(f"w{i}"))
+    assert len(client.list("Widget")) == 10
+    assert client.handshakes <= 2, client.handshakes
+    got = client.get("Widget", "w3")
+    got.status["phase"] = "Ready"
+    client.update_status(got)
+    assert api.get("Widget", "w3").status["phase"] == "Ready"
+
+
+def test_injected_503_burst_write_retries_once_landed(proxied):
+    """A 5xx burst never reached the server: the bounded retry lands the
+    write exactly once."""
+    api, client, stage, _ = proxied
+    stage(Fault("error_5xx", 2.0, 0))
+    created = client.create(mk("burst-victim"))
+    assert created.metadata.resource_version > 0
+    assert len(api.list("Widget")) == 1
+    assert client.retries_total >= 1
+
+
+def test_crash_before_ack_create_recovers_without_duplicate(proxied):
+    """The duplicate-side-effect trap: the create COMMITTED upstream but
+    the ack died. The retry hits AlreadyExists, recognizes the stored
+    object as its own write, and returns it — one object, no error."""
+    api, client, stage, _ = proxied
+    stage(Fault("crash_before_ack", 0.0, 0))
+    created = client.create(mk("ambiguous", spec={"size": 9}))
+    assert created.spec == {"size": 9}
+    assert len(api.list("Widget")) == 1
+    assert client.retries_total >= 1
+
+
+def test_crash_before_ack_create_recovers_past_mutating_admission(proxied):
+    """Admission that ADDS defaulted fields must not make the client
+    disown its own committed create: recovery uses containment, not
+    spec equality."""
+    api, client, stage, _ = proxied
+
+    def default_tier(obj):
+        obj.spec.setdefault("tier", "standard")
+        return obj
+
+    api.register_admission(default_tier, "Widget")
+    stage(Fault("crash_before_ack", 0.0, 0))
+    created = client.create(mk("defaulted", spec={"size": 3}))
+    assert created.spec == {"size": 3, "tier": "standard"}
+    assert len(api.list("Widget")) == 1
+
+
+def test_crash_before_ack_delete_recovers(proxied):
+    api, client, stage, _ = proxied
+    client.create(mk("doomed"))
+    stage(Fault("crash_before_ack", 0.0, 0))
+    client.delete("Widget", "doomed")  # must not raise NotFound
+    assert api.list("Widget") == []
+
+
+def test_reset_mid_response_read_survives(proxied):
+    """A severed response on a read: the GET retries (reads are
+    idempotent) or surfaces a clean error the caller's backoff absorbs;
+    either way the next call works."""
+    api, client, stage, _ = proxied
+    client.create(mk("steady"))
+    stage(Fault("reset_mid_response", 0.5, 0))
+    try:
+        client.get("Widget", "steady")
+    except Exception:
+        pass  # one failed read is allowed; the endpoint must recover
+    assert client.get("Widget", "steady").metadata.name == "steady"
+
+
+def test_stale_gone_watch_relists_and_streams_on(proxied):
+    """An injected 410 forces the informer's relist path; no events are
+    lost across it."""
+    api, client, stage, _ = proxied
+    seen = []
+    client.watch(lambda ev, o: seen.append(o.metadata.name), "Widget")
+    api.create(mk("before"))
+    assert wait_for(lambda: "before" in seen), seen
+    stage(Fault("stale_gone", 0.0, 0))
+    api.create(mk("after-gone"))
+    assert wait_for(lambda: "after-gone" in seen), seen
+
+
+def test_truncated_stream_reconnects_no_loss(proxied):
+    """A stream severed mid-body (no terminal chunk) is a transport
+    failure: the client re-opens and resumes from its bookmark."""
+    api, client, stage, _ = proxied
+    seen = []
+    client.watch(lambda ev, o: seen.append(o.metadata.name), "Widget")
+    api.create(mk("first"))
+    assert wait_for(lambda: "first" in seen), seen
+    stage(Fault("truncate_stream", 64.0, 0))
+    for i in range(5):
+        api.create(mk(f"tail{i}"))
+    assert wait_for(
+        lambda: all(f"tail{i}" in seen for i in range(5)), timeout=30.0
+    ), seen
+
+
+def test_slow_stream_still_delivers(proxied):
+    api, client, stage, _ = proxied
+    seen = []
+    client.watch(lambda ev, o: seen.append(o.metadata.name), "Widget")
+    stage(Fault("slow_stream", 0.05, 0))
+    api.create(mk("sluggish"))
+    assert wait_for(lambda: "sluggish" in seen, timeout=30.0), seen
+
+
+def test_delayed_write_still_exactly_once(proxied):
+    api, client, stage, _ = proxied
+    stage(Fault("delay_write", 0.2, 0))
+    t0 = time.monotonic()
+    client.create(mk("held"))
+    assert time.monotonic() - t0 >= 0.15
+    assert len(api.list("Widget")) == 1
+
+
+# -- client hardening units -------------------------------------------------
+
+
+def test_stream_rejection_classifier():
+    """Only an AFFIRMATIVE stream rejection may trigger the long-poll
+    fallback — the round-5 bug was any stray 400 disabling streaming
+    for the process lifetime."""
+    assert _stream_rejected('{"success": false, "log": "unknown parameter: stream"}')
+    assert _stream_rejected("streaming watch not supported")
+    assert _stream_rejected("invalid query parameter: stream")
+    assert not _stream_rejected('{"log": "resourceVersion must be an integer"}')
+    assert not _stream_rejected("chaos: injected apiserver outage")
+    # An intermediary's "upstream" is not the stream parameter, and a
+    # transient that HAPPENS to a stream is not a rejection OF streams.
+    assert not _stream_rejected("upstream connect error or disconnect")
+    assert not _stream_rejected("stream timeout")
+    assert not _stream_rejected("stream reset by peer")
+    # Non-object JSON bodies classify without crashing.
+    assert not _stream_rejected("null")
+    assert not _stream_rejected("[1, 2]")
+    assert not _stream_rejected("")
+
+
+def test_circuit_breaker_opens_half_opens_closes():
+    br = CircuitBreaker(threshold=3, cooldown=0.1)
+    assert br.allow()
+    for _ in range(3):
+        br.failure()
+    assert br.trips == 1
+    assert not br.allow()  # open: fail fast
+    time.sleep(0.12)
+    assert br.allow()       # half-open probe slot
+    assert not br.allow()   # only ONE probe per cooldown window
+    br.success()
+    assert br.allow() and br.allow()  # closed again
+
+
+def test_client_breaker_sheds_to_fail_fast():
+    """Repeated transport failures open the endpoint's circuit: the
+    client stops hammering a dead socket and fails fast with
+    Unavailable until the cooldown probe."""
+    import socket
+
+    # A port with nothing behind it (bind, never accept, then close —
+    # connects are refused immediately).
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    client = HttpApiClient(
+        f"http://127.0.0.1:{port}",
+        timeout=0.5,
+        breaker_threshold=3,
+        breaker_cooldown=30.0,
+    )
+    for _ in range(3):
+        with pytest.raises(OSError):
+            client.get("Widget", "x")
+    with pytest.raises(Unavailable) as exc:
+        client.get("Widget", "x")
+    assert "circuit open" in str(exc.value)
+    (trips, is_open), = [
+        v for k, v in client.breaker_state().items() if "Widget" in k
+    ]
+    assert trips == 1 and is_open
+    client.close()
+
+
+def test_record_event_replay_is_idempotent():
+    """Event names derive from content: a replayed emission (lost ack →
+    retry) lands on the SAME Event instead of duplicating it; distinct
+    occurrences still record separately."""
+    api = FakeApiServer()
+    about = api.create(mk("thing"))
+    first = api.record_event(about, "Tested", "hello")
+    again = api.record_event(about, "Tested", "hello")
+    assert first.metadata.name == again.metadata.name
+    assert len(api.list("Event")) == 1
+    api.record_event(about, "Tested", "different message")
+    assert len(api.list("Event")) == 2
+
+
+def test_retry_on_conflict_rereads_until_success():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise Conflict("stale rv")
+        return "landed"
+
+    assert retry_on_conflict(flaky) == "landed"
+    assert len(calls) == 3
+    with pytest.raises(Conflict):
+        retry_on_conflict(lambda: (_ for _ in ()).throw(Conflict("x")),
+                          attempts=2)
+
+
+def test_wsgi_skips_auto_content_length_when_framed():
+    """A handler that sets its own framing header keeps it: the server
+    must never emit two Content-Lengths (or Content-Length beside
+    Transfer-Encoding) on a keep-alive connection."""
+    import http.client
+
+    from kubeflow_tpu.web.wsgi import App
+
+    app = App("framing")
+    body = b'{"ok": true}'
+
+    @app.route("/framed")
+    def framed(req):
+        return Response(
+            body, headers=[("Content-Length", str(len(body)))]
+        )
+
+    @app.route("/plain")
+    def plain(req):
+        return Response(body)
+
+    server, _ = serve(app, host="127.0.0.1", port=0)
+    try:
+        for path in ("/framed", "/plain"):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.server_port, timeout=5
+            )
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            lengths = resp.headers.get_all("Content-Length")
+            assert lengths == [str(len(body))], (path, lengths)
+            assert resp.read() == body
+            conn.close()
+    finally:
+        server.shutdown()

@@ -39,6 +39,55 @@ def test_kind_aliases():
     assert resolve_kind("FancyNewKind") == "FancyNewKind"  # pass-through
 
 
+def test_kind_fallback_singularizes_sibilant_plurals():
+    """`-es`/`-ses` plurals must not derive impossible kinds (the old
+    strip-one-s produced `Statuse`/`Classe`) — while silent-e stems
+    (`caches`, `sizes`) keep their old correct derivation."""
+    assert resolve_kind("statuses") == "Status"
+    assert resolve_kind("classes") == "Class"
+    assert resolve_kind("boxes") == "Box"
+    assert resolve_kind("dishes") == "Dish"
+    assert resolve_kind("caches") == "Cache"      # silent-e stem kept
+    assert resolve_kind("sizes") == "Size"        # silent-e stem kept
+    assert resolve_kind("policies") == "Policy"   # -ies unchanged
+    assert resolve_kind("leases") == "Lease"      # table, and -s form
+    assert resolve_kind("widgets") == "Widget"    # plain -s unchanged
+
+
+def test_kind_fallback_disambiguates_against_live_objects(server):
+    """Genuinely ambiguous plurals resolve to whichever candidate has
+    live objects — the heuristic's runner-up wins when the cluster says
+    so (`churches` is church+es, the -che reading's opposite)."""
+    api, url = server
+
+    class FakeClient:
+        def list(self, kind, **kw):
+            return ["obj"] if kind == "Church" else []
+
+    assert resolve_kind("churches", FakeClient()) == "Church"
+    # And the reverse ambiguity: live Cache objects beat the es-strip.
+    class FakeClient2:
+        def list(self, kind, **kw):
+            return ["obj"] if kind == "Cache" else []
+
+    assert resolve_kind("caches", FakeClient2()) == "Cache"
+
+
+def test_kind_fallback_warns_when_no_live_objects(server):
+    """A derived (guessed) kind with zero live objects warns on stderr —
+    an empty table from a wrong guess must not look like a quiet
+    cluster."""
+    api, url = server
+    rc, out, err = run(url, "get", "gizmos")
+    assert rc == 0
+    assert "no live 'Gizmo' objects" in err, err
+    api.create(new_resource("Gizmo", "g1", "default", spec={}))
+    rc, out, err = run(url, "get", "gizmos")
+    assert rc == 0
+    assert "no live" not in err, err
+    assert "g1" in out
+
+
 def test_get_table_and_yaml(server):
     api, url = server
     nb = new_resource("Notebook", "nb1", "team", spec={"image": "i"})

@@ -228,8 +228,20 @@ def test_client_watch_filters_by_kind(served):
     client.watch(lambda ev, o: gadgets.append(o.metadata.name), "Gadget")
     api.create(mk("w", kind="Widget"))
     api.create(mk("g", kind="Gadget"))
-    assert wait_for(lambda: "w" in widgets and "g" in gadgets)
+    # Sentinels AFTER the interesting writes: the watch stream delivers
+    # in rv order, so once both sentinels have been dispatched every
+    # earlier event has too — the negative assertions below can never
+    # race late delivery. Deadline-polled with a generous bound (the
+    # old 10 s wall-clock wait flaked once under full-suite load).
+    api.create(mk("w-sentinel", kind="Widget"))
+    api.create(mk("g-sentinel", kind="Gadget"))
+    assert wait_for(
+        lambda: "w-sentinel" in widgets and "g-sentinel" in gadgets,
+        timeout=60.0,
+    ), (widgets, gadgets)
+    assert "w" in widgets and "g" in gadgets
     assert "g" not in widgets and "w" not in gadgets
+    assert "g-sentinel" not in widgets and "w-sentinel" not in gadgets
 
 
 def test_client_watch_recovers_from_gone(served):
