@@ -61,7 +61,10 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--workload",
-        choices=("all", "resnet", "lm", "serving", "study", "chaos"),
+        choices=(
+            "all", "resnet", "lm", "serving", "study", "chaos",
+            "controlplane",
+        ),
         default="all",
         help="all (default) = resnet then lm, so the driver artifact "
         "carries both headline numbers; resnet = the driver's parsed "
@@ -70,7 +73,9 @@ def main() -> None:
         "latency percentiles; study = HP sweep trials/hour through the "
         "full control plane; chaos = the nightly seeded fault-injection "
         "soak (prints the seed so any failure reproduces with "
-        "KFTPU_CHAOS_SEED=<seed>)",
+        "KFTPU_CHAOS_SEED=<seed>); controlplane = watch fan-out "
+        "events/sec, list latency, and write-to-delivery latency through "
+        "the HTTP facade against both store backends",
     )
     parser.add_argument(
         "--chaos-seed",
@@ -131,6 +136,35 @@ def main() -> None:
     )
     parser.add_argument("--warmup-steps", type=int, default=5)
     parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument(
+        "--cp-watchers", type=int, default=50,
+        help="controlplane only: streaming watch connections held "
+        "against the facade during the fan-out phase",
+    )
+    parser.add_argument(
+        "--cp-writers", type=int, default=4,
+        help="controlplane only: concurrent writer threads (each owns "
+        "one object and updates it --cp-events times)",
+    )
+    parser.add_argument(
+        "--cp-events", type=int, default=40,
+        help="controlplane only: updates per writer in the fan-out phase",
+    )
+    parser.add_argument(
+        "--cp-objects", type=int, default=5000,
+        help="controlplane only: store population for the list-latency "
+        "phase",
+    )
+    parser.add_argument(
+        "--cp-list-reps", type=int, default=20,
+        help="controlplane only: timed list calls over the populated "
+        "store",
+    )
+    parser.add_argument(
+        "--cp-payload", type=int, default=2048,
+        help="controlplane only: spec payload bytes per object "
+        "(controls serialized event size)",
+    )
     args = parser.parse_args()
     if args.workload in ("lm", "all") and (
         args.head_dim <= 0 or 1024 % args.head_dim
@@ -150,6 +184,8 @@ def main() -> None:
         return bench_study(args)
     if args.workload == "chaos":
         return bench_chaos(args)
+    if args.workload == "controlplane":
+        return bench_controlplane(args)
     bench_resnet(args)
     if args.workload == "all":
         # ResNet line first (the driver parses it), LM headline after.
@@ -610,6 +646,343 @@ def bench_chaos(args) -> None:
         f"{backends})",
         file=sys.stderr,
     )
+
+
+def _controlplane_backends():
+    """(name, factory) for every available store backend. The native
+    toolchain may be absent; the metric must not claim coverage the run
+    didn't have, so unavailable backends are reported and skipped."""
+    from kubeflow_tpu.testing import FakeApiServer
+
+    backends = [("python", FakeApiServer)]
+    try:
+        from kubeflow_tpu.native.apiserver import NativeApiServer
+
+        NativeApiServer()  # probe the toolchain/build now, not mid-bench
+        backends.append(("native", NativeApiServer))
+    except Exception as e:
+        print(f"# controlplane: native backend unavailable ({e}); "
+              "python only", file=sys.stderr)
+    return backends
+
+
+class _CpFleet:
+    """N streaming-watch connections driven by ONE selector loop.
+
+    A fan-out benchmark's consumer must be thinner than the server it
+    measures: inside the timed window each socket costs bulk recv()s, a
+    substring count for the exit condition, and an append of (arrival
+    time, raw bytes). HTTP chunk deframing, line splitting, and JSON
+    parsing all happen in digest() after the clock stops. (A thread or
+    an http.client/json stack per watcher measures the GIL and the
+    stdlib, not the apiserver — real fleets are separate processes, and
+    load generators are thin for exactly this reason.) Connections are
+    established in connect(), before the caller starts its clock."""
+
+    _EVENT_MARK = b'"type":"MODIFIED"'
+
+    def __init__(self, base: str, n: int, rv0: int, expected_each: int):
+        import urllib.parse
+
+        parts = urllib.parse.urlsplit(base)
+        self._addr = (parts.hostname, parts.port)
+        self._host = parts.hostname
+        self.rv0 = rv0
+        self.expected = expected_each
+        self._states = [
+            {"sock": None, "chunks": [], "count": 0, "tail": b""}
+            for _ in range(n)
+        ]
+
+    def _request(self) -> bytes:
+        return (
+            "GET /apis/FanObj?watch=true&stream=true&namespace=bench"
+            f"&resourceVersion={self.rv0}&timeoutSeconds=120 HTTP/1.1\r\n"
+            f"Host: {self._host}\r\nConnection: close\r\n\r\n"
+        ).encode()
+
+    def _open(self, st: dict) -> None:
+        import socket
+
+        st["sock"] = socket.create_connection(self._addr, timeout=30)
+
+    def connect(self) -> None:
+        for st in self._states:
+            self._open(st)
+
+    def run(self, deadline_seconds: float) -> bool:
+        """Send all requests, then drain single-threaded until every
+        watcher counted `expected` events (True) or the deadline passed
+        (False). A socket the server closes early is reopened from rv0
+        with its capture reset (digest() dedups redeliveries)."""
+        import selectors
+
+        sel = selectors.DefaultSelector()
+        req = self._request()
+        for st in self._states:
+            st["sock"].sendall(req)
+            st["sock"].setblocking(False)
+            sel.register(st["sock"], selectors.EVENT_READ, st)
+        done = 0
+        deadline = time.monotonic() + deadline_seconds
+        try:
+            while done < len(self._states):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                for key, _ in sel.select(min(1.0, remaining)):
+                    st = key.data
+                    try:
+                        data = key.fileobj.recv(1 << 20)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError:
+                        data = b""
+                    if data:
+                        st["chunks"].append((time.time(), data))
+                        # Count only within COMPLETE lines: the mark
+                        # leads its (multi-KB) line, so counting it in
+                        # a partial line would close the socket before
+                        # the line's tail arrived and lose the event.
+                        scan = st["tail"] + data
+                        cut = scan.rfind(b"\n") + 1
+                        st["count"] += scan[:cut].count(self._EVENT_MARK)
+                        st["tail"] = scan[cut:]
+                        if st["count"] < self.expected:
+                            continue
+                    sel.unregister(key.fileobj)
+                    key.fileobj.close()
+                    if st["count"] >= self.expected:
+                        done += 1
+                        continue
+                    # Early server-side close: reopen and recount.
+                    st["chunks"], st["count"], st["tail"] = [], 0, b""
+                    self._open(st)
+                    st["sock"].sendall(req)
+                    st["sock"].setblocking(False)
+                    sel.register(st["sock"], selectors.EVENT_READ, st)
+            return True
+        finally:
+            sel.close()
+
+    def digest(self) -> tuple[int, list[float]]:
+        """Post-window parse of the raw captures: unique (name, seq)
+        deliveries and per-delivery latency (arrival wall-clock of the
+        recv that completed the line, minus the writer's in-object
+        stamp)."""
+        delivered = 0
+        latencies: list[float] = []
+        for st in self._states:
+            buf = b""
+            payload = bytearray()
+            header_done = False
+            seen: set = set()
+            for t_recv, data in st["chunks"]:
+                buf += data
+                if not header_done:
+                    k = buf.find(b"\r\n\r\n")
+                    if k < 0:
+                        continue
+                    buf = buf[k + 4:]
+                    header_done = True
+                while True:  # deframe complete chunks
+                    i = buf.find(b"\r\n")
+                    if i < 0:
+                        break
+                    try:
+                        size = int(buf[:i], 16)
+                    except ValueError:
+                        size = 0
+                    if size == 0 or len(buf) < i + 2 + size + 2:
+                        break
+                    payload += buf[i + 2 : i + 2 + size]
+                    buf = buf[i + 2 + size + 2:]
+                while True:  # consume complete event lines
+                    j = payload.find(b"\n")
+                    if j < 0:
+                        break
+                    line = bytes(payload[:j])
+                    del payload[: j + 1]
+                    if not line.startswith(b'{"type":"MODIFIED"'):
+                        continue
+                    obj = json.loads(line)["object"]
+                    key = (obj["metadata"]["name"], obj["spec"]["seq"])
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    delivered += 1
+                    latencies.append(t_recv - obj["spec"]["t"])
+        return delivered, sorted(latencies)
+
+
+def bench_controlplane(args) -> None:
+    """Control-plane hot paths through the HTTP facade, both backends:
+
+    - FAN-OUT: N streaming watchers held open while M writers churn
+      updates; deliveries/sec across the fleet is the shared-watch-cache
+      headline (each event should be serialized once, not once per
+      watcher).
+    - LIST: p99 latency of a full-kind list at --cp-objects population
+      (the indexed-store headline).
+    - DELIVERY LATENCY: write-to-watcher-delivery p99, stamped at the
+      writer and measured at each watcher (same host, same clock).
+
+    Emits one driver-parsable JSON line per metric per backend.
+    """
+    import threading
+
+    from kubeflow_tpu.api.objects import new_resource
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+    from kubeflow_tpu.web.wsgi import serve as wsgi_serve
+
+    # Structured padding (not one big string): real control-plane
+    # objects are nested maps, and every layer — copy, serialize,
+    # parse — must pay proportionally to object size for the bench to
+    # measure what production pays.
+    payload = {
+        f"k{j:04d}": "x" * 24 for j in range(max(1, args.cp_payload // 32))
+    }
+    for backend, factory in _controlplane_backends():
+        api = factory()
+        server, _ = wsgi_serve(ApiServerApp(api), host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{server.server_port}"
+        try:
+            # -- list latency over a populated store -----------------------
+            for i in range(args.cp_objects):
+                api.create(
+                    new_resource(
+                        "ListObj", f"obj-{i:06d}", "bench",
+                        spec={"i": i, "pad": dict(list(payload.items())[:2])},
+                    )
+                )
+            lister = HttpApiClient(base)
+            lister.list("ListObj", namespace="bench")  # warm the pool
+            list_lat: list[float] = []
+            for _ in range(max(1, args.cp_list_reps)):
+                t0 = time.perf_counter()
+                items = lister.list("ListObj", namespace="bench")
+                list_lat.append(time.perf_counter() - t0)
+            assert len(items) == args.cp_objects
+            list_lat.sort()
+            list_p99_ms = list_lat[int(len(list_lat) * 0.99)] * 1000
+
+            # -- fan-out + delivery latency --------------------------------
+            writers = max(1, args.cp_writers)
+            events_per_writer = max(1, args.cp_events)
+            expected = writers * events_per_writer
+            clients = [HttpApiClient(base) for _ in range(writers)]
+            owned = []
+            for w, client in enumerate(clients):
+                owned.append(
+                    client.create(
+                        new_resource(
+                            "FanObj", f"fan-{w}", "bench",
+                            spec={"seq": -1, "t": time.time(),
+                                  "pad": payload},
+                        )
+                    )
+                )
+            rv0 = api.current_rv
+            want = expected * args.cp_watchers
+
+            # -- live phase: write→delivery latency ------------------------
+            # The fleet drains on the main thread while the writers run;
+            # each delivery's latency is its arrival time minus the
+            # writer's in-object stamp.
+            fleet = _CpFleet(base, args.cp_watchers, rv0, expected)
+            fleet.connect()
+
+            def write(w: int) -> None:
+                client, obj = clients[w], owned[w]
+                for seq in range(events_per_writer):
+                    obj = obj.thaw() if hasattr(obj, "thaw") else obj
+                    obj.spec["seq"] = seq
+                    obj.spec["t"] = time.time()
+                    obj = client.update(obj)
+
+            writer_threads = [
+                threading.Thread(target=write, args=(w,), daemon=True)
+                for w in range(writers)
+            ]
+            t0 = time.perf_counter()
+            for t in writer_threads:
+                t.start()
+            live_ok = fleet.run(600.0)
+            live_elapsed = time.perf_counter() - t0
+            for t in writer_threads:
+                t.join()
+            # Clock stopped — now pay for parsing, outside the window.
+            delivered, latencies = fleet.digest()
+            if not live_ok or delivered < want:
+                raise SystemExit(
+                    f"controlplane bench ({backend}): live watchers saw "
+                    f"{delivered}/{want} deliveries before the deadline"
+                )
+            delivery_p99_ms = latencies[int(len(latencies) * 0.99)] * 1000
+
+            # -- fan-out throughput: replay drain --------------------------
+            # The live phase is paced by the writers; fan-out capacity is
+            # measured where the server actually fans out — a fresh
+            # N-watcher fleet resuming from rv0 drains the full event
+            # history (the apiserver watch-cache resume scenario: every
+            # event already committed, every watcher wants all of them).
+            # Connection setup happens before the clock starts.
+            fleet_b = _CpFleet(base, args.cp_watchers, rv0, expected)
+            fleet_b.connect()
+            t0 = time.perf_counter()
+            drain_ok = fleet_b.run(600.0)
+            elapsed = time.perf_counter() - t0
+            drained, _lat = fleet_b.digest()
+            if not drain_ok or drained < want:
+                raise SystemExit(
+                    f"controlplane bench ({backend}): replay fleet "
+                    f"drained {drained}/{want} before the deadline"
+                )
+            fanout = drained / elapsed
+        finally:
+            server.shutdown()
+            close = getattr(api, "close", None)
+            if close is not None:
+                close()
+
+        for metric, value, unit in (
+            (
+                f"controlplane_fanout_deliveries_per_sec_{backend}",
+                round(fanout, 1),
+                f"event deliveries/sec (replay drain: {args.cp_watchers} "
+                f"watchers x {expected} events, {args.cp_payload}B "
+                "payload)",
+            ),
+            (
+                f"controlplane_list_p99_ms_{backend}",
+                round(list_p99_ms, 2),
+                f"ms (full-kind list at {args.cp_objects} objects)",
+            ),
+            (
+                f"controlplane_delivery_p99_ms_{backend}",
+                round(delivery_p99_ms, 2),
+                "ms (write to watcher delivery, streaming watch)",
+            ),
+        ):
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": value,
+                        "unit": unit,
+                        "vs_baseline": None,  # greenfield: no reference
+                    }
+                )
+            )
+        print(
+            f"# controlplane[{backend}]: replay drain {drained} "
+            f"deliveries in {elapsed:.2f}s ({fanout:.0f}/s); live phase "
+            f"{delivered} deliveries in {live_elapsed:.2f}s; list p50="
+            f"{list_lat[len(list_lat) // 2] * 1000:.1f}ms "
+            f"p99={list_p99_ms:.1f}ms; delivery p99="
+            f"{delivery_p99_ms:.1f}ms",
+            file=sys.stderr,
+        )
 
 
 def bench_study(args) -> None:

@@ -17,8 +17,103 @@ GROUP = "kubeflow-tpu.org"
 VERSION = "v1"
 
 
+class FrozenResourceError(TypeError):
+    """Raised on any mutation of a frozen Resource snapshot.
+
+    The copy-on-write store (docs/perf.md) commits ONE copy per write
+    and then shares that frozen snapshot with every consumer — journal,
+    dispatch queue, watch handlers, get/list results. A consumer that
+    needs to mutate takes a private copy with `.thaw()` first; mutating
+    the shared snapshot in place would corrupt every other consumer, so
+    it fails loudly here instead."""
+
+
+_FROZEN_HINT = (
+    "this Resource is a frozen shared snapshot (copy-on-write store); "
+    "call .thaw() on the Resource for a private mutable copy"
+)
+
+
+class _FrozenDict(dict):
+    """Immutable dict for frozen snapshots. Still a real dict (json,
+    iteration, equality, C-level construction all work); only the
+    mutating surface is closed. deepcopy/thaw yields plain mutable
+    containers."""
+
+    __slots__ = ()
+
+    def _frozen(self, *args, **kwargs):
+        raise FrozenResourceError(_FROZEN_HINT)
+
+    __setitem__ = __delitem__ = _frozen
+    __ior__ = _frozen
+    clear = pop = popitem = setdefault = update = _frozen
+
+    def __deepcopy__(self, memo):
+        return {k: copy.deepcopy(v, memo) for k, v in self.items()}
+
+    def __copy__(self):
+        return dict(self)
+
+    def __reduce__(self):
+        return (dict, (), None, None, iter(self.items()))
+
+
+class _FrozenList(list):
+    """Immutable list for frozen snapshots (see _FrozenDict)."""
+
+    __slots__ = ()
+
+    def _frozen(self, *args, **kwargs):
+        raise FrozenResourceError(_FROZEN_HINT)
+
+    __setitem__ = __delitem__ = __iadd__ = __imul__ = _frozen
+    append = extend = insert = pop = remove = _frozen
+    clear = sort = reverse = _frozen
+
+    def __deepcopy__(self, memo):
+        return [copy.deepcopy(v, memo) for v in self]
+
+    def __copy__(self):
+        return list(self)
+
+    def __reduce__(self):
+        return (list, (), None, iter(self))
+
+
+def _frozen_value(value):
+    """Deep-freeze plain JSON-ish containers in one walk."""
+    if isinstance(value, dict):
+        return _FrozenDict(
+            (k, _frozen_value(v)) for k, v in value.items()
+        )
+    if isinstance(value, list):
+        return _FrozenList(_frozen_value(v) for v in value)
+    return value
+
+
+class _Freezable:
+    """Attribute-level mutation guard shared by Resource/ObjectMeta.
+    Freezing writes through __dict__ (bypassing the guard); dataclass
+    __init__ uses normal setattr and stays unaffected until frozen."""
+
+    def __setattr__(self, name, value):
+        if self.__dict__.get("_kftpu_frozen"):
+            raise FrozenResourceError(_FROZEN_HINT)
+        object.__setattr__(self, name, value)
+
+    def __delattr__(self, name):
+        if self.__dict__.get("_kftpu_frozen"):
+            raise FrozenResourceError(_FROZEN_HINT)
+        object.__delattr__(self, name)
+
+    @property
+    def frozen(self) -> bool:
+        return bool(self.__dict__.get("_kftpu_frozen"))
+
+
 @dataclasses.dataclass
-class ObjectMeta:
+class ObjectMeta(_Freezable):
     name: str
     namespace: str = "default"
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -62,9 +157,20 @@ class ObjectMeta:
             owner_references=copy.deepcopy(d.get("ownerReferences") or []),
         )
 
+    def __deepcopy__(self, memo):
+        return ObjectMeta.from_dict(self.to_dict())  # private mutable copy
+
+    def _freeze(self) -> None:
+        d = self.__dict__
+        d["labels"] = _frozen_value(d["labels"])
+        d["annotations"] = _frozen_value(d["annotations"])
+        d["finalizers"] = _frozen_value(d["finalizers"])
+        d["owner_references"] = _frozen_value(d["owner_references"])
+        d["_kftpu_frozen"] = True
+
 
 @dataclasses.dataclass
-class Resource:
+class Resource(_Freezable):
     kind: str
     metadata: ObjectMeta
     spec: dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -83,6 +189,81 @@ class Resource:
             status=copy.deepcopy(self.status),
             api_version=self.api_version,
         )
+
+    def __deepcopy__(self, memo):
+        # copy.deepcopy of a (possibly frozen) Resource is a private
+        # mutable copy — same contract as .deepcopy()/.thaw().
+        return self.deepcopy()
+
+    def freeze(self) -> "Resource":
+        """Make this object (deeply) immutable, in place, and return it.
+
+        The copy-on-write store calls this once per commit; from then on
+        the snapshot is shared by the journal, the dispatch queue, every
+        watch handler, and get/list results (docs/perf.md). Any mutation
+        attempt raises FrozenResourceError."""
+        d = self.__dict__
+        if d.get("_kftpu_frozen"):
+            return self
+        self.metadata._freeze()
+        d["spec"] = _frozen_value(d["spec"])
+        d["status"] = _frozen_value(d["status"])
+        d["_kftpu_frozen"] = True
+        return self
+
+    def thaw(self) -> "Resource":
+        """A mutable Resource: a private deep copy when frozen, self
+        otherwise (HttpApiClient results are already private parses, so
+        the read-modify-write idiom is uniform across clients)."""
+        return self.deepcopy() if self.frozen else self
+
+    def _wire_dict(self) -> dict:
+        """to_dict() without the defensive copies — for immediate
+        serialization only; the result aliases this resource's (frozen)
+        containers and must never be stored or mutated."""
+        m = self.metadata
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": {
+                "name": m.name,
+                "namespace": m.namespace,
+                "labels": m.labels,
+                "annotations": m.annotations,
+                "uid": m.uid,
+                "resourceVersion": m.resource_version,
+                "generation": m.generation,
+                "creationTimestamp": m.creation_timestamp,
+                "deletionTimestamp": m.deletion_timestamp,
+                "finalizers": m.finalizers,
+                "ownerReferences": m.owner_references,
+            },
+            "spec": self.spec,
+            "status": self.status,
+        }
+
+    def wire_bytes(self) -> bytes:
+        """Compact-JSON wire form of this resource. On a frozen snapshot
+        the bytes are computed ONCE and cached — immutability makes that
+        safe — so every consumer (get/list responses, the watch cache)
+        shares one serialization per commit (docs/perf.md). On a mutable
+        resource it serializes fresh each call."""
+        import json as _json
+
+        if not self.frozen:
+            return _json.dumps(
+                self._wire_dict(), separators=(",", ":")
+            ).encode()
+        cached = self.__dict__.get("_kftpu_wire")
+        if cached is None:
+            # __dict__ write bypasses the freeze guard by design: this
+            # is a cache of derived state, not a mutation (idempotent —
+            # a concurrent double-compute yields identical bytes).
+            cached = _json.dumps(
+                self._wire_dict(), separators=(",", ":")
+            ).encode()
+            self.__dict__["_kftpu_wire"] = cached
+        return cached
 
     def to_dict(self) -> dict:
         return {

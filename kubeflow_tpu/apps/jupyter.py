@@ -446,7 +446,7 @@ class JupyterApp(App):
         body = req.json()
         if "stopped" not in body:
             raise HttpError(400, "PATCH body needs {'stopped': bool}")
-        nb = self.api.get("Notebook", name, ns)
+        nb = self.api.get("Notebook", name, ns).thaw()
         if body["stopped"]:
             nb.metadata.annotations.setdefault(
                 STOP_ANNOTATION, str(time.time())

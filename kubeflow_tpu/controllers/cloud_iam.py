@@ -275,7 +275,7 @@ def _annotate_editor_sa(
     """Set (or, with value=None, remove) an annotation on the namespace's
     default-editor KSA (`patchAnnotation`, both reference plugins)."""
     try:
-        sa = api.get("ServiceAccount", EDITOR_SA, namespace)
+        sa = api.get("ServiceAccount", EDITOR_SA, namespace).thaw()
     except NotFound:
         raise PluginError(
             f"ServiceAccount {namespace}/{EDITOR_SA} not found — plugins "

@@ -171,7 +171,9 @@ class CronWorkflowController:
         error: str | None = None,
         requeue: float | None = None,
     ) -> Result:
-        fresh = api.get(cron_api.KIND, cw.metadata.name, cw.metadata.namespace)
+        fresh = api.get(
+            cron_api.KIND, cw.metadata.name, cw.metadata.namespace
+        ).thaw()
         new_status = dict(fresh.status)
         if last_schedule is not None:
             new_status["lastScheduleTime"] = last_schedule

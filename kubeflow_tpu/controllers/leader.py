@@ -161,6 +161,7 @@ class LeaderElector:
             # Ownership change: new term, new fencing token.
             spec["acquireTime"] = now
             spec["leaseTransitions"] = int(spec.get("leaseTransitions", 0)) + 1
+        lease = lease.thaw()
         lease.spec = spec
         try:
             updated = self.api.update(lease)  # rv CAS
@@ -256,7 +257,7 @@ class LeaderElector:
         try:
             lease = self.api.get(LEASE_KIND, self.name, self.namespace)
             if lease.spec.get("holderIdentity") == self.identity:
-                lease.spec = dict(lease.spec)
+                lease = lease.thaw()
                 lease.spec["holderIdentity"] = ""
                 self.api.update(lease)
         except Exception:

@@ -96,7 +96,7 @@ class NodeHealthController:
             if since is None:
                 # First observation of NotReady: stamp it so the grace
                 # period is measured from detection, then re-check.
-                fresh = api.get("Node", name, ns)
+                fresh = api.get("Node", name, ns).thaw()
                 fresh.status["notReadySince"] = now
                 api.update_status(fresh)
                 return Result(requeue_after=self.grace_seconds)
@@ -122,7 +122,7 @@ class NodeHealthController:
                 continue
             fresh = api.get(
                 "Pod", pod.metadata.name, pod.metadata.namespace
-            )
+            ).thaw()
             fresh.status["phase"] = "Failed"
             fresh.status["reason"] = REASON_NODE_LOST
             fresh.status["message"] = (

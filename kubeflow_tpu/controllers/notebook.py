@@ -340,6 +340,7 @@ class NotebookController:
             ("Terminated" if stopped else "Running")
         )
         if new_status != nb.status:
+            nb = nb.thaw()
             nb.status = new_status
             api.update_status(nb)
 
@@ -361,7 +362,7 @@ class NotebookController:
             return
         if self.clock() - last < self.culler.idle_seconds:
             return
-        fresh = api.get(KIND, nb.metadata.name, nb.metadata.namespace)
+        fresh = api.get(KIND, nb.metadata.name, nb.metadata.namespace).thaw()
         if STOP_ANNOTATION in fresh.metadata.annotations:
             return
         fresh.metadata.annotations[STOP_ANNOTATION] = str(self.clock())

@@ -80,6 +80,7 @@ class ProfileController:
             return self._finalize(api, profile)
 
         if FINALIZER not in profile.metadata.finalizers:
+            profile = profile.thaw()
             profile.metadata.finalizers.append(FINALIZER)
             profile = api.update(profile)
 
@@ -193,6 +194,7 @@ class ProfileController:
             if plugin is not None:
                 plugin.revoke(api, profile)
         if FINALIZER in profile.metadata.finalizers:
+            profile = profile.thaw()
             profile.metadata.finalizers.remove(FINALIZER)
             api.update(profile)  # storage finalizes; namespace cascades
         return Result()
@@ -204,6 +206,7 @@ class ProfileController:
             KIND, profile.metadata.name, profile.metadata.namespace
         )
         if fresh.status.get("condition") != cond:
+            fresh = fresh.thaw()
             fresh.status["condition"] = cond
             api.update_status(fresh)
         return Result()

@@ -330,6 +330,7 @@ def publish_used(api: FakeApiServer, namespace: str) -> None:
         return
     if rq.status.get("used") == used and "hard" in rq.status:
         return
+    rq = rq.thaw()
     rq.status["hard"] = dict(rq.spec.get("hard", {}))
     rq.status["used"] = used
     try:

@@ -12,6 +12,15 @@ The queue itself is the native rate-limited workqueue
 (`native/src/workqueue.cc`, the compiled tier this platform keeps in C++
 where the reference kept it in Go); a pure-Python fallback with identical
 semantics covers environments without the native toolchain.
+
+Handler/reconciler contract under the copy-on-write store
+(docs/perf.md): objects delivered by watches and returned by
+get/list/create/update are SHARED FROZEN SNAPSHOTS — read freely, but
+take a private copy with `.thaw()` before mutating (the canonical
+read-modify-write is `fresh = api.get(...).thaw()`). Mutating a frozen
+snapshot raises FrozenResourceError rather than corrupting the store's
+other consumers. HttpApiClient results arrive mutable (private parses),
+and `.thaw()` is a no-op there — the idiom is client-agnostic.
 """
 
 from __future__ import annotations

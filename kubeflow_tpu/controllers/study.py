@@ -363,7 +363,7 @@ class StudyController:
     ) -> Result:
         fresh = api.get(
             study_api.KIND, study.metadata.name, study.metadata.namespace
-        )
+        ).thaw()
         new_status = dict(fresh.status)
         if trials is not None:
             new_status["trials"] = trials

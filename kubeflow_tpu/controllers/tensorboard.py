@@ -163,6 +163,7 @@ class TensorboardController:
         new_status = dict(tb.status)
         new_status["readyReplicas"] = dep.status.get("readyReplicas", 0)
         if new_status != tb.status:
+            tb = tb.thaw()
             tb.status = new_status
             api.update_status(tb)
         return Result()

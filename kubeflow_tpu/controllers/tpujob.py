@@ -459,7 +459,7 @@ class TpuJobController:
 
             for _ in range(3):
                 try:
-                    fresh = api.get(KIND, victim.metadata.name, vns)
+                    fresh = api.get(KIND, victim.metadata.name, vns).thaw()
                 except NotFound:
                     break
                 fresh.status["phase"] = "Pending"
@@ -525,7 +525,7 @@ class TpuJobController:
                 # Deadline-based — the status write below retriggers an
                 # event-driven reconcile immediately, which must keep
                 # holding until the clock actually passes.
-                fresh = api.get(KIND, name, ns)
+                fresh = api.get(KIND, name, ns).thaw()
                 fresh.status["reason"] = "PreemptedBackoff"
                 fresh.status["preemptedUntil"] = time.time() + 3.0
                 api.update_status(fresh)
@@ -570,7 +570,7 @@ class TpuJobController:
                         api.record_event(
                             job, "Unschedulable", str(e), type_="Warning"
                         )
-                        fresh = api.get(KIND, name, ns)
+                        fresh = api.get(KIND, name, ns).thaw()
                         fresh.status["reason"] = "Unschedulable"
                         api.update_status(fresh)
                     self._set_phase(api, job, "Pending")
@@ -584,7 +584,7 @@ class TpuJobController:
                     "Unschedulable", "Preempted", "PreemptedBackoff",
                     "QuotaExceeded",
                 ):
-                    fresh = api.get(KIND, name, ns)
+                    fresh = api.get(KIND, name, ns).thaw()
                     fresh.status.pop("reason", None)
                     fresh.status.pop("preemptedUntil", None)
                     api.update_status(fresh)
@@ -612,7 +612,7 @@ class TpuJobController:
                     api.record_event(
                         job, "QuotaExceeded", str(e), type_="Warning"
                     )
-                fresh = api.get(KIND, name, ns)
+                fresh = api.get(KIND, name, ns).thaw()
                 fresh.status["reason"] = "QuotaExceeded"
                 fresh.status["quotaRetryAt"] = (
                     time.time() + self._quota_retry_seconds
@@ -629,7 +629,7 @@ class TpuJobController:
             ):
                 # Episode over (covers the no-scheduler path, where the
                 # placement-success clear above never runs).
-                fresh = api.get(KIND, name, ns)
+                fresh = api.get(KIND, name, ns).thaw()
                 fresh.status.pop("reason", None)
                 fresh.status.pop("preemptedUntil", None)
                 fresh.status.pop("quotaRetryAt", None)
@@ -703,7 +703,9 @@ class TpuJobController:
         restarts: int | None = None,
     ) -> Result:
         def write() -> None:
-            fresh = api.get(KIND, job.metadata.name, job.metadata.namespace)
+            fresh = api.get(
+                KIND, job.metadata.name, job.metadata.namespace
+            ).thaw()
             new_status = dict(fresh.status)
             if counts is not None:
                 new_status["replicaStatuses"] = counts

@@ -44,7 +44,7 @@ def report_step_output(api, pod_name: str, namespace: str, output) -> None:
     downstream `${steps.<name>.output}` rendering — the Argo
     output-parameter contract, apiserver-reported like a trial's
     observation."""
-    pod = api.get("Pod", pod_name, namespace)
+    pod = api.get("Pod", pod_name, namespace).thaw()
     pod.status["output"] = str(output)
     api.update_status(pod)
 
@@ -409,7 +409,9 @@ class WorkflowController:
         steps: dict | None = None,
         reason: str | None = None,
     ) -> Result:
-        fresh = api.get(wf_api.KIND, wf.metadata.name, wf.metadata.namespace)
+        fresh = api.get(
+            wf_api.KIND, wf.metadata.name, wf.metadata.namespace
+        ).thaw()
         new_status = dict(fresh.status)
         if steps is not None:
             new_status["steps"] = steps

@@ -86,12 +86,12 @@ def retry_rmw(
 
     for _ in range(attempts):
         try:
-            obj = api.get(kind, name, namespace)
+            obj = api.get(kind, name, namespace).thaw()
         except NotFound:
             if factory is None:
                 raise
             try:
-                obj = api.create(factory())
+                obj = api.create(factory()).thaw()
             except AlreadyExists:
                 continue  # lost a create/create race — re-read
         mutate(obj)

@@ -63,7 +63,7 @@ def report_observation(
     # retry on Conflict — losing the observation would record a trained
     # trial as Failed.
     for attempt in range(10):
-        job = api.get("TpuJob", job_name, namespace)
+        job = api.get("TpuJob", job_name, namespace).thaw()
         observation = dict(job.status.get("observation") or {})
         observation.update({k: float(v) for k, v in metrics.items()})
         job.status["observation"] = observation
@@ -98,7 +98,7 @@ def report_metrics(
     from kubeflow_tpu.testing.fake_apiserver import Conflict
 
     for attempt in range(10):
-        job = api.get("TpuJob", job_name, namespace)
+        job = api.get("TpuJob", job_name, namespace).thaw()
         curve = [
             dict(p)
             for p in job.status.get("metrics") or []

@@ -154,6 +154,7 @@ class LocalPodRunner:
             )
         except NotFound:
             return
+        fresh = fresh.thaw()
         changed = fresh.status.get("phase") != "Running"
         fresh.status["phase"] = "Running"
         if log_path and fresh.status.get("logPath") != log_path:
@@ -170,6 +171,7 @@ class LocalPodRunner:
         except NotFound:
             return
         if fresh.status.get("phase") != phase:
+            fresh = fresh.thaw()
             fresh.status["phase"] = phase
             self.api.update_status(fresh)
 

@@ -155,7 +155,7 @@ class WorkloadMaterializer:
         )
         fresh = self.api.get(
             workload.kind, workload.metadata.name, workload.metadata.namespace
-        )
+        ).thaw()
         desired_status = {"replicas": replicas, "readyReplicas": ready}
         if {
             k: fresh.status.get(k) for k in desired_status

@@ -33,6 +33,7 @@ separate process against this facade.
 
 from __future__ import annotations
 
+import collections
 import json
 import urllib.parse
 
@@ -79,6 +80,54 @@ def _seg_ns(seg: str) -> str:
     return "" if seg == "_" else seg
 
 
+class WatchCache:
+    """Shared watch cache: each journal event is serialized to its
+    compact-JSON wire form EXACTLY ONCE, and the cached bytes fan out
+    to every consumer — streaming connections write the cached line,
+    long-poll responses are assembled from the cached fragments. This
+    is the apiserver watch-cache property (serialize once, no matter
+    how many watchers), folded onto our transport: 50 watchers of one
+    event cost one json.dumps and 50 socket writes (docs/perf.md).
+
+    Keyed by (rv, type): both stores stamp every journal event with a
+    fresh rv, so the key is unique per event; DELETED events carry
+    their own fresh rv by construction. Bounded FIFO — rv is monotonic,
+    so eviction order is age order. Thread-safe; a rare concurrent miss
+    serializes twice, which only costs the duplicate work."""
+
+    def __init__(self, size: int = 4096):
+        self._entries: collections.OrderedDict[tuple[int, str], bytes] = (
+            collections.OrderedDict()
+        )
+        self._size = size
+        self._lock = threading.Lock()
+        self.serializations = 0  # misses: actual json.dumps calls
+        self.hits = 0
+
+    def event_bytes(self, rv: int, etype: str, obj: Resource) -> bytes:
+        """Wire form of one watch event, without trailing newline:
+        {"type":...,"rv":...,"object":{...}}. The object payload comes
+        from the snapshot's own cached wire bytes (`Resource.
+        wire_bytes`), so even the one serialization per event is shared
+        with get/list responses of the same snapshot."""
+        key = (rv, etype)
+        with self._lock:
+            cached = self._entries.get(key)
+            if cached is not None:
+                self.hits += 1
+                return cached
+        data = (
+            b'{"type":"' + etype.encode() + b'","rv":' + str(rv).encode()
+            + b',"object":' + obj.wire_bytes() + b"}"
+        )
+        with self._lock:
+            self.serializations += 1
+            self._entries[key] = data
+            while len(self._entries) > self._size:
+                self._entries.popitem(last=False)
+        return data
+
+
 class ApiServerApp(App):
     """REST facade.
 
@@ -106,6 +155,9 @@ class ApiServerApp(App):
         super().__init__("apiserver")
         self.api = api
         self.tokens = tokens
+        # Shared watch cache: one serialization per journal event across
+        # ALL watch connections, streaming and long-poll alike.
+        self.watch_cache = WatchCache()
         if tokens is not None:
             self.before_request(self._authenticate)
         # Containment root for /log: only files under the runner's
@@ -249,12 +301,13 @@ class ApiServerApp(App):
             label_selector=selector,
         )
         items = [self._at_version(r, req) for r in items]
-        return json_response(
-            {
-                "items": [r.to_dict() for r in items],
-                "resourceVersion": rv,
-            }
+        # Assembled from each snapshot's cached wire bytes: a list of N
+        # objects costs a byte join, not N serializations per request.
+        body = (
+            b'{"items":[' + b",".join(r.wire_bytes() for r in items)
+            + b'],"resourceVersion":' + str(rv).encode() + b"}"
         )
+        return Response(body)
 
     def _watch(self, req: Request) -> Response:
         """Watch transport, two forms.
@@ -301,15 +354,17 @@ class ApiServerApp(App):
         except Gone as e:
             raise HttpError(410, str(e))
         events = self._filter_watchable(req, kind, events)
-        return json_response(
-            {
-                "events": [
-                    {"type": ev, "rv": ev_rv, "object": obj.to_dict()}
-                    for ev_rv, ev, obj in events
-                ],
-                "resourceVersion": rv,
-            }
+        # Assemble the envelope from the cached per-event wire bytes —
+        # N long-pollers of one event share a single serialization.
+        frags = [
+            self.watch_cache.event_bytes(ev_rv, ev, obj)
+            for ev_rv, ev, obj in events
+        ]
+        body = (
+            b'{"events":[' + b",".join(frags)
+            + b'],"resourceVersion":' + str(rv).encode() + b"}"
         )
+        return Response(body)
 
     def _filter_watchable(self, req: Request, kind: str, events):
         """Per-event SAR filter for the multiplexed `_` stream."""
@@ -335,7 +390,7 @@ class ApiServerApp(App):
     def _watch_stream(
         self, req: Request, since: int, kind: str, namespace: str | None
     ) -> StreamResponse:
-        import json as _json
+        from kubeflow_tpu.web.wsgi import encode_json
 
         duration = min(
             float(req.query.get("timeoutSeconds", self.STREAM_DURATION)),
@@ -343,7 +398,7 @@ class ApiServerApp(App):
         )
 
         def line(payload: dict) -> bytes:
-            return _json.dumps(payload, separators=(",", ":")).encode() + b"\n"
+            return encode_json(payload) + b"\n"
 
         def gen():
             # Exceptions here happen AFTER App.handle returned (the
@@ -378,14 +433,20 @@ class ApiServerApp(App):
                         {"type": "ERROR", "status": 503, "message": str(e)}
                     )
                     return
+                # One chunk per wakeup, not per event: the whole batch
+                # (cached wire bytes per event — serialized once across
+                # every streaming/long-poll connection) plus its
+                # bookmark rides a single framed write, so a burst of
+                # W events costs one syscall instead of W+1.
+                out = bytearray()
                 for ev_rv, ev, obj in self._filter_watchable(
                     req, kind, events
                 ):
-                    yield line(
-                        {"type": ev, "rv": ev_rv, "object": obj.to_dict()}
-                    )
+                    out += self.watch_cache.event_bytes(ev_rv, ev, obj)
+                    out += b"\n"
                 rv = new_rv
-                yield line({"type": "BOOKMARK", "resourceVersion": rv})
+                out += line({"type": "BOOKMARK", "resourceVersion": rv})
+                yield bytes(out)
 
         return StreamResponse(gen(), content_type="application/json")
 
@@ -409,7 +470,7 @@ class ApiServerApp(App):
             req.path_params["name"],
             _seg_ns(req.path_params["ns"]),
         )
-        return json_response(self._at_version(obj, req).to_dict())
+        return Response(self._at_version(obj, req).wire_bytes())
 
     def create(self, req: Request) -> Response:
         obj = Resource.from_dict(req.json())

@@ -20,6 +20,15 @@ Thread-safe. Watch delivery is ASYNCHRONOUS on a dedicated dispatcher
 thread, off the store lock — a slow handler delays delivery, never
 writers; `flush()` is the barrier deterministic tests drain on (the
 controller runtime's run_until_idle calls it automatically).
+
+Storage is copy-on-write (docs/perf.md): each commit deep-copies the
+incoming object ONCE, freezes it, and shares that immutable snapshot
+with the object map, the per-(kind, namespace) index, the journal, the
+dispatch queue, every watch handler, and get/list/create/update return
+values — fan-out costs zero copies per watcher. Consumers treat
+results as read-only; `.thaw()` yields a private mutable copy, and
+mutating a frozen snapshot raises FrozenResourceError instead of
+corrupting other consumers.
 """
 
 from __future__ import annotations
@@ -81,6 +90,72 @@ class Unavailable(ApiError):
 
 def _matches(labels: dict[str, str], selector: dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
+
+
+class KindIndex:
+    """Per-(kind, namespace) index over frozen Resource snapshots —
+    kind -> namespace -> name -> Resource — shared by BOTH store
+    backends (FakeApiServer's object index and NativeApiServer's
+    snapshot mirror), so list ordering, selector filtering, and
+    empty-bucket pruning can never drift between them (the
+    select_journal_events unification, applied to reads). NOT
+    synchronized: callers hold their store's lock."""
+
+    def __init__(self):
+        self._by_kind: dict[str, dict[str, dict[str, Resource]]] = {}
+
+    def put(self, obj: Resource) -> None:
+        self._by_kind.setdefault(obj.kind, {}).setdefault(
+            obj.metadata.namespace, {}
+        )[obj.metadata.name] = obj
+
+    def pop(self, kind: str, namespace: str, name: str) -> None:
+        by_ns = self._by_kind.get(kind)
+        if by_ns is None:
+            return
+        names = by_ns.get(namespace)
+        if names is not None:
+            names.pop(name, None)
+            if not names:
+                del by_ns[namespace]
+        if not by_ns:
+            del self._by_kind[kind]
+
+    def get(
+        self, kind: str, namespace: str, name: str
+    ) -> Resource | None:
+        return (
+            self._by_kind.get(kind, {}).get(namespace, {}).get(name)
+        )
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[Resource]:
+        """Frozen shared snapshots, (namespace, name)-ordered:
+        O(result), not O(store)."""
+        by_ns = self._by_kind.get(kind, {})
+        if namespace is not None:
+            spaces = [namespace] if namespace in by_ns else []
+        else:
+            spaces = sorted(by_ns)
+        out = []
+        for ns in spaces:
+            names = by_ns[ns]
+            for name in sorted(names):
+                obj = names[name]
+                if label_selector and not _matches(
+                    obj.metadata.labels, label_selector
+                ):
+                    continue
+                out.append(obj)
+        return out
+
+    def kinds(self) -> list[str]:
+        """Kinds with live objects (empty buckets are pruned on pop)."""
+        return sorted(self._by_kind)
 
 
 def event_name(
@@ -149,7 +224,15 @@ def select_journal_events(
     filtered by kind/namespace, plus the rv to resume from; Gone when
     the bookmark predates the floor or the journal's trimmed horizon.
     One implementation so the 410 math can never drift between the
-    Python and native apiservers."""
+    Python and native apiservers.
+
+    The journal is rv-ordered (every commit appends with a strictly
+    increasing rv), so the resume point is a binary search, not a scan;
+    and entries are frozen shared snapshots (docs/perf.md), so serving
+    a bookmark costs zero copies."""
+    import bisect
+    from operator import itemgetter
+
     if resource_version < floor:
         raise Gone(
             f"resourceVersion {resource_version} predates this "
@@ -160,11 +243,13 @@ def select_journal_events(
             f"resourceVersion {resource_version} is too old "
             f"(journal begins at {journal[0][0]})"
         )
+    start = bisect.bisect_right(
+        journal, resource_version, key=itemgetter(0)
+    )
     out = [
-        (rv, event, obj.deepcopy())
-        for rv, event, obj in journal
-        if rv > resource_version
-        and (kind is None or obj.kind == kind)
+        (rv, event, obj)
+        for rv, event, obj in journal[start:]
+        if (kind is None or obj.kind == kind)
         and (namespace is None or obj.metadata.namespace == namespace)
     ]
     return out, current_rv
@@ -239,6 +324,10 @@ class FakeApiServer:
         wal_backend: str = "auto",
     ):
         self._objects: dict[tuple[str, str, str], Resource] = {}
+        # Per-(kind, namespace) index over the same frozen snapshots,
+        # so list()/filtering touch only the kind+namespace asked for
+        # instead of scanning the whole store (docs/perf.md).
+        self._index = KindIndex()
         self._rv = 0
         # Events at or below the floor are unknowable (pre-restart, or
         # trimmed): watch bookmarks under it get Gone → relist.
@@ -285,6 +374,27 @@ class FakeApiServer:
             self._wal = persist.open_wal(persist_dir, backend=wal_backend)
             self._restore()
 
+    # -- storage (copy-on-write commit point) -----------------------------
+
+    def _store_obj(self, stored: Resource) -> Resource:
+        """THE commit point: freeze the (already private) copy and
+        install it in the object map + per-(kind, namespace) index.
+        Everything downstream — journal, dispatch, watchers, get/list —
+        shares this frozen snapshot; nothing copies it again."""
+        stored.freeze()
+        key = stored.key
+        self._objects[key] = stored
+        self._index.put(stored)
+        if stored.kind == self.WEBHOOK_KIND:
+            self._webhook_keys.add(key)
+        return stored
+
+    def _unstore(self, key: tuple[str, str, str]) -> Resource:
+        obj = self._objects.pop(key)
+        self._index.pop(*key)
+        self._webhook_keys.discard(key)
+        return obj
+
     # -- persistence ------------------------------------------------------
 
     def _restore(self) -> None:
@@ -309,10 +419,7 @@ class FakeApiServer:
                     f"{FORMAT} — refusing to guess at a migration"
                 )
             for d in snap.get("objects", []):
-                obj = Resource.from_dict(d)
-                self._objects[obj.key] = obj
-                if obj.kind == self.WEBHOOK_KIND:
-                    self._webhook_keys.add(obj.key)
+                self._store_obj(Resource.from_dict(d))
             self._rv = int(snap.get("rv", 0))
         torn = False
         for line in self._wal.read_journal().splitlines():
@@ -328,12 +435,10 @@ class FakeApiServer:
             if rv <= self._rv:
                 continue  # pre-snapshot leftover
             if event == "DELETED":
-                self._objects.pop(obj.key, None)
-                self._webhook_keys.discard(obj.key)
+                if obj.key in self._objects:
+                    self._unstore(obj.key)
             else:
-                self._objects[obj.key] = obj
-                if obj.kind == self.WEBHOOK_KIND:
-                    self._webhook_keys.add(obj.key)
+                self._store_obj(obj)
             self._rv = rv
         if torn:
             # REPAIR the log now: the WAL reopens in append mode, so the
@@ -627,8 +732,9 @@ class FakeApiServer:
             return _matches(obj.metadata.labels, selector)  # objectSelector
 
         with self._lock:
+            # Frozen snapshots — the callout only reads cfg.spec.
             configs = [
-                self._objects[k].deepcopy()
+                self._objects[k]
                 for k in sorted(self._webhook_keys)
                 if k in self._objects
                 and _matches_cfg(self._objects[k].spec)
@@ -658,7 +764,8 @@ class FakeApiServer:
     def watch(self, handler: WatchHandler, kind: str | None = None) -> None:
         """Subscribe to events; kind=None receives everything. The first
         subscription starts the dispatcher thread (stores nobody watches
-        never pay for one)."""
+        never pay for one). Handlers receive the SHARED frozen snapshot
+        (read-only; `.thaw()` for a private mutable copy)."""
         with self._lock:
             self._watchers.append((kind, handler))
         with self._dispatch_cv:
@@ -676,6 +783,10 @@ class FakeApiServer:
         # while another thread fail-stopped must not see its event
         # journaled/delivered with persistence silently gone.
         self._check_available()
+        # The copy-on-write contract: callers emit the frozen committed
+        # snapshot, which the journal, the dispatch queue, and every
+        # handler then SHARE — zero copies from here on (docs/perf.md).
+        assert obj.frozen, "emit requires the frozen committed snapshot"
         # Durability first: the WAL append (fsync'd) happens before any
         # watcher can observe the event, so an acked write survives a
         # crash that follows it.
@@ -685,8 +796,17 @@ class FakeApiServer:
         # resourceVersion order — a watcher resuming from rv N can never
         # miss an event that commits with rv > N after N was served.
         with self._journal_cv:
+            # rv-sortedness is load-bearing: the bisect resume in
+            # select_journal_events is undefined on unsorted data. Any
+            # emit site that would append out of order (the old
+            # finalize-then-cascade shape) must fail HERE, not as a
+            # silently dropped resume event at some watcher later.
+            assert (
+                not self._journal
+                or obj.metadata.resource_version > self._journal[-1][0]
+            ), "journal emit out of rv order"
             self._journal.append(
-                (obj.metadata.resource_version, event, obj.deepcopy())
+                (obj.metadata.resource_version, event, obj)
             )
             if len(self._journal) > self._journal_size:
                 del self._journal[: -self._journal_size]
@@ -694,7 +814,7 @@ class FakeApiServer:
         if not self._watchers:
             return  # nobody to deliver to (late watchers get no replay)
         with self._dispatch_cv:
-            self._dispatch_q.append((event, obj.deepcopy()))
+            self._dispatch_q.append((event, obj))
             self._dispatch_enqueued += 1
             self._dispatch_cv.notify_all()
 
@@ -706,10 +826,15 @@ class FakeApiServer:
                 event, obj = self._dispatch_q.pop(0)
             with self._lock:
                 watchers = list(self._watchers)
+            # Every handler gets THE SAME frozen snapshot: a handler
+            # that mutates raises FrozenResourceError (and .thaw() is
+            # its private-copy escape hatch) instead of corrupting its
+            # peers — the old per-handler defensive copy's isolation,
+            # now at zero copies per delivery.
             for kind, handler in watchers:
                 if kind is None or kind == obj.kind:
                     try:
-                        handler(event, obj.deepcopy())
+                        handler(event, obj)
                     except Exception:
                         log.exception(
                             "watch handler failed for %s %s", event, obj.key
@@ -805,18 +930,18 @@ class FakeApiServer:
             key = obj.key
             if key in self._objects:
                 raise AlreadyExists(f"{key} already exists")
+            # THE one copy per commit (docs/perf.md): everything from
+            # here — store, index, journal, dispatch, return value —
+            # shares the frozen `stored` snapshot.
             stored = obj.deepcopy()
             self._rv += 1
             stored.metadata.uid = fresh_uid()
             stored.metadata.resource_version = self._rv
             stored.metadata.generation = 1
             stored.metadata.creation_timestamp = now()
-            self._objects[key] = stored
-            if stored.kind == self.WEBHOOK_KIND:
-                self._webhook_keys.add(key)
-            out = stored.deepcopy()
+            self._store_obj(stored)
             self._emit("ADDED", stored)
-        return out
+        return stored
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
         with self._lock:
@@ -824,7 +949,7 @@ class FakeApiServer:
             obj = self._objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            return obj.deepcopy()
+            return obj  # frozen shared snapshot; .thaw() to mutate
 
     def kinds(self) -> list[str]:
         """Distinct kinds with live objects (quota's count/<resource>
@@ -840,20 +965,12 @@ class FakeApiServer:
         namespace: str | None = None,
         label_selector: dict[str, str] | None = None,
     ) -> list[Resource]:
+        """Frozen shared snapshots, (namespace, name)-ordered. Served
+        from the shared per-(kind, namespace) index: O(result), not
+        O(store)."""
         with self._lock:
             self._check_available()
-            out = []
-            for (k, ns, _), obj in sorted(self._objects.items()):
-                if k != kind:
-                    continue
-                if namespace is not None and ns != namespace:
-                    continue
-                if label_selector and not _matches(
-                    obj.metadata.labels, label_selector
-                ):
-                    continue
-                out.append(obj.deepcopy())
-            return out
+            return self._index.list(kind, namespace, label_selector)
 
     def _update(
         self, obj: Resource, *, status_only: bool, lease_guard=None
@@ -875,6 +992,9 @@ class FakeApiServer:
                     f"{obj.metadata.resource_version} != "
                     f"{current.metadata.resource_version}"
                 )
+            # THE one copy per commit: `current` stays the previous
+            # frozen snapshot (journal entries and readers may still
+            # hold it); `stored` becomes the new one.
             stored = current.deepcopy()
             if status_only:
                 stored.status = Resource.from_dict(obj.to_dict()).status
@@ -891,14 +1011,10 @@ class FakeApiServer:
                 )
             self._rv += 1
             stored.metadata.resource_version = self._rv
-            self._objects[key] = stored
-            deleted = self._maybe_finalize(stored)
-            out = stored.deepcopy()
-            if deleted:
-                self._emit("DELETED", stored)
-            else:
+            self._store_obj(stored)
+            if not self._maybe_finalize(stored):
                 self._emit("MODIFIED", stored)
-        return out
+        return stored
 
     def update(self, obj: Resource, *, lease_guard=None) -> Resource:
         # Fast-fail precheck (authoritative re-check is in _emit, under
@@ -933,33 +1049,45 @@ class FakeApiServer:
                 raise NotFound(f"{key} not found")
             if obj.metadata.finalizers:
                 if obj.metadata.deletion_timestamp is None:
-                    obj.metadata.deletion_timestamp = now()
+                    # Marking deletion is a commit of its own: copy once
+                    # (prior snapshot stays shared with the journal).
+                    stored = obj.thaw()
+                    stored.metadata.deletion_timestamp = now()
                     self._rv += 1
-                    obj.metadata.resource_version = self._rv
-                    self._emit("MODIFIED", obj)
+                    stored.metadata.resource_version = self._rv
+                    self._store_obj(stored)
+                    self._emit("MODIFIED", stored)
                 return
             self._remove(key)
 
     def _maybe_finalize(self, stored: Resource) -> bool:
         """Remove an object whose deletion was pending and whose last
-        finalizer was just cleared. Returns True if removed."""
+        finalizer was just cleared (emitting its DELETED). Returns True
+        if removed. The DELETED is journaled BEFORE the cascade runs:
+        cascaded children get fresh (higher) rvs, so emitting the parent
+        first is what keeps the journal rv-sorted — the invariant the
+        bisect resume in select_journal_events depends on."""
         if (
             stored.metadata.deletion_timestamp is not None
             and not stored.metadata.finalizers
         ):
+            self._emit("DELETED", stored)
             self._remove(stored.key, emit_delete=False)
             return True
         return False
 
     def _remove(self, key: tuple, *, emit_delete: bool = True) -> None:
-        obj = self._objects.pop(key)
-        self._webhook_keys.discard(key)
+        obj = self._unstore(key)
         if emit_delete:
             # Deletion is a state transition of its own: give the DELETED
             # event a fresh rv so a watcher resuming from the object's
-            # last-seen version still observes the removal.
+            # last-seen version still observes the removal. The stamp
+            # goes on a private copy — the popped snapshot is still
+            # shared with the journal/readers at its old rv.
+            obj = obj.thaw()
             self._rv += 1
             obj.metadata.resource_version = self._rv
+            obj.freeze()
             self._emit("DELETED", obj)
         self._cascade(obj)
         if obj.kind == "Namespace":

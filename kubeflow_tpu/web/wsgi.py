@@ -129,8 +129,16 @@ class StreamResponse(Response):
         self.chunks = chunks
 
 
+def encode_json(payload: Any) -> bytes:
+    """THE JSON wire encoder: compact separators, utf-8. Every response
+    body (and the apiserver facade's cached watch-event lines) goes
+    through here so the wire form is uniformly slim — the fat default
+    separators cost ~2 bytes per key on every object of every list."""
+    return json.dumps(payload, separators=(",", ":")).encode()
+
+
 def json_response(payload: Any, status: int = 200) -> Response:
-    return Response(json.dumps(payload).encode(), status=status)
+    return Response(encode_json(payload), status=status)
 
 
 def success_response(field: str | None = None, value: Any = None) -> Response:
@@ -451,6 +459,12 @@ class _HttpServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
     keep-alive means a thread serves its peer's whole request train)."""
 
     daemon_threads = True
+    # Listen backlog. The stdlib default (5) makes any burst of
+    # connections — a controller fleet reconnecting after an apiserver
+    # restart, a watcher fleet attaching — overflow the accept queue,
+    # and the dropped SYNs come back as ~1s retransmit stalls per
+    # client. Real servers listen deep (nginx defaults to 511).
+    request_queue_size = 128
 
     def __init__(self, addr, handler, app: App):
         self.app = app

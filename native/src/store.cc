@@ -295,12 +295,15 @@ class Store {
     Json& stored = objects_.at(key);
     if (DeletionPending(stored) && !HasFinalizers(stored)) {
       last_removed_ = stored;
-      Remove(key, /*emit_delete=*/false);
       // The caller's update cleared the last finalizer of a
       // deletion-pending object: that update IS the deletion. The
       // finalizing update already bumped rv onto last_removed_, so the
-      // DELETED event is journal-ordered without another bump.
+      // DELETED event is journal-ordered without another bump — but it
+      // must be appended BEFORE Remove() runs the owner-ref cascade:
+      // cascaded children get fresh (higher) rvs, and the journal must
+      // stay rv-sorted (the Python wrapper's resume bisects on rv).
       Append("DELETED", last_removed_);
+      Remove(key, /*emit_delete=*/false);
       return true;
     }
     return false;

@@ -89,11 +89,18 @@ class _SideEffectLedger:
         self.adds = Counter()
         self.dels = Counter()
         self.violations: list[tuple] = []
+        # Copy-on-write deflake guard (docs/perf.md): in-process watch
+        # delivers the SHARED frozen snapshot. A mutable delivery here
+        # would mean a fault landing mid-fan-out could expose a
+        # half-written object to some other consumer.
+        self.mutable_deliveries: list[tuple] = []
         self._lock = threading.Lock()
 
     def __call__(self, event: str, obj) -> None:
         key = (obj.kind, obj.metadata.namespace, obj.metadata.name)
         with self._lock:
+            if not getattr(obj, "frozen", False):
+                self.mutable_deliveries.append((event, key))
             if event == "ADDED":
                 self.adds[key] += 1
                 if self.adds[key] - self.dels[key] > 1:
@@ -212,7 +219,7 @@ def _run_soak(
             i += 1
             ns, name = nb_names[i % len(nb_names)]
             try:
-                nb = api.get("Notebook", name, ns)
+                nb = api.get("Notebook", name, ns).thaw()
                 nb.spec["image"] = f"jax-nb:v{i}"
                 api.update(nb)
             except (Conflict, Invalid):
@@ -284,6 +291,10 @@ def _run_soak(
         flush()
     assert ledger.violations == [], (
         f"an object identity was live twice: {ledger.violations} {repro}"
+    )
+    assert ledger.mutable_deliveries == [], (
+        f"watch delivered non-frozen objects (copy-on-write contract "
+        f"broken): {ledger.mutable_deliveries[:5]} {repro}"
     )
     # Exactly one child set per notebook, exactly one worker set per
     # gang — no strays left behind by retried/replayed writes.

@@ -165,7 +165,7 @@ def test_watcher_survives_journal_compaction_under_load():
             for i in range(30):
                 name = f"c{i}"
                 try:
-                    obj = api.get("CompactObj", name, "load")
+                    obj = api.get("CompactObj", name, "load").thaw()
                     obj.spec["v"] = v
                     api.update(obj)
                 except Exception:

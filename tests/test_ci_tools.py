@@ -120,6 +120,40 @@ def test_repo_passes_its_own_boilerplate_policy():
     assert result.returncode == 0, result.stdout + result.stderr
 
 
+def test_no_deepcopy_in_dispatch_or_fanout_paths():
+    """Lint-style perf gate (docs/perf.md): the copy-on-write rewrite
+    removed every defensive deepcopy from the event fan-out and read
+    hot paths of BOTH store backends. One creeping back in silently
+    restores O(watchers x events) copying — fail loudly instead."""
+    import inspect
+
+    from kubeflow_tpu.native import apiserver as native_apiserver
+    from kubeflow_tpu.testing import fake_apiserver
+
+    hot_paths = {
+        "FakeApiServer._emit": fake_apiserver.FakeApiServer._emit,
+        "FakeApiServer._dispatch_loop":
+            fake_apiserver.FakeApiServer._dispatch_loop,
+        "FakeApiServer.get": fake_apiserver.FakeApiServer.get,
+        "FakeApiServer.list": fake_apiserver.FakeApiServer.list,
+        "select_journal_events": fake_apiserver.select_journal_events,
+        "NativeApiServer._drain_events":
+            native_apiserver.NativeApiServer._drain_events,
+        "NativeApiServer.get": native_apiserver.NativeApiServer.get,
+        "NativeApiServer.list": native_apiserver.NativeApiServer.list,
+    }
+    offenders = {
+        name: fn
+        for name, fn in hot_paths.items()
+        if "deepcopy" in inspect.getsource(fn)
+    }
+    assert not offenders, (
+        f"deepcopy reappeared in fan-out/read hot paths: "
+        f"{sorted(offenders)} — these must share frozen snapshots "
+        "(see docs/perf.md)"
+    )
+
+
 def test_gcb_template():
     result = subprocess.run(
         [sys.executable, "tools/gcb/template.py", "--commit", "abc123"],

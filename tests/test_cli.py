@@ -244,7 +244,7 @@ def test_get_watch_single_object_filters(server):
         assert proc.poll() is None, "watch died during a quiet interval"
         api.create(new_resource("TpuJob", "noise2", "default",
                                 spec={"replicas": 1}))
-        fresh = api.get("TpuJob", "keep", "default")
+        fresh = api.get("TpuJob", "keep", "default").thaw()
         fresh.status["phase"] = "Running"
         api.update_status(fresh)
         line = proc.stdout.readline()
@@ -308,7 +308,7 @@ def test_logs_command(tmp_path):
     # Containment: a client-written logPath outside the capture root is
     # refused — status is client-writable, so this would otherwise be an
     # arbitrary-file-read primitive.
-    victim = api.get("Pod", "talk-worker-0")
+    victim = api.get("Pod", "talk-worker-0").thaw()
     victim.status["logPath"] = "/etc/hostname"
     api.update_status(victim)
     rc, _, err = run(url, "logs", "talk-worker-0")
@@ -361,7 +361,7 @@ def test_describe_golden(server):
         "TpuJob", "train", "ml",
         spec={"replicas": 2}, labels={"team": "research"},
     )
-    created = api.create(job)
+    created = api.create(job).thaw()
     created.status = {
         "phase": "Running",
         "conditions": [{"type": "Created"}, {"type": "Running"}],

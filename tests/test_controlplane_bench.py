@@ -1,0 +1,49 @@
+"""CI smoke for `bench.py --workload controlplane` (docs/perf.md): the
+bench must run end-to-end at tiny scale and emit driver-parsable JSON
+metric lines for every backend it covered."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_controlplane_bench_smoke_emits_parsable_metrics():
+    result = subprocess.run(
+        [
+            sys.executable, "bench.py", "--workload", "controlplane",
+            "--cp-watchers", "3", "--cp-writers", "2", "--cp-events", "4",
+            "--cp-objects", "40", "--cp-list-reps", "3",
+            "--cp-payload", "64",
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=280,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    metrics = [
+        json.loads(line)
+        for line in result.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert metrics, f"no metric lines in:\n{result.stdout}"
+    for m in metrics:
+        # The driver's parse contract — same shape as every other bench.
+        assert set(m) == {"metric", "value", "unit", "vs_baseline"}, m
+        assert isinstance(m["value"], (int, float)) and m["value"] > 0, m
+    names = {m["metric"] for m in metrics}
+    for stem in (
+        "controlplane_fanout_deliveries_per_sec",
+        "controlplane_list_p99_ms",
+        "controlplane_delivery_p99_ms",
+    ):
+        assert f"{stem}_python" in names, (stem, names)
+    # Native coverage is environment-dependent: when the toolchain is
+    # absent the bench must SAY so rather than silently halving scope.
+    if f"controlplane_fanout_deliveries_per_sec_native" not in names:
+        assert "native backend unavailable" in result.stderr

@@ -1,0 +1,215 @@
+"""Copy-on-write store contract (docs/perf.md): one copy per commit,
+zero copies per fan-out.
+
+The regression these tests pin down: event dispatch used to deepcopy
+per watcher per event (O(watchers x events x object size)); now every
+consumer — journal, dispatch, watch handlers, get, list — shares one
+frozen snapshot per commit, and copies-per-event stays O(1) as watcher
+count grows. Mutating a frozen snapshot is a loud FrozenResourceError,
+never silent corruption; `.thaw()` is the private-mutable-copy idiom.
+"""
+
+import pytest
+
+from kubeflow_tpu.api.objects import (
+    FrozenResourceError,
+    Resource,
+    new_resource,
+)
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+
+
+def _make_api(name: str):
+    if name == "native":
+        try:
+            from kubeflow_tpu.native.apiserver import NativeApiServer
+
+            return NativeApiServer()
+        except Exception as e:  # toolchain/build unavailable
+            pytest.skip(f"native store unavailable: {e}")
+    return FakeApiServer()
+
+
+@pytest.fixture(params=["python", "native"])
+def backend(request):
+    return request.param
+
+
+@pytest.fixture
+def api(backend):
+    return _make_api(backend)
+
+
+def _flush(api) -> None:
+    flush = getattr(api, "flush", None)
+    if flush is not None:
+        flush()
+
+
+# -- copy counting ----------------------------------------------------------
+
+
+def _count_copies(api, n_watchers: int, monkeypatch, events: int = 6) -> int:
+    """Total Resource materializations (deepcopy + from_dict) across
+    `events` create+update pairs with `n_watchers` subscribed."""
+    for _ in range(n_watchers):
+        api.watch(lambda event, obj: None)
+
+    counts = {"n": 0}
+    orig_deepcopy = Resource.deepcopy
+    orig_from_dict = Resource.from_dict.__func__
+
+    def counting_deepcopy(self):
+        counts["n"] += 1
+        return orig_deepcopy(self)
+
+    def counting_from_dict(cls, d):
+        counts["n"] += 1
+        return orig_from_dict(cls, d)
+
+    monkeypatch.setattr(Resource, "deepcopy", counting_deepcopy)
+    monkeypatch.setattr(
+        Resource, "from_dict", classmethod(counting_from_dict)
+    )
+    try:
+        for i in range(events):
+            obj = api.create(
+                new_resource("CopyObj", f"c-{i}", "default", spec={"v": 0})
+            )
+            fresh = obj.thaw()
+            fresh.spec["v"] = 1
+            api.update(fresh)
+        _flush(api)
+    finally:
+        monkeypatch.setattr(Resource, "deepcopy", orig_deepcopy)
+        monkeypatch.setattr(
+            Resource, "from_dict", classmethod(orig_from_dict)
+        )
+    return counts["n"]
+
+
+def test_copies_per_event_constant_in_watcher_count(backend, monkeypatch):
+    """THE tentpole property: the same workload costs the same number of
+    Resource copies whether 1 or 32 watchers are subscribed."""
+    per_count = {}
+    for n in (1, 4, 32):
+        api = _make_api(backend)
+        per_count[n] = _count_copies(api, n, monkeypatch)
+    assert per_count[1] == per_count[4] == per_count[32], (
+        f"copies grew with watcher count: {per_count} — a per-watcher "
+        "deepcopy crept back into the dispatch path"
+    )
+
+
+def test_all_watchers_share_one_frozen_snapshot(api):
+    seen: list[tuple[int, bool]] = []
+    for _ in range(4):
+        api.watch(lambda event, obj: seen.append((id(obj), obj.frozen)))
+    api.create(new_resource("ShareObj", "s-0", "default"))
+    _flush(api)
+    assert len(seen) == 4
+    assert all(frozen for _, frozen in seen), "delivered object not frozen"
+    assert len({oid for oid, _ in seen}) == 1, (
+        "watchers received distinct objects — fan-out is copying again"
+    )
+
+
+# -- frozen-snapshot contract ----------------------------------------------
+
+
+def test_get_list_and_returns_are_frozen(api):
+    created = api.create(
+        new_resource("FrozenObj", "f-0", "default", spec={"a": {"b": 1}})
+    )
+    assert created.frozen
+    got = api.get("FrozenObj", "f-0")
+    listed = api.list("FrozenObj")[0]
+    for obj in (created, got, listed):
+        assert obj.frozen
+        with pytest.raises(FrozenResourceError):
+            obj.spec["x"] = 1
+        with pytest.raises(FrozenResourceError):
+            obj.spec["a"]["b"] = 2  # nested structures frozen too
+        with pytest.raises(FrozenResourceError):
+            obj.metadata.labels["k"] = "v"
+        with pytest.raises(FrozenResourceError):
+            obj.status = {}
+        with pytest.raises(FrozenResourceError):
+            obj.metadata.finalizers.append("x")
+
+
+def test_thaw_yields_private_mutable_copy(api):
+    api.create(new_resource("ThawObj", "t-0", "default", spec={"v": 1}))
+    fresh = api.get("ThawObj", "t-0").thaw()
+    assert not fresh.frozen
+    fresh.spec["v"] = 2
+    # The store's snapshot is untouched until the write commits.
+    assert api.get("ThawObj", "t-0").spec["v"] == 1
+    updated = api.update(fresh)
+    assert updated.frozen
+    assert api.get("ThawObj", "t-0").spec["v"] == 2
+
+
+def test_thaw_on_mutable_resource_is_identity():
+    obj = new_resource("X", "x", "default")
+    assert obj.thaw() is obj
+
+
+def test_journal_events_are_frozen_snapshots(api):
+    api.create(new_resource("JournalObj", "j-0", "default"))
+    events, _rv = api.events_since(0, kind="JournalObj")
+    assert events
+    for _rv2, _etype, obj in events:
+        assert obj.frozen
+        with pytest.raises(FrozenResourceError):
+            obj.spec["poison"] = True
+    # The snapshot the journal shares IS the stored one.
+    assert api.get("JournalObj", "j-0").spec.get("poison") is None
+
+
+def test_handler_mutation_cannot_corrupt_other_watchers(api):
+    """A misbehaving handler gets a loud error and the other handlers
+    (and the store) still observe the committed state."""
+    observed: list[dict] = []
+
+    def bad_handler(event, obj):
+        obj.spec["corrupted"] = True  # raises FrozenResourceError
+
+    api.watch(bad_handler)
+    api.watch(lambda event, obj: observed.append(dict(obj.spec)))
+    api.create(
+        new_resource("GuardObj", "g-0", "default", spec={"ok": True})
+    )
+    _flush(api)
+    assert observed == [{"ok": True}]
+    assert api.get("GuardObj", "g-0").spec == {"ok": True}
+
+
+# -- shared watch cache (HTTP facade) ---------------------------------------
+
+
+def test_watch_cache_serializes_each_event_once(api):
+    """N long-poll consumers of the same events cost ONE serialization
+    per event — the shared watch cache contract."""
+    from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+    from kubeflow_tpu.web.wsgi import TestClient
+
+    app = ApiServerApp(api)
+    client = TestClient(app)
+    for i in range(3):
+        api.create(
+            new_resource("CacheObj", f"w-{i}", "default", spec={"i": i})
+        )
+    for _ in range(5):  # five watchers replaying the same history
+        resp = client.get(
+            "/apis/CacheObj?watch=true&resourceVersion=0&timeoutSeconds=0.05"
+        )
+        assert resp.status == 200
+        events = resp.json()["events"]
+        assert [e["object"]["spec"]["i"] for e in events] == [0, 1, 2]
+    assert app.watch_cache.serializations == 3, (
+        f"{app.watch_cache.serializations} serializations for 3 events "
+        f"x 5 watchers — the shared cache is not being hit "
+        f"(hits={app.watch_cache.hits})"
+    )
+    assert app.watch_cache.hits >= 12

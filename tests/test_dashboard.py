@@ -63,7 +63,7 @@ def test_metrics_series(world):
     api, _, app = world
     node = new_resource("Node", "tpu-node-0", "")
     api.create(node)
-    node = api.get("Node", "tpu-node-0", "")
+    node = api.get("Node", "tpu-node-0", "").thaw()
     node.status = {
         "cpuUtilization": 0.4,
         "memoryUtilization": 0.6,

@@ -33,8 +33,8 @@ def test_create_duplicate_rejected(api):
 
 def test_stale_update_conflicts(api):
     api.create(new_resource("Pod", "p"))
-    a = api.get("Pod", "p")
-    b = api.get("Pod", "p")
+    a = api.get("Pod", "p").thaw()
+    b = api.get("Pod", "p").thaw()
     a.spec["x"] = 1
     api.update(a)
     b.spec["x"] = 2
@@ -44,7 +44,7 @@ def test_stale_update_conflicts(api):
 
 def test_update_status_does_not_touch_spec(api):
     api.create(new_resource("Pod", "p", spec={"a": 1}))
-    obj = api.get("Pod", "p")
+    obj = api.get("Pod", "p").thaw()
     obj.spec["a"] = 99
     obj.status["phase"] = "Running"
     api.update_status(obj)
@@ -55,10 +55,11 @@ def test_update_status_does_not_touch_spec(api):
 
 def test_generation_bumps_only_on_spec_change(api):
     api.create(new_resource("Pod", "p", spec={"a": 1}))
-    obj = api.get("Pod", "p")
+    obj = api.get("Pod", "p").thaw()
     obj.metadata.labels["l"] = "v"
     updated = api.update(obj)
     assert updated.metadata.generation == 1
+    updated = updated.thaw()  # store returns are frozen shared snapshots
     updated.spec["a"] = 2
     assert api.update(updated).metadata.generation == 2
 
@@ -74,7 +75,7 @@ def test_watch_events(api):
     api.watch(lambda e, o: events.append((e, o.metadata.name)), "Pod")
     api.create(new_resource("Pod", "p"))
     api.create(new_resource("Service", "s"))  # different kind: not seen
-    obj = api.get("Pod", "p")
+    obj = api.get("Pod", "p").thaw()
     obj.spec["x"] = 1
     api.update(obj)
     api.delete("Pod", "p")
@@ -121,6 +122,7 @@ def test_finalizers_defer_deletion(api):
     api.delete("Profile", "u1")
     pending = api.get("Profile", "u1")  # still there
     assert pending.metadata.deletion_timestamp is not None
+    pending = pending.thaw()
     pending.metadata.finalizers = []
     api.update(pending)
     with pytest.raises(NotFound):
@@ -146,3 +148,28 @@ def test_apply_create_or_update(api):
     api.apply(new_resource("Service", "s", spec={"p": 1}))
     api.apply(new_resource("Service", "s", spec={"p": 2}))
     assert api.get("Service", "s").spec == {"p": 2}
+
+
+def test_finalizer_cascade_journal_stays_rv_ordered(api):
+    """Clearing the last finalizer of an owner WITH dependents emits the
+    owner's DELETED before the cascaded children's: the journal must
+    stay rv-sorted, or the bisect resume in select_journal_events would
+    skip events a watcher never saw."""
+    parent = new_resource("Profile", "p1")
+    parent.metadata.finalizers = ["cleanup"]
+    parent = api.create(parent)
+    child = new_resource("Pod", "p1-child")
+    child.metadata.owner_references = [owner_ref(parent)]
+    api.create(child)
+    api.delete("Profile", "p1")  # parks: finalizer pending
+    pending = api.get("Profile", "p1").thaw()
+    bookmark = pending.metadata.resource_version
+    pending.metadata.finalizers = []
+    api.update(pending)  # finalizes; owner-ref cascade deletes the child
+    events, _ = api.events_since(bookmark)
+    rvs = [rv for rv, _, _ in events]
+    assert rvs == sorted(rvs), f"journal out of rv order: {rvs}"
+    deleted = {
+        (o.kind, o.metadata.name) for _, e, o in events if e == "DELETED"
+    }
+    assert {("Profile", "p1"), ("Pod", "p1-child")} <= deleted, deleted

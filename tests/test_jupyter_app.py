@@ -298,7 +298,7 @@ def test_snapshot_error_paths(world):
     _spawn(client, "nb4")
     client.post("/api/namespaces/team/snapshots",
                 {"pvc": "nb4-workspace", "name": "cold"})
-    snap = api.get("VolumeSnapshot", "cold", "team")
+    snap = api.get("VolumeSnapshot", "cold", "team").thaw()
     snap.status["readyToUse"] = False
     api.update_status(snap)
     assert _spawn(

@@ -35,8 +35,7 @@ def _elector(api, identity, **kw):
 def _backdate(api, name="test-controller", by=10.0):
     """Simulate the holder going silent for `by` seconds (crash or
     partition) without waiting wall-clock time."""
-    lease = api.get(LEASE_KIND, name, "")
-    lease.spec = dict(lease.spec)
+    lease = api.get(LEASE_KIND, name, "").thaw()
     lease.spec["renewTime"] = time.time() - by
     api.update(lease)
 
@@ -169,7 +168,7 @@ def test_fenced_write_rejected_in_process():
     assert b._try_acquire_or_renew()
     with pytest.raises(Conflict, match="fenced"):
         api.create(new_resource("Widget", "w2"), lease_guard=guard)
-    w1 = api.get("Widget", "w1")
+    w1 = api.get("Widget", "w1").thaw()
     w1.spec["touched"] = True
     with pytest.raises(Conflict, match="fenced"):
         api.update(w1, lease_guard=guard)
@@ -192,8 +191,7 @@ def test_lease_writes_exempt_from_fencing():
     api = FakeApiServer()
     a = _elector(api, "a")
     assert a._try_acquire_or_renew()
-    lease = api.get(LEASE_KIND, "test-controller", "")
-    lease.spec = dict(lease.spec)
+    lease = api.get(LEASE_KIND, "test-controller", "").thaw()
     lease.spec["renewTime"] = time.time()
     # Stale guard on a Lease write: exempt, must succeed.
     api.update(lease, lease_guard=("", "test-controller", "zombie", 99))

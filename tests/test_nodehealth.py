@@ -38,7 +38,7 @@ def _add_node(api, name, ready=True):
     node = new_resource("Node", name, spec={"pool": "v5e", "chips": 8})
     node.status["ready"] = ready
     created = api.create(node)
-    fresh = api.get("Node", name)
+    fresh = api.get("Node", name).thaw()
     fresh.status["ready"] = ready
     api.update_status(fresh)
     return created
@@ -69,10 +69,10 @@ def _make_running_gang(api, jobs, replicas=2):
     assert len(pods) == replicas
     # Bind pods to nodes and mark Running (kubelet's role).
     for i, pod in enumerate(sorted(pods, key=lambda p: p.metadata.name)):
-        fresh = api.get("Pod", pod.metadata.name, "ml")
+        fresh = api.get("Pod", pod.metadata.name, "ml").thaw()
         fresh.spec["nodeName"] = f"n{i}"
         api.update(fresh)
-        fresh = api.get("Pod", pod.metadata.name, "ml")
+        fresh = api.get("Pod", pod.metadata.name, "ml").thaw()
         fresh.status["phase"] = "Running"
         api.update_status(fresh)
     jobs.controller.run_until_idle()
@@ -105,7 +105,7 @@ def test_node_deletion_fails_pods_and_restarts_gang(world):
 def test_notready_waits_out_grace_period(world):
     api, health, jobs, clock = world
     _make_running_gang(api, jobs)
-    fresh = api.get("Node", "n0")
+    fresh = api.get("Node", "n0").thaw()
     fresh.status["ready"] = False
     api.update_status(fresh)
     health.controller.run_until_idle()
@@ -115,7 +115,7 @@ def test_notready_waits_out_grace_period(world):
     )
     assert health.controller.has_pending()
     # Node recovers before the grace expires: pods untouched.
-    fresh = api.get("Node", "n0")
+    fresh = api.get("Node", "n0").thaw()
     fresh.status["ready"] = True
     api.update_status(fresh)
     clock.t += 31.0
@@ -128,7 +128,7 @@ def test_notready_waits_out_grace_period(world):
 def test_notready_past_grace_fails_pods(world):
     api, health, jobs, clock = world
     _make_running_gang(api, jobs)
-    fresh = api.get("Node", "n0")
+    fresh = api.get("Node", "n0").thaw()
     fresh.status["ready"] = False
     api.update_status(fresh)
     health.controller.run_until_idle()
@@ -167,11 +167,11 @@ def test_exhausted_restarts_terminal(world):
         alive = [n.metadata.name for n in api.list("Node")]
         pods = api.list("Pod", "ml", label_selector={LABEL_JOB: "train"})
         for i, pod in enumerate(sorted(pods, key=lambda p: p.metadata.name)):
-            fresh = api.get("Pod", pod.metadata.name, "ml")
+            fresh = api.get("Pod", pod.metadata.name, "ml").thaw()
             if not fresh.spec.get("nodeName"):
                 fresh.spec["nodeName"] = alive[i % len(alive)]
                 api.update(fresh)
-            fresh = api.get("Pod", pod.metadata.name, "ml")
+            fresh = api.get("Pod", pod.metadata.name, "ml").thaw()
             if fresh.status.get("phase") is None:
                 fresh.status["phase"] = "Running"
                 api.update_status(fresh)

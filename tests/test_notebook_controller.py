@@ -43,7 +43,7 @@ def test_stop_annotation_scales_to_zero(api):
     ctl = NotebookController(api)
     _make_nb(api)
     ctl.controller.run_until_idle()
-    nb = api.get(KIND, "nb", "user1")
+    nb = api.get(KIND, "nb", "user1").thaw()
     nb.metadata.annotations[STOP_ANNOTATION] = "now"
     api.update(nb)
     ctl.controller.run_until_idle()
@@ -56,7 +56,7 @@ def test_status_mirrors_pod(api):
     ctl.controller.run_until_idle()
     pod = new_resource("Pod", "nb-0", "user1", labels={"notebook": "nb"})
     api.create(pod)
-    pod = api.get("Pod", "nb-0", "user1")
+    pod = api.get("Pod", "nb-0", "user1").thaw()
     pod.status["phase"] = "Running"
     api.update_status(pod)
     ctl.controller.run_until_idle()
@@ -69,7 +69,7 @@ def test_status_mirrors_pod(api):
 def _run_pod(api, name="nb-0", ns="user1", nb="nb"):
     api.create(new_resource("Pod", name, ns, labels={"notebook": nb},
                             spec={"containers": [{"name": "nb"}]}))
-    pod = api.get("Pod", name, ns)
+    pod = api.get("Pod", name, ns).thaw()
     pod.status["phase"] = "Running"
     api.update_status(pod)
 
@@ -210,7 +210,7 @@ def test_tpu_duty_probe_counts_busy_chips_as_activity():
     cpu_pod.status["phase"] = "Running"
     api.create(cpu_pod)
     assert probe(cpu_nb) is None
-    fresh = api.get("Node", "tpu-0", "")
+    fresh = api.get("Node", "tpu-0", "").thaw()
     fresh.status["tpuDutyCycle"] = 0.0
     api.update_status(fresh)
     assert probe(nb) is None  # idle chips: no claimed activity
@@ -256,7 +256,7 @@ def test_combined_probe_takes_latest_and_culler_respects_it():
     nb = api.get("Notebook", "nb", "team")
     assert STOP_ANNOTATION not in nb.metadata.annotations  # chips busy
 
-    fresh = api.get("Node", "tpu-0", "")
+    fresh = api.get("Node", "tpu-0", "").thaw()
     fresh.status["tpuDutyCycle"] = 0.0
     api.update_status(fresh)
     now["t"] += 200.0  # idle everywhere, past IDLE_TIME

@@ -53,10 +53,10 @@ def test_cold_start_from_empty_dir(tmp_path, backend):
 def test_restart_restores_objects_and_rv(tmp_path, backend):
     api = _server(tmp_path, backend)
     api.create(new_resource("ConfigMap", "a", spec={"k": "v1"}))
-    b = api.create(new_resource("TpuJob", "train", spec={"replicas": 4}))
+    b = api.create(new_resource("TpuJob", "train", spec={"replicas": 4})).thaw()
     b.spec["replicas"] = 8
     api.update(b)
-    job = api.get("TpuJob", "train")
+    job = api.get("TpuJob", "train").thaw()
     job.status = {"phase": "Running"}
     api.update_status(job)
     api.create(new_resource("ConfigMap", "gone", spec={}))
@@ -95,6 +95,7 @@ def test_restart_preserves_finalizers_and_deletion_timestamp(
     assert parked.metadata.deletion_timestamp is not None
     assert parked.metadata.finalizers == ["profile-finalizer"]
     # Clearing the finalizer post-restart completes the delete.
+    parked = parked.thaw()
     parked.metadata.finalizers = []
     api2.update(parked)
     with pytest.raises(NotFound):
@@ -179,7 +180,7 @@ def test_crash_between_snapshot_and_truncate_is_safe(tmp_path, backend):
     """Stale pre-snapshot WAL records (legal after a crash inside
     snapshot()) are skipped by rv on replay, not double-applied."""
     api = _server(tmp_path, backend)
-    obj = api.create(new_resource("ConfigMap", "a", spec={"v": 1}))
+    obj = api.create(new_resource("ConfigMap", "a", spec={"v": 1})).thaw()
     obj.spec["v"] = 2
     api.update(obj)
     api.checkpoint()

@@ -52,7 +52,7 @@ def test_terminal_pods_release_budget():
     quota.register(api)
     _quota(api, chips=4)
     api.create(_pod("a", chips=4))
-    done = api.get("Pod", "a", "team")
+    done = api.get("Pod", "a", "team").thaw()
     done.status["phase"] = "Succeeded"
     api.update_status(done)
     api.create(_pod("b", chips=4))  # fits now
@@ -72,7 +72,7 @@ def test_update_does_not_double_count_self():
     quota.register(api)
     _quota(api, chips=4)
     api.create(_pod("a", chips=4))
-    pod = api.get("Pod", "a", "team")
+    pod = api.get("Pod", "a", "team").thaw()
     pod.spec["nodeName"] = "n0"
     api.update(pod)  # re-admission must exclude its own usage
 
@@ -99,7 +99,7 @@ def test_gang_over_quota_holds_pending_episode():
     assert "QuotaExceeded" in reasons
 
     # The budget doubles (profile edit); the next pass starts the gang.
-    rq = api.get("ResourceQuota", "kf-resource-quota", "default")
+    rq = api.get("ResourceQuota", "kf-resource-quota", "default").thaw()
     rq.spec["hard"]["google.com/tpu"] = 8
     api.update(rq)
     import time as _time
@@ -270,7 +270,7 @@ def test_strict_spec_enforced_at_admission():
         api.create(bad)
     good = make_tpujob("j", replicas=1, tpu_chips_per_worker=0,
                        command=("true",))
-    created = api.create(good)
+    created = api.create(good).thaw()
     created.spec["replicsa"] = 2
     with pytest.raises(Invalid, match="replicsa"):
         api.update(created)
@@ -289,7 +289,7 @@ def test_invalid_stored_spec_tears_down_gang_pods():
     assert len(api.list("Pod", "default",
                         label_selector={LABEL_JOB: "j"})) == 2
     # The spec rots in storage (no admission hook on this store).
-    job = api.get(KIND, "j")
+    job = api.get(KIND, "j").thaw()
     job.spec["surprise"] = True
     api.update(job)
     ctl.controller.run_until_idle()
@@ -345,7 +345,7 @@ def test_garbage_cap_or_stored_limit_is_422_not_500():
     with pytest.raises(Invalid, match="old"):
         api.create(_host_pod("new", cpu="1"))
     # Malformed cap: also a clean 422.
-    rq = api.get("ResourceQuota", "kf-resource-quota", "team")
+    rq = api.get("ResourceQuota", "kf-resource-quota", "team").thaw()
     rq.spec["hard"]["cpu"] = "lots"
     api.update(rq)
     api.delete("Pod", "old", "team")
@@ -454,7 +454,7 @@ def test_pod_count_quota():
     with pytest.raises(QuotaExceeded, match="'pods'"):
         api.create(_pod_rr("c"))
     # Terminal pods release count budget.
-    done = api.get("Pod", "a", "team")
+    done = api.get("Pod", "a", "team").thaw()
     done.status["phase"] = "Failed"
     api.update_status(done)
     api.create(_pod_rr("c"))
@@ -532,6 +532,6 @@ def test_update_to_terminal_pod_is_not_charged():
     # Create of an already-terminal pod (runtime materialization) and
     # updates to it are both exempt.
     api.create(done)
-    fresh = api.get("Pod", "done", "team")
+    fresh = api.get("Pod", "done", "team").thaw()
     fresh.metadata.labels["archived"] = "yes"
     api.update(fresh)

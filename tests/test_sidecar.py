@@ -30,6 +30,7 @@ class FakeClock:
 def make_job(api, phase=None):
     job = api.create(new_resource("TpuJob", "job1", "team"))
     if phase:
+        job = job.thaw()
         job.status["phase"] = phase
         api.update_status(job)
     return job
@@ -90,7 +91,7 @@ def test_wait_done_signals_on_terminal_phase(tmp_path):
     ctl, clock = controller(api, tmp_path)
 
     def flip():
-        job = api.get("TpuJob", "job1", "team")
+        job = api.get("TpuJob", "job1", "team").thaw()
         job.status["phase"] = "Succeeded"
         api.update_status(job)
 
@@ -129,7 +130,7 @@ def test_transient_poll_errors_do_not_kill_watch(tmp_path):
         if calls["n"] <= 2:
             raise ConnectionRefusedError("apiserver restarting")
         if calls["n"] >= 4:
-            job = real_get("TpuJob", "job1", "team")
+            job = real_get("TpuJob", "job1", "team").thaw()
             job.status["phase"] = "Succeeded"
             return job
         return real_get(*a, **kw)
@@ -225,7 +226,7 @@ def test_http_facade_conflict_mapping(http_api):
 def test_sidecar_cli_against_http_apiserver(tmp_path):
     """Cross-process: the sidecar CLI watches a real HTTP apiserver."""
     api = FakeApiServer()
-    job = api.create(new_resource("TpuJob", "job1", "team"))
+    job = api.create(new_resource("TpuJob", "job1", "team")).thaw()
     job.status["phase"] = "Running"
     api.update_status(job)
     server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
@@ -235,7 +236,7 @@ def test_sidecar_cli_against_http_apiserver(tmp_path):
         import time
 
         time.sleep(1.0)
-        fresh = api.get("TpuJob", "job1", "team")
+        fresh = api.get("TpuJob", "job1", "team").thaw()
         fresh.status["phase"] = "Succeeded"
         api.update_status(fresh)
 

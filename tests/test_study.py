@@ -56,7 +56,7 @@ def finish_trial(api, name, loss=None, phase="Succeeded"):
     launcher's report_observation call."""
     if loss is not None:
         report_observation(api, name, "team", {"loss": loss})
-    job = api.get("TpuJob", name, "team")
+    job = api.get("TpuJob", name, "team").thaw()
     job.status["phase"] = phase
     api.update_status(job)
 
@@ -651,7 +651,7 @@ def test_non_numeric_observation_does_not_crash():
     make_study(api, algorithm="grid", parallelism=4)
     ctl.controller.run_until_idle()
     trials = api.list("TpuJob", "team", label_selector={LABEL_STUDY: "study1"})
-    bad = api.get("TpuJob", trials[0].metadata.name, "team")
+    bad = api.get("TpuJob", trials[0].metadata.name, "team").thaw()
     bad.status["observation"] = {"loss": "not-a-number"}
     bad.status["phase"] = "Succeeded"
     api.update_status(bad)

@@ -33,7 +33,7 @@ def test_pvc_logspath_mounts_and_colocates(api):
                            "name": "x"}]},
     )
     api.create(holder)
-    p = api.get("Pod", "train-0", "user1")
+    p = api.get("Pod", "train-0", "user1").thaw()
     p.status["phase"] = "Running"
     api.update_status(p)
 
@@ -52,7 +52,7 @@ def test_status_mirrors_deployment(api):
     ctl = TensorboardController(api)
     api.create(new_resource(KIND, "tb", "u", spec={"logspath": "gs://b/l"}))
     ctl.controller.run_until_idle()
-    dep = api.get("Deployment", "tb", "u")
+    dep = api.get("Deployment", "tb", "u").thaw()
     dep.status["readyReplicas"] = 1
     api.update_status(dep)
     ctl.controller.run_until_idle()

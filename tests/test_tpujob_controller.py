@@ -26,7 +26,7 @@ def _drain(ctl):
 
 
 def _set_pod_phase(api, name, phase, ns="default"):
-    pod = api.get("Pod", name, ns)
+    pod = api.get("Pod", name, ns).thaw()
     pod.status["phase"] = phase
     api.update_status(pod)
 

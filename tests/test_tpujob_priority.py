@@ -122,6 +122,7 @@ def test_victim_reschedules_after_preemptor_finishes():
 
     # The urgent gang completes; its pods report Succeeded.
     for pod in _pods(api, "urgent"):
+        pod = pod.thaw()
         pod.status["phase"] = "Succeeded"
         api.update_status(pod)
     _run(ctl, passes=10)
@@ -148,7 +149,7 @@ def test_preempted_victim_backs_off_before_regrabbing_chips():
     api, ctl = _world(nodes=2)
     api.create(_job("batch", priority=0))
     _run(ctl)
-    job = api.get(KIND, "batch")
+    job = api.get(KIND, "batch").thaw()
     job.status["reason"] = "Preempted"
     job.status["phase"] = "Pending"
     api.update_status(job)
@@ -207,6 +208,7 @@ def test_preemption_scopes_victims_by_node_overlap_not_topology_string():
     # No topology → the controller didn't place; simulate an external
     # placement pinning the squatter onto the pool's nodes.
     for i, pod in enumerate(_pods(api, "squatter")):
+        pod = pod.thaw()
         pod.spec["nodeName"] = f"n{i}"
         api.update(pod)
 

@@ -82,7 +82,7 @@ def test_finalized_delete_emits_deleted_past_bookmark():
     obj.metadata.finalizers = ["keep"]
     stored = api.create(obj)
     api.delete("Widget", "fin")  # marks deletionTimestamp (MODIFIED)
-    pending = api.get("Widget", "fin")
+    pending = api.get("Widget", "fin").thaw()
     bookmark = pending.metadata.resource_version
     pending.metadata.finalizers = []
     api.update(pending)  # clears last finalizer → actual removal

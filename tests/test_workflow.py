@@ -35,7 +35,7 @@ def pods_for(api, step_name, name="wf"):
 
 
 def finish(api, pod, phase="Succeeded"):
-    fresh = api.get("Pod", pod.metadata.name, "ci")
+    fresh = api.get("Pod", pod.metadata.name, "ci").thaw()
     fresh.status["phase"] = phase
     api.update_status(fresh)
 
@@ -552,7 +552,7 @@ def test_when_false_skips_step_and_dependents_still_run():
     ctl.controller.run_until_idle()
     [probe] = pods_for(api, "probe")
     # probe reports healthy → remediate's guard is false.
-    fresh = api.get("Pod", probe.metadata.name, "ci")
+    fresh = api.get("Pod", probe.metadata.name, "ci").thaw()
     fresh.status["phase"] = "Succeeded"
     fresh.status["output"] = "healthy"
     api.update_status(fresh)
@@ -584,7 +584,7 @@ def test_when_true_runs_step():
     make_workflow(api, spec)
     ctl.controller.run_until_idle()
     [probe] = pods_for(api, "probe")
-    fresh = api.get("Pod", probe.metadata.name, "ci")
+    fresh = api.get("Pod", probe.metadata.name, "ci").thaw()
     fresh.status["phase"] = "Succeeded"
     fresh.status["output"] = "unhealthy"
     api.update_status(fresh)
@@ -725,6 +725,7 @@ def test_tpu_job_step_lifecycle():
     assert api.list("Pod", "ci") == []  # no bare step pod for slice steps
 
     # Gang finishes with an observation (launcher contract).
+    job = job.thaw()
     job.status = {"phase": "Succeeded",
                   "observation": {"loss": 0.25, "accuracy": 0.9}}
     api.update_status(job)
@@ -754,6 +755,7 @@ def test_tpu_job_step_failure_fails_dag_and_retries():
     make_workflow(api, spec)
     ctl.controller.run_until_idle()
     [job] = api.list("TpuJob", "ci")
+    job = job.thaw()
     job.status = {"phase": "Failed"}
     api.update_status(job)
     ctl.controller.run_until_idle()
@@ -761,6 +763,7 @@ def test_tpu_job_step_failure_fails_dag_and_retries():
     assert len(jobs) == 2  # retry attempt materialized
     for j in jobs:
         if j.status.get("phase") != "Failed":
+            j = j.thaw()
             j.status = {"phase": "Failed"}
             api.update_status(j)
     ctl.controller.run_until_idle()
@@ -821,6 +824,7 @@ def test_restarting_gang_is_in_flight_not_retried():
     make_workflow(api, spec)
     ctl.controller.run_until_idle()
     [job] = api.list("TpuJob", "ci")
+    job = job.thaw()
     job.status = {"phase": "Restarting", "restarts": 1}
     api.update_status(job)
     ctl.controller.run_until_idle()

@@ -44,7 +44,7 @@ def test_scale_down_deletes_excess_pods():
     m = WorkloadMaterializer(api)
     make_sts(api, replicas=2)
     m.step()
-    sts = api.get("StatefulSet", "web", "team")
+    sts = api.get("StatefulSet", "web", "team").thaw()
     sts.spec["replicas"] = 0
     api.update(sts)
     m.step()
@@ -106,7 +106,7 @@ def test_same_name_sts_and_deployment_do_not_fight():
     assert api.get("Deployment", "demo", "team").status["readyReplicas"] == 1
     assert api.get("StatefulSet", "demo", "team").status["readyReplicas"] == 1
     # Stop the notebook: only the STS pod goes away.
-    sts = api.get("StatefulSet", "demo", "team")
+    sts = api.get("StatefulSet", "demo", "team").thaw()
     sts.spec["replicas"] = 0
     api.update(sts)
     m.step()
