@@ -63,7 +63,7 @@ def main() -> None:
         "--workload",
         choices=(
             "all", "resnet", "lm", "serving", "study", "chaos",
-            "controlplane",
+            "controlplane", "attention",
         ),
         default="all",
         help="all (default) = resnet then lm, so the driver artifact "
@@ -75,7 +75,10 @@ def main() -> None:
         "soak (prints the seed so any failure reproduces with "
         "KFTPU_CHAOS_SEED=<seed>); controlplane = watch fan-out "
         "events/sec, list latency, and write-to-delivery latency through "
-        "the HTTP facade against both store backends",
+        "the HTTP facade against both store backends; attention = "
+        "per-seq-len flash kernel TFLOP/s (fwd and fwd+bwd) vs the dense "
+        "reference, plus grid-step and lse-HBM-byte accounting from the "
+        "static schedule",
     )
     parser.add_argument(
         "--chaos-seed",
@@ -96,7 +99,7 @@ def main() -> None:
     parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument(
         "--remat-policy",
-        choices=("auto", "none", "full", "dots", "attn", "mlp"),
+        choices=("auto", "none", "full", "dots", "attn", "mlp", "flash"),
         default="auto",
         help="lm only: per-block checkpoint policy. auto = none (no "
         "remat at all — every activation saved) at S<=8192 with the "
@@ -107,7 +110,9 @@ def main() -> None:
         "re-runs in the backward) — at 16k no-remat's saved "
         "activations crowd out the batch (51.9%% mlp vs 50.8%% none "
         "at bs=2). dots spills at long S; full re-runs flash fwd in "
-        "bwd",
+        "bwd; flash pins only each attention's output + packed lse "
+        "(strictly less state than mlp, same no-recompute property — "
+        "the long-context candidate to sweep against mlp)",
     )
     parser.add_argument(
         "--flash-block-q", type=int, default=None,
@@ -133,6 +138,22 @@ def main() -> None:
         "attention matmul; 64 half-utilizes them (measured: 128 is +52%% "
         "tokens/sec at S=8192, +38%% at S=2048 — the TPU-first head "
         "shape, same d_attn and param count)",
+    )
+    parser.add_argument(
+        "--attn-seq-lens", default="2048,4096,8192,16384",
+        help="attention only: comma-separated sequence lengths",
+    )
+    parser.add_argument(
+        "--attn-heads", type=int, default=None,
+        help="attention only: head count (default 1024 // head_dim, the "
+        "LM bench's d_attn=1024 shape)",
+    )
+    parser.add_argument(
+        "--attn-dense-max", type=int, default=4096,
+        help="attention only: longest S to also time the dense "
+        "reference at (it materializes [S, S] scores — at 8k+ it OOMs "
+        "a v5e, which is the point); longer rows report vs_baseline "
+        "null",
     )
     parser.add_argument("--warmup-steps", type=int, default=5)
     parser.add_argument("--steps", type=int, default=30)
@@ -166,18 +187,22 @@ def main() -> None:
         "(controls serialized event size)",
     )
     args = parser.parse_args()
-    if args.workload in ("lm", "all") and (
-        args.head_dim <= 0 or 1024 % args.head_dim
-    ):
+    needs_lm_shape = args.workload in ("lm", "all") or (
+        args.workload == "attention" and args.attn_heads is None
+    )
+    if needs_lm_shape and (args.head_dim <= 0 or 1024 % args.head_dim):
         parser.error(
             "--head-dim must divide 1024 (n_heads = 1024 // head_dim "
-            "keeps d_attn fixed so runs are comparable)"
+            "keeps d_attn fixed so runs are comparable); for other "
+            "attention shapes pass --attn-heads explicitly"
         )
     if args.steps < 1:
         parser.error("--steps must be >= 1 (the timing fence reads the "
                      "last step's metrics)")
     if args.workload == "lm":
         return bench_lm(args)
+    if args.workload == "attention":
+        return bench_attention(args)
     if args.workload == "serving":
         return bench_serving(args)
     if args.workload == "study":
@@ -1087,6 +1112,177 @@ def bench_study(args) -> None:
 
 
 
+def _published_baseline(metric_key: str):
+    """Published baseline for a metric from BASELINE.json's `published`
+    map (this repo's own driver-captured r05 numbers for the LM
+    metrics — the recovery target for the attention-schedule work).
+    Returns None when no baseline is recorded, which prints as
+    `"vs_baseline": null`."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            published = json.load(f).get("published", {})
+    except (OSError, ValueError):
+        return None
+    value = published.get(metric_key)
+    return value if isinstance(value, (int, float)) else None
+
+
+def bench_attention(args) -> None:
+    """Flash-attention kernel microbench: per-seq-len TFLOP/s (fwd and
+    fwd+bwd) with the dense reference as the baseline, plus the static
+    schedule accounting the overhaul is about — causal grid steps
+    (compact triangular vs rectangular) and lse HBM bytes (lane-packed
+    vs lane-replicated). The accounting comes from `flash_schedule`, the
+    same helper the kernel impls build their grids from, so the emitted
+    numbers are the schedule that actually ran.
+
+    FLOP accounting is causal (half the S² rectangle), identical for
+    flash and dense, so the TFLOP/s ratio is purely a wall-clock ratio.
+    Runs under the Pallas interpreter off-TPU (slow; the tier-1 smoke
+    test uses tiny shapes) — the accounting metrics are exact either
+    way."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops.attention import dense_attention
+    from kubeflow_tpu.ops.flash import flash_attention, flash_schedule
+
+    seq_lens = [int(s) for s in args.attn_seq_lens.split(",") if s]
+    b = args.batch_size or 4
+    d = args.head_dim
+    h = args.attn_heads or max(1, 1024 // d)
+    bq = args.flash_block_q or 1024
+    bk = args.flash_block_k or 1024
+    dtype = jnp.bfloat16
+    steps = max(1, args.steps)
+
+    def timed(fn, *xs) -> float:
+        # Same fencing discipline as timed_run: a scalar device_get is
+        # the only reliable fence on tunneled platforms, and the warmup
+        # (compile + --warmup-steps dispatches) ends with one so no
+        # warmup work leaks into the timed window.
+        out = None
+        for _ in range(max(1, args.warmup_steps)):
+            out = fn(*xs)
+        float(jax.tree_util.tree_leaves(out)[0].sum())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*xs)
+        float(jax.tree_util.tree_leaves(out)[0].sum())
+        return (time.perf_counter() - t0) / steps
+
+    for s in seq_lens:
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (b, s, h, d)
+        q = jax.random.normal(kq, shape, dtype)
+        k = jax.random.normal(kk, shape, dtype)
+        v = jax.random.normal(kv, shape, dtype)
+
+        def run_flash(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk
+            )
+
+        def flash_loss(q, k, v):
+            return jnp.sum(run_flash(q, k, v).astype(jnp.float32) ** 2)
+
+        flash = jax.jit(run_flash)
+        flash_grad = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
+
+        t_fwd = timed(flash, q, k, v)
+        t_bwd = timed(flash_grad, q, k, v)  # fwd residuals + both bwd kernels
+
+        # Causal FLOPs: 2 matmuls fwd, 5 matmuls bwd (dq: 2, dkv: 3), each
+        # 2·(S²/2)·d per head — the standard fwd:bwd = 2:5 ratio.
+        fwd_flops = 2 * b * h * s * s * d
+        bwd_flops = fwd_flops * 5 / 2
+        fwd_tflops = fwd_flops / t_fwd / 1e12
+        fwdbwd_tflops = (fwd_flops + bwd_flops) / t_bwd / 1e12
+
+        dense_fwd_tflops = dense_fwdbwd_tflops = None
+        if s <= args.attn_dense_max:
+            dense = jax.jit(lambda q, k, v: dense_attention(q, k, v))
+            dense_loss = jax.jit(
+                lambda q, k, v: jnp.sum(
+                    dense_attention(q, k, v).astype(jnp.float32) ** 2
+                )
+            )
+            dense_grad = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))
+            dense_fwd_tflops = fwd_flops / timed(dense, q, k, v) / 1e12
+            dense_fwdbwd_tflops = (
+                (fwd_flops + bwd_flops) / timed(dense_grad, q, k, v) / 1e12
+            )
+
+        sched = flash_schedule(s, s, block_q=bq, block_k=bk, causal=True)
+        bh = b * h
+        sig4 = lambda x: float(f"{x:.4g}")  # interpret-mode runs are tiny
+        rows = (
+            (
+                f"attention_flash_fwd_tflops_s{s}",
+                sig4(fwd_tflops),
+                "TFLOP/s (causal-FLOP accounting)",
+                round(fwd_tflops / dense_fwd_tflops, 4)
+                if dense_fwd_tflops
+                else None,
+            ),
+            (
+                f"attention_flash_fwdbwd_tflops_s{s}",
+                sig4(fwdbwd_tflops),
+                "TFLOP/s (fwd+bwd, causal-FLOP accounting)",
+                round(fwdbwd_tflops / dense_fwdbwd_tflops, 4)
+                if dense_fwdbwd_tflops
+                else None,
+            ),
+            (
+                f"attention_causal_grid_steps_s{s}",
+                sched["grid_steps"],
+                f"fwd grid steps per bh row ({'compact' if sched['compact'] else 'rectangular'}; "
+                f"rectangular = {sched['rect_grid_steps']}, blocks "
+                f"{sched['block_q']}x{sched['block_k']})",
+                round(sched["grid_steps"] / sched["rect_grid_steps"], 4),
+            ),
+            (
+                f"attention_lse_hbm_bytes_s{s}",
+                sched["lse_bytes"] * bh,
+                f"bytes ({'lane-packed' if sched['lse_packed'] else 'lane-replicated'}; "
+                f"replicated layout = {sched['lse_replicated_bytes'] * bh})",
+                round(
+                    sched["lse_bytes"] / sched["lse_replicated_bytes"], 6
+                ),
+            ),
+        )
+        for metric, value, unit, vs in rows:
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": value,
+                        "unit": unit,
+                        "vs_baseline": vs,
+                    }
+                )
+            )
+        dense_note = (
+            f"dense fwd {dense_fwd_tflops:.2f} fwd+bwd "
+            f"{dense_fwdbwd_tflops:.2f} TF/s"
+            if dense_fwd_tflops
+            else f"dense skipped (S > {args.attn_dense_max})"
+        )
+        print(
+            f"# attention s={s} bh={bh} d={d}: flash fwd "
+            f"{fwd_tflops:.2f} fwd+bwd {fwdbwd_tflops:.2f} TF/s; "
+            f"{dense_note}; grid {sched['grid_steps']}/"
+            f"{sched['rect_grid_steps']} steps "
+            f"(compact={sched['compact']}), lse "
+            f"{sched['lse_bytes'] * bh}B (packed={sched['lse_packed']})",
+            file=sys.stderr,
+        )
+
+
 def bench_lm(args) -> None:
     """Transformer-LM training throughput (tokens/sec/chip) with the
     Pallas flash-attention kernel — the long-context datapoint the
@@ -1192,13 +1388,26 @@ def bench_lm(args) -> None:
     )
     V5E_PEAK_BF16 = 197e12
     mfu = per_chip * flops_per_token / V5E_PEAK_BF16
+    # Baselines are this repo's own r05 driver artifact (BENCH_r05.json),
+    # recorded per seq-len in BASELINE.json's `published` map — the MFU
+    # decay curve the attention-schedule overhaul targets. The ratio is
+    # computed exactly like the ResNet metric's (measured / baseline);
+    # an unrecorded seq-len reports null.
+    tokens_base = _published_baseline(
+        f"transformer_lm_train_tokens_per_sec_per_chip_s{args.seq_len}"
+    )
+    mfu_base = _published_baseline(
+        f"transformer_lm_model_mfu_s{args.seq_len}"
+    )
     print(
         json.dumps(
             {
                 "metric": "transformer_lm_train_tokens_per_sec_per_chip",
                 "value": round(per_chip, 1),
                 "unit": "tokens/sec/chip",
-                "vs_baseline": None,  # greenfield: no reference number
+                "vs_baseline": (
+                    round(per_chip / tokens_base, 4) if tokens_base else None
+                ),
             }
         )
     )
@@ -1208,7 +1417,7 @@ def bench_lm(args) -> None:
                 "metric": f"transformer_lm_model_mfu_s{args.seq_len}",
                 "value": round(mfu, 4),
                 "unit": "fraction of v5e bf16 peak",
-                "vs_baseline": None,
+                "vs_baseline": round(mfu / mfu_base, 4) if mfu_base else None,
             }
         )
     )

@@ -30,7 +30,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.ops.attention import dense_attention, ring_attention
 from kubeflow_tpu.parallel.sharding import batch_axes
-from kubeflow_tpu.ops.flash import flash_attention, flash_usable
+from kubeflow_tpu.ops.flash import (
+    CHECKPOINT_LSE_NAME,
+    CHECKPOINT_OUT_NAME,
+    flash_attention,
+    flash_kernel_tileable,
+    flash_usable,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +65,16 @@ class TransformerConfig:
     #   "attn" — pin only the attention output (measured-neutral: the
     #            custom-VJP's lse residual is out of the policy's
     #            reach). See docs/architecture.md LM roofline.
+    #   "flash" — pin the flash kernel's named outputs (attention output
+    #            AND its log-sum-exp, `flash_attn_out`/`flash_attn_lse`)
+    #            so the backward never re-runs the forward attention
+    #            kernel; everything else (projections, norms, MLP)
+    #            recomputes as under "full". With the lane-packed lse the
+    #            pinned state is O(S·d) + O(S) per layer — strictly less
+    #            than "mlp" saves (which pins q/k/v/o/lse) while dodging
+    #            the same flash-forward recompute. Requires the flash
+    #            kernel path; under the dense fallback nothing is named,
+    #            so it degrades to "full" (use "attn" there).
     remat_policy: str = "full"
     # Attention kernel for the non-ring path: "auto" uses the Pallas flash
     # kernel on TPU when the shapes divide into flash blocks, else the
@@ -82,6 +98,31 @@ class TransformerConfig:
     aux_loss_coef: float = 0.01
 
 
+def checkpoint_policy(name: str):
+    """`jax.checkpoint` policy object for a named remat policy.
+
+    Shared by `_block_cls` (per-block remat) and the trainer's
+    whole-step remat (`TrainConfig.step_remat`) so the two layers can't
+    drift. Only the policies that ARE `jax.checkpoint` policies live
+    here — "none" (no checkpoint) and "mlp" (a structural split, not a
+    policy) are handled by `_block_cls` directly.
+    """
+    if name == "full":
+        return None  # checkpoint with no policy: save block boundaries only
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if name == "flash":
+        return jax.checkpoint_policies.save_only_these_names(
+            CHECKPOINT_OUT_NAME, CHECKPOINT_LSE_NAME
+        )
+    raise ValueError(
+        f"no jax.checkpoint policy for remat_policy {name!r}; expected "
+        "'full', 'dots', 'attn', or 'flash'"
+    )
+
+
 def _block_cls(cfg: "TransformerConfig"):
     """Block, wrapped per the config's remat policy."""
     if not cfg.remat or cfg.remat_policy == "none":
@@ -92,24 +133,20 @@ def _block_cls(cfg: "TransformerConfig"):
         # "mlp" retakes the lead at S=16384 where the saved activations
         # crowd out the batch (docs/architecture.md roofline).
         return Block
-    if cfg.remat_policy == "dots":
+    if cfg.remat_policy in ("dots", "attn", "flash"):
+        # Policy-driven checkpoints. "attn" saves only the named
+        # attention output — the classic save-what's-costly-and-small
+        # trade, but the flash custom-VJP's lse residual is out of its
+        # reach, so the flash FORWARD still re-runs in the backward to
+        # rebuild it (measured-neutral). "flash" fixes exactly that: the
+        # kernel names both its output and its (lane-packed) lse, the
+        # policy pins both, and the backward's partial eval dead-codes
+        # the forward kernel entirely — q/k/v recompute from the cheap
+        # projections, o/lse come from the saved residuals.
         return nn.remat(
             Block,
             static_argnums=(),
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        )
-    if cfg.remat_policy == "attn":
-        # Long-context policy: save ONLY the attention outputs across the
-        # block checkpoint. The flash kernel is the expensive recompute
-        # (O(S²·d) with its own softmax pass) while its output is small
-        # (O(S·d)) — the classic save-what's-costly-and-small trade.
-        # Everything else (norms, MLP) recomputes as under "full".
-        return nn.remat(
-            Block,
-            static_argnums=(),
-            policy=jax.checkpoint_policies.save_only_these_names(
-                "attn_out"
-            ),
+            policy=checkpoint_policy(cfg.remat_policy),
         )
     if cfg.remat_policy == "mlp":
         # Long-context policy that actually dodges the flash recompute:
@@ -195,7 +232,8 @@ def _attend(q, k, v, mesh: Mesh | None, cfg: "TransformerConfig"):
         if (
             impl in ("auto", "flash")
             and jax.default_backend() == "tpu"
-            and flash_usable(chunk, chunk, bq, bk)
+            and flash_kernel_tileable(chunk, bq)
+            and flash_kernel_tileable(chunk, bk)
         ):
             from kubeflow_tpu.ops.flash import ring_flash_attention
 
@@ -203,6 +241,10 @@ def _attend(q, k, v, mesh: Mesh | None, cfg: "TransformerConfig"):
                 q, k, v, mesh, causal=True, block_q=bq, block_k=bk
             )
         return ring_attention(q, k, v, mesh, causal=True)
+    # flash_usable is now unconditionally true for positive lengths
+    # (ragged sequences pad inside the kernel wrapper instead of
+    # silently falling back to the dense O(S²) path); the predicate
+    # stays as the dispatch contract.
     use_flash = impl == "flash" or (
         impl == "auto"
         and jax.default_backend() == "tpu"

@@ -23,6 +23,7 @@ from jax import lax
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu.parallel.collectives import axis_size
 from kubeflow_tpu.parallel.sharding import batch_axes
 
 
@@ -45,7 +46,7 @@ def _ring_body(q, k, v, *, axis: str, causal: bool):
     the step index is static (letting the causal mask specialize per hop)
     and the final hop skips its rotation — n-1 ppermutes, not n.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     b, c, h, d = q.shape
     scale = 1.0 / math.sqrt(d)

@@ -4,23 +4,49 @@ The reference has no kernels at all — its device-level compute lives inside
 third-party containers (SURVEY.md §2.1). On TPU the hot op of the flagship
 transformer is attention, and the XLA-fused dense path materializes the
 [S, S] score matrix in HBM. This kernel is the classic blockwise
-(flash-attention) schedule tiled for the MXU instead:
+(flash-attention) schedule tiled for the MXU, with a long-context schedule
+on top:
 
-- grid (batch*heads, q_blocks, k_blocks), k innermost: TPU grid steps run
-  sequentially, so the running max / normalizer / output accumulator live in
-  VMEM scratch and carry across k-steps — HBM traffic is O(S·d), never O(S²).
-- Q/K/V blocks stream HBM→VMEM via the BlockSpec pipeline (double-buffered
-  by Pallas); the two matmuls per block hit the MXU in float32 accumulation.
-- causal blocks strictly above the diagonal are predicated off with
-  ``pl.when`` — they cost a grid step but no FLOPs.
-- the saved log-sum-exp rides in a lane-replicated [BH, S, 128] buffer —
-  Mosaic requires the last two block dims to be (8k, 128)-tileable, so a
-  [BH, S] vector output is not lowerable (same layout the upstream TPU
-  flash kernel uses).
-- backward is two more kernels with the same tiling: one accumulating dQ
-  (k innermost), one accumulating dK/dV (q innermost), both recomputing
-  P = exp(S - lse) from the lse rather than storing P, and recomputing
-  delta = rowsum(dO ∘ O) on-chip.
+- **Compact causal grid.** For causal self-attention the grid enumerates
+  ONLY the lower-triangular (q, k) block pairs: the grid is
+  (batch*heads, T) with T = nq·(nq+1)/2, and two scalar-prefetched int32
+  tables map the flat step index back to (i, j). Blocks above the
+  diagonal cost zero grid steps — at large S that halves the step count
+  outright, where the old rectangular grid paid a predicated-off
+  DMA+step per masked block. The rectangular grid (with `_clamp_i` /
+  `_clamp_j` DMA elision) remains as the fallback for non-causal,
+  cross-shaped, or uneven-block configurations.
+- **Lane-packed LSE.** The saved log-sum-exp is stored as
+  [BH, S/128, 128] tiles — 128 per-row values per lane row — instead of
+  the lane-replicated [BH, S, 128] buffer Mosaic's tiling would
+  otherwise force (a [BH, S] vector output is not lowerable). That cuts
+  the lse's HBM footprint and its fwd→bwd traffic 128×. Packing happens
+  in-register via (128, 128) transposes of the lane-replicated scratch
+  (a supported Mosaic relayout), not a 1-D reshape. Block sizes that are
+  not lane-aligned fall back to the replicated layout with a slim
+  [BH, S, 1] residual.
+- **Shared-delta backward.** A small precompute kernel emits
+  delta = rowsum(dO ∘ O) once per backward; both `_dq_kernel` and
+  `_dkv_kernel` read it as an input instead of each recomputing the
+  rowsum on-chip — which also removes O entirely from both kernels'
+  input streams (dO/O were previously re-streamed by each).
+- **Internal padding.** Sequence lengths with no 8-aligned divisor pad
+  to the next lane multiple inside `flash_attention`; the tail is
+  masked in-kernel (`kv_len`) and sliced off the output, so ragged
+  lengths run the kernel instead of silently falling back to the dense
+  O(S²) path.
+- grid steps run sequentially on TPU, so the running max / normalizer /
+  output accumulator live in VMEM scratch and carry across k-steps —
+  HBM traffic is O(S·d), never O(S²); Q/K/V blocks stream HBM→VMEM via
+  the BlockSpec pipeline (double-buffered by Pallas) and the two
+  matmuls per block hit the MXU in float32 accumulation.
+
+The forward names its outputs (`flash_attn_out`, `flash_attn_lse`) via
+`jax.checkpoint_name`, so `remat_policy="flash"`
+(`models/transformer.py`) can pin exactly {attention output, lse} across
+a block checkpoint — the backward then never re-runs the forward kernel
+(its residuals q/k/v recompute from the cheap projections; o and lse are
+saved).
 
 Everything is wired through ``jax.custom_vjp`` so the op drops into any
 ``jax.grad`` / ``pjit`` / ``shard_map`` context. On non-TPU backends the
@@ -35,13 +61,27 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float("-inf")
-_LANES = 128  # lse lane-replication width (Mosaic min tile lane count)
+_LANES = 128  # Mosaic min tile lane count (f32 tile is (8, 128))
 _SUBLANES = 8  # Mosaic's minimum second-minor tile rows
+
+# Compact causal grids carry two int32 (i, j) lookup tables in SMEM via
+# scalar prefetch. Cap their length so a degenerate tiny-block × huge-S
+# combination cannot blow the scalar-memory budget; past the cap the
+# rectangular fallback (predicated blocks + clamped DMAs) still runs.
+_MAX_COMPACT_STEPS = 1 << 16
+
+# jax.checkpoint_name tags on the forward's outputs — the handles
+# remat_policy="flash" (models/transformer.py) pins across a block
+# checkpoint so the backward never re-runs the forward kernel.
+CHECKPOINT_OUT_NAME = "flash_attn_out"
+CHECKPOINT_LSE_NAME = "flash_attn_lse"
 
 
 def _auto_interpret(interpret: bool | None) -> bool:
@@ -56,24 +96,227 @@ def _causal_mask(s, i, j, bq, bk):
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
 
-def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc,
-    *, scale: float, causal: bool, bq: int, bk: int,
-):
-    i = pl.program_id(1)
-    j = pl.program_id(2)
-    nk = pl.num_programs(2)
+def _kv_tail_mask(s, j, bk, kv_len: int):
+    """Mask key positions past the true (pre-padding) sequence length."""
+    k_pos = j * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos < kv_len, s, _NEG_INF)
 
-    @pl.when(j == 0)
+
+# -- lse layouts -------------------------------------------------------------
+#
+# Kernel-side the lse rides in one of two layouts:
+#   packed     [BH, S/128, 128] — tile (w, l) holds lse[w*128 + l]; the
+#              exact information content, 1/128th the replicated bytes.
+#   replicated [BH, S, 128]     — every lane carries the row's value (the
+#              layout Mosaic's (8, 128) tiling forces when the q block is
+#              not lane-aligned).
+# The packed layout needs S and every q-block size in play (fwd and bwd)
+# to be multiples of 128 so block boundaries land on packed-row
+# boundaries. Outside the kernels the canonical form is per-row
+# [BH, S, 1] ("rows"), to which both layouts convert with free reshapes.
+
+
+def _lse_layout_shape(bh: int, sq: int, packed: bool) -> tuple[int, ...]:
+    if packed:
+        return (bh, sq // _LANES, _LANES)
+    return (bh, sq, _LANES)
+
+
+def _lse_block(bq: int, packed: bool) -> tuple[int, ...]:
+    if packed:
+        return (1, bq // _LANES, _LANES)
+    return (1, bq, _LANES)
+
+
+def _lse_is_packed(sq: int, *q_blocks: int) -> bool:
+    return sq % _LANES == 0 and all(b % _LANES == 0 for b in q_blocks)
+
+
+def _pack_rows(x_rep):
+    """(bq, 128) lane-replicated → (bq/128, 128) packed, in-kernel.
+
+    Cross-lane packing without a Mosaic 1-D reshape: each 128-row chunk
+    of the replicated buffer is transposed — a supported (128, 128)
+    relayout — after which EVERY row of the transpose holds the chunk's
+    128 per-row values; row 0 is the packed tile row."""
+    bq = x_rep.shape[0]
+    rows = [
+        x_rep[w * _LANES:(w + 1) * _LANES, :].T[:1, :]
+        for w in range(bq // _LANES)
+    ]
+    return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
+
+
+def _unpack_rows(x_packed):
+    """(m, 128) packed → (m*128, 128) lane-replicated, in-kernel (the
+    inverse trick: broadcast each packed row across sublanes, transpose)."""
+    m = x_packed.shape[0]
+    chunks = [
+        jnp.broadcast_to(x_packed[w:w + 1, :], (_LANES, _LANES)).T
+        for w in range(m)
+    ]
+    return chunks[0] if m == 1 else jnp.concatenate(chunks, axis=0)
+
+
+def _read_rows(ref0, packed: bool):
+    """Kernel-side: an lse/delta block in either layout → (bq, 1) rows."""
+    if packed:
+        return _unpack_rows(ref0)[:, :1]
+    return ref0[:, :1]
+
+
+def _lse_rows(lse, sq: int):
+    """Host-side: any lse form (packed / replicated / slim) → [BH, S, 1]."""
+    if lse.shape[1] == sq:
+        return lse[:, :, :1]
+    return lse.reshape(lse.shape[0], sq, 1)
+
+
+def _rows_to_layout(rows, packed: bool):
+    """Host-side: [BH, S, 1] rows → the kernel layout."""
+    bh, sq, _ = rows.shape
+    if packed:
+        return rows.reshape(bh, sq // _LANES, _LANES)
+    return jnp.broadcast_to(rows, (bh, sq, _LANES))
+
+
+# -- schedule ----------------------------------------------------------------
+
+
+def _pick_block(block: int, s: int) -> int:
+    """The requested block, clamped and — when it doesn't divide the
+    sequence — degraded to the largest aligned divisor of `s` instead of
+    erroring (a v5e sweep shows bigger blocks win, so prefer the largest
+    block that tiles the sequence exactly). Every returned block is a
+    multiple of the 8-row sublane so Mosaic can lower the (bq, ...)
+    VMEM tiles; lane-aligned (128) divisors are preferred. Raising is
+    internal-only now: ``flash_attention`` pads untileable sequences to
+    the next lane multiple before the kernels ever see them."""
+    block = min(block, s)
+    if s % block == 0 and block % _SUBLANES == 0:
+        return block
+    for step in (_LANES, _SUBLANES):
+        for candidate in range(block - block % step, step - 1, -step):
+            if s % candidate == 0:
+                return candidate
+    raise ValueError(
+        f"flash attention: no {_SUBLANES}-aligned block <= {block} divides "
+        f"the sequence length ({s}); pad the sequence (flash_attention "
+        "does this automatically) or use dense_attention"
+    )
+
+
+def _tileable(block: int, s: int) -> bool:
+    try:
+        _pick_block(block, s)
+    except ValueError:
+        return False
+    return True
+
+
+def _pad_to_tileable(block: int, s: int) -> int:
+    """`s` when it already tiles, else the next lane multiple (which
+    always tiles: 128 itself divides any 128-multiple)."""
+    if _tileable(block, s):
+        return s
+    return -(-s // _LANES) * _LANES
+
+
+def _compactable(causal: bool, sq: int, sk: int, bq: int, bk: int) -> bool:
+    """Whether the triangular grid applies: causal self-attention with
+    square blocks, so block row i runs exactly blocks j <= i."""
+    if not (causal and sq == sk and bq == bk):
+        return False
+    nq = sq // bq
+    return nq * (nq + 1) // 2 <= _MAX_COMPACT_STEPS
+
+
+def _grid_steps(causal: bool, sq: int, sk: int, bq: int, bk: int):
+    """(steps, rectangular_steps, compact) per (batch*head) grid row."""
+    nq, nk = sq // bq, sk // bk
+    rect = nq * nk
+    if _compactable(causal, sq, sk, bq, bk):
+        return nq * (nq + 1) // 2, rect, True
+    return rect, rect, False
+
+
+def _tri_tables(nq: int, order: str):
+    """Scalar-prefetch lookup tables for the compact causal grid: the
+    flat step index t → (i, j) over the lower triangle. "row" order
+    (fwd / dq: j contiguous per i) or "col" order (dkv: i contiguous
+    per j)."""
+    i, j = np.tril_indices(nq)
+    if order == "col":
+        o = np.lexsort((i, j))
+        i, j = i[o], j[o]
+    return jnp.asarray(i, jnp.int32), jnp.asarray(j, jnp.int32)
+
+
+def flash_schedule(
+    seq_q: int,
+    seq_k: int,
+    *,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
+    causal: bool = True,
+) -> dict:
+    """Static accounting for the schedule `flash_attention` would run.
+
+    This is the single source of truth the kernel impls themselves use
+    (`_grid_steps`, `_lse_is_packed`, `_pad_to_tileable`), exposed so
+    benches and regression tests can assert grid-step counts and lse
+    HBM bytes without launching a kernel. All byte/step figures are per
+    (batch*head) grid row."""
+    sp_q = _pad_to_tileable(block_q, seq_q)
+    sp_k = _pad_to_tileable(block_k, seq_k)
+    bq = _pick_block(block_q, sp_q)
+    bk = _pick_block(block_k, sp_k)
+    bq_bwd = _pick_block(bwd_block_q or block_q, sp_q)
+    bk_bwd = _pick_block(bwd_block_k or block_k, sp_k)
+    steps, rect, compact = _grid_steps(causal, sp_q, sp_k, bq, bk)
+    # The backward kernels run their own grids with the (possibly
+    # narrower) bwd blocks — dq and dkv each walk this many steps.
+    bwd_steps, bwd_rect, bwd_compact = _grid_steps(
+        causal, sp_q, sp_k, bq_bwd, bk_bwd
+    )
+    packed = _lse_is_packed(sp_q, bq, bq_bwd)
+    lse_shape = _lse_layout_shape(1, sp_q, packed)[1:]
+    return {
+        "padded_seq_q": sp_q,
+        "padded_seq_k": sp_k,
+        "block_q": bq,
+        "block_k": bk,
+        "bwd_block_q": bq_bwd,
+        "bwd_block_k": bk_bwd,
+        "compact": compact,
+        "grid_steps": steps,
+        "rect_grid_steps": rect,
+        "bwd_compact": bwd_compact,
+        "bwd_grid_steps": bwd_steps,
+        "bwd_rect_grid_steps": bwd_rect,
+        "lse_packed": packed,
+        "lse_shape": lse_shape,
+        "lse_bytes": int(np.prod(lse_shape)) * 4,
+        "lse_replicated_bytes": sp_q * _LANES * 4,
+    }
+
+
+# -- kernels -----------------------------------------------------------------
+
+
+def _fwd_body(
+    i, j, first, last, run, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc,
+    *, scale: float, causal: bool, bq: int, bk: int,
+    kv_len: int | None, packed: bool,
+):
+    @pl.when(first)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc[:] = jnp.zeros_like(acc)
-
-    run = True
-    if causal:
-        # Skip blocks strictly above the diagonal.
-        run = j * bk <= i * bq + bq - 1
 
     @pl.when(run)
     def _compute():
@@ -84,6 +327,8 @@ def _fwd_kernel(
         )
         if causal:
             s = _causal_mask(s, i, j, bq, bk)
+        if kv_len is not None:
+            s = _kv_tail_mask(s, j, bk, kv_len)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # Rows with every key masked so far keep m=-inf; exp(-inf - -inf)
@@ -103,37 +348,75 @@ def _fwd_kernel(
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
-    @pl.when(j == nk - 1)
+    @pl.when(last)
     def _finalize():
         l = l_scr[:, :1]
-        m = m_scr[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        lse = jnp.where(m == _NEG_INF, _NEG_INF, m + jnp.log(safe_l))
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        lse_rep = jnp.where(
+            m_scr[:] == _NEG_INF,
+            _NEG_INF,
+            m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])),
+        )
+        lse_ref[0] = _pack_rows(lse_rep) if packed else lse_rep
 
 
-def _dq_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc, delta_scr,
-    *, scale: float, causal: bool, bq: int, bk: int,
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc, **kw
 ):
+    """Rectangular grid: (bh, nq, nk), k innermost; causal blocks above
+    the diagonal are predicated off (they still cost a grid step — the
+    compact kernel below is the one that doesn't pay them)."""
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    run = True
+    if kw["causal"]:
+        run = j * kw["bk"] <= i * kw["bq"] + kw["bq"] - 1
+    _fwd_body(
+        i, j, j == 0, j == nk - 1, run,
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc, **kw
+    )
 
-    @pl.when(j == 0)
+
+def _fwd_kernel_compact(
+    rows_ref, cols_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc, **kw
+):
+    """Compact causal grid: (bh, T) over lower-triangular block pairs;
+    the scalar-prefetched tables recover (i, j). Every enumerated block
+    runs — skipped blocks simply don't exist in the grid."""
+    t = pl.program_id(1)
+    i = rows_ref[t]
+    j = cols_ref[t]
+    _fwd_body(
+        i, j, j == 0, j == i, True,
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc, **kw
+    )
+
+
+def _delta_kernel(o_ref, do_ref, delta_ref, *, packed: bool):
+    """delta = rowsum(dO ∘ O), computed ONCE per backward and shared by
+    the dq and dkv kernels (each previously recomputed it per grid row,
+    re-streaming dO and O from HBM to do so)."""
+    delta = jnp.sum(
+        do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
+    )
+    rep = jnp.broadcast_to(delta, (delta.shape[0], _LANES))
+    delta_ref[0] = _pack_rows(rep) if packed else rep
+
+
+def _dq_body(
+    i, j, first, last, run, q_ref, k_ref, v_ref, do_ref, lse_ref,
+    delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, bq: int, bk: int,
+    kv_len: int | None, packed: bool,
+):
+    @pl.when(first)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
-        delta = jnp.sum(
-            do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
-            axis=-1,
-            keepdims=True,
-        )
-        delta_scr[:] = jnp.broadcast_to(delta, delta_scr.shape)
-
-    run = True
-    if causal:
-        run = j * bk <= i * bq + bq - 1
 
     @pl.when(run)
     def _compute():
@@ -144,7 +427,9 @@ def _dq_kernel(
         )
         if causal:
             s = _causal_mask(s, i, j, bq, bk)
-        lse = lse_ref[0][:, :1]
+        if kv_len is not None:
+            s = _kv_tail_mask(s, j, bk, kv_len)
+        lse = _read_rows(lse_ref[0], packed)
         p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - lse))
         do = do_ref[0].astype(jnp.float32)
         dp = lax.dot_general(
@@ -153,33 +438,56 @@ def _dq_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_scr[:, :1])
+        ds = p * (dp - _read_rows(delta_ref[0], packed))
         dq_acc[:] = dq_acc[:] + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(j == nk - 1)
+    @pl.when(last)
     def _finalize():
         dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
-    *, scale: float, causal: bool, bq: int, bk: int,
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, **kw
 ):
-    j = pl.program_id(1)  # k block (outer)
-    i = pl.program_id(2)  # q block (inner)
-    nq = pl.num_programs(2)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    run = True
+    if kw["causal"]:
+        run = j * kw["bk"] <= i * kw["bq"] + kw["bq"] - 1
+    _dq_body(
+        i, j, j == 0, j == nk - 1, run,
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+        **kw,
+    )
 
-    @pl.when(i == 0)
+
+def _dq_kernel_compact(
+    rows_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_acc, **kw
+):
+    t = pl.program_id(1)
+    i = rows_ref[t]
+    j = cols_ref[t]
+    _dq_body(
+        i, j, j == 0, j == i, True,
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+        **kw,
+    )
+
+
+def _dkv_body(
+    i, j, first, last, run, q_ref, k_ref, v_ref, do_ref, lse_ref,
+    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+    *, scale: float, causal: bool, bq: int, bk: int,
+    kv_len: int | None, packed: bool,
+):
+    @pl.when(first)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
-
-    run = True
-    if causal:
-        run = j * bk <= i * bq + bq - 1
 
     @pl.when(run)
     def _compute():
@@ -190,7 +498,9 @@ def _dkv_kernel(
         )
         if causal:
             s = _causal_mask(s, i, j, bq, bk)
-        lse = lse_ref[0][:, :1]
+        if kv_len is not None:
+            s = _kv_tail_mask(s, j, bk, kv_len)
+        lse = _read_rows(lse_ref[0], packed)
         p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - lse))
         do = do_ref[0].astype(jnp.float32)
         dv_acc[:] = dv_acc[:] + lax.dot_general(
@@ -202,15 +512,12 @@ def _dkv_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        delta = jnp.sum(
-            do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True
-        )
-        ds = p * (dp - delta)
+        ds = p * (dp - _read_rows(delta_ref[0], packed))
         dk_acc[:] = dk_acc[:] + lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(i == nq - 1)
+    @pl.when(last)
     def _finalize():
         # dK = Σ dSᵀ·(scale·q); q was loaded pre-scaled, so the accumulator
         # already carries the 1/sqrt(d) factor. dV is scale-free.
@@ -218,43 +525,61 @@ def _dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _pick_block(block: int, s: int) -> int:
-    """The requested block, clamped and — when it doesn't divide the
-    sequence — degraded to the largest aligned divisor of `s` instead of
-    erroring (a v5e sweep shows bigger blocks win, so prefer the largest
-    block that tiles the sequence exactly). Every returned block is a
-    multiple of the 8-row sublane so Mosaic can lower the (bq, ...)
-    VMEM tiles; lane-aligned (128) divisors are preferred."""
-    block = min(block, s)
-    if s % block == 0 and block % _SUBLANES == 0:
-        return block
-    for step in (_LANES, _SUBLANES):
-        for candidate in range(block - block % step, step - 1, -step):
-            if s % candidate == 0:
-                return candidate
-    raise ValueError(
-        f"flash attention: no {_SUBLANES}-aligned block <= {block} divides "
-        f"the sequence length ({s}); pad the sequence or use "
-        "dense_attention"
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, **kw
+):
+    j = pl.program_id(1)  # k block (outer)
+    i = pl.program_id(2)  # q block (inner)
+    nq = pl.num_programs(2)
+    run = True
+    if kw["causal"]:
+        run = j * kw["bk"] <= i * kw["bq"] + kw["bq"] - 1
+    _dkv_body(
+        i, j, i == 0, i == nq - 1, run,
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+        dk_acc, dv_acc, **kw,
     )
 
 
+def _dkv_kernel_compact(
+    rows_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc, **kw
+):
+    """Column-major compact traversal: for each k block j, q blocks
+    i = j..nq-1 are contiguous, so dk/dv accumulate across exactly the
+    blocks that exist below the diagonal."""
+    t = pl.program_id(1)
+    i = rows_ref[t]
+    j = cols_ref[t]
+    nq = kw.pop("nq")
+    _dkv_body(
+        i, j, i == j, i == nq - 1, True,
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+        dk_acc, dv_acc, **kw,
+    )
+
+
+# -- clamped index maps (rectangular fallback only) --------------------------
+
+
 def _clamp_j(i, j, bq: int, bk: int, causal: bool):
-    """K-block index for grid step (i, j). Under causality, blocks
-    strictly above the diagonal are compute-skipped (`pl.when(run)`), but
-    Pallas would still DMA their K/V tiles; clamping the index to the
-    diagonal makes every skipped step re-address the block the previous
-    step already holds, so Mosaic elides the copy — the skipped half of
-    the grid costs neither FLOPs nor HBM traffic (the long-context win)."""
+    """K-block index for rectangular grid step (i, j). Under causality,
+    blocks strictly above the diagonal are compute-skipped (`pl.when`),
+    but Pallas would still DMA their K/V tiles; clamping the index to
+    the diagonal makes every skipped step re-address the block the
+    previous step already holds, so Mosaic elides the copy. The compact
+    grid doesn't enumerate those steps at all — this clamp only matters
+    for the non-compacted fallback."""
     if not causal:
         return j
     return jnp.minimum(j, (i * bq + bq - 1) // bk)
 
 
 def _clamp_i(i, j, bq: int, bk: int, causal: bool):
-    """Q-block index for the dk/dv grid (i inner, ascending): steps below
-    the first unmasked q block are compute-skipped; clamping them onto
-    that first block elides their DMAs the same way."""
+    """Q-block index for the rectangular dk/dv grid (i inner, ascending):
+    steps below the first unmasked q block are compute-skipped; clamping
+    them onto that first block elides their DMAs the same way."""
     if not causal:
         return i
     return jnp.maximum(i, (j * bk) // bq)
@@ -269,94 +594,210 @@ def _qkv_specs(bq: int, bk: int, d: int, causal: bool):
     ]
 
 
+# -- pallas_call wrappers ----------------------------------------------------
+
+
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=(
+        "causal", "block_q", "block_k", "interpret", "kv_len", "packed"
+    ),
 )
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd_impl(
+    q, k, v, causal, block_q, block_k, interpret, kv_len=None, packed=False
+):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = _pick_block(block_q, sq)
     bk = _pick_block(block_k, sk)
     scale = 1.0 / math.sqrt(d)
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+    steps, _, compact = _grid_steps(causal, sq, sk, bq, bk)
+    nq = sq // bq
+    kernel_kw = dict(
+        scale=scale, causal=causal, bq=bq, bk=bk, kv_len=kv_len,
+        packed=packed,
     )
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=(bh, sq // bq, sk // bk),
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        jax.ShapeDtypeStruct(_lse_layout_shape(bh, sq, packed), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((bq, _LANES), jnp.float32),
+        pltpu.VMEM((bq, _LANES), jnp.float32),
+        pltpu.VMEM((bq, d), jnp.float32),
+    ]
+    cost = pl.CostEstimate(
+        flops=4 * bh * steps * bq * bk * d,
+        bytes_accessed=bh * (sq + 2 * sk) * d * q.dtype.itemsize,
+        transcendentals=bh * steps * bq * bk,
+    )
+    if compact:
+        rows, cols = _tri_tables(nq, "row")
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, steps),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, t, rs, cs: (b, rs[t], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, t, rs, cs: (b, cs[t], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, t, rs, cs: (b, cs[t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, t, rs, cs: (b, rs[t], 0)),
+                pl.BlockSpec(
+                    _lse_block(bq, packed),
+                    lambda b, t, rs, cs: (b, rs[t], 0),
+                ),
+            ],
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_compact, **kernel_kw),
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            cost_estimate=cost,
+            interpret=interpret,
+        )(rows, cols, q, k, v)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, **kernel_kw),
+        grid=(bh, nq, sk // bk),
         in_specs=_qkv_specs(bq, bk, d, causal),
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec(_lse_block(bq, packed), lambda b, i, j: (b, i, 0)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, _LANES), jnp.float32),
-            pltpu.VMEM((bq, _LANES), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
-        ],
-        cost_estimate=pl.CostEstimate(
-            flops=4 * bh * sq * sk * d // (2 if causal else 1),
-            bytes_accessed=bh * (sq + 2 * sk) * d * q.dtype.itemsize,
-            transcendentals=bh * sq * sk,
-        ),
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        cost_estimate=cost,
         interpret=interpret,
     )(q, k, v)
-    return o, lse
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit, static_argnames=("block_q", "interpret", "packed")
 )
-def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+def _flash_delta_impl(o, do, block_q, interpret, packed):
+    """The shared-delta precompute: one O(S·d) pass over (o, do)."""
+    bh, sq, d = o.shape
+    bq = _pick_block(block_q, sq)
+    spec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0))
+    return pl.pallas_call(
+        functools.partial(_delta_kernel, packed=packed),
+        grid=(bh, sq // bq),
+        in_specs=[spec, spec],
+        out_specs=pl.BlockSpec(
+            _lse_block(bq, packed), lambda b, i: (b, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            _lse_layout_shape(bh, sq, packed), jnp.float32
+        ),
+        interpret=interpret,
+    )(o, do)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "block_q", "block_k", "interpret", "kv_len", "packed"
+    ),
+)
+def _flash_bwd_kernels(
+    q, k, v, do, lse, delta, causal, block_q, block_k, interpret,
+    kv_len=None, packed=False,
+):
+    """dQ and dK/dV kernels over a precomputed (lse, delta) pair, both in
+    the kernel lse layout."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = _pick_block(block_q, sq)
     bk = _pick_block(block_k, sk)
     scale = 1.0 / math.sqrt(d)
+    steps, _, compact = _grid_steps(causal, sq, sk, bq, bk)
+    nq, nk = sq // bq, sk // bk
+    kw = dict(
+        scale=scale, causal=causal, bq=bq, bk=bk, kv_len=kv_len,
+        packed=packed,
+    )
 
-    def _common_specs(qidx, kidx):
-        # qidx/kidx map grid positions (x, y) → block indices, with the
-        # causal clamp folded in so compute-skipped steps re-address the
-        # previous step's block and their DMAs are elided (see _clamp_j).
+    def _row_specs(qidx, kidx):
+        # q/do/lse/delta ride the q-block index, k/v the k-block index.
         return [
-            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, qidx(x, y), 0)),
-            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, kidx(x, y), 0)),
-            pl.BlockSpec((1, bk, d), lambda b, x, y: (b, kidx(x, y), 0)),
-            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, qidx(x, y), 0)),
-            pl.BlockSpec((1, bq, d), lambda b, x, y: (b, qidx(x, y), 0)),
+            pl.BlockSpec((1, bq, d), lambda *a: (a[0], qidx(*a[1:]), 0)),
+            pl.BlockSpec((1, bk, d), lambda *a: (a[0], kidx(*a[1:]), 0)),
+            pl.BlockSpec((1, bk, d), lambda *a: (a[0], kidx(*a[1:]), 0)),
+            pl.BlockSpec((1, bq, d), lambda *a: (a[0], qidx(*a[1:]), 0)),
             pl.BlockSpec(
-                (1, bq, _LANES), lambda b, x, y: (b, qidx(x, y), 0)
+                _lse_block(bq, packed), lambda *a: (a[0], qidx(*a[1:]), 0)
+            ),
+            pl.BlockSpec(
+                _lse_block(bq, packed), lambda *a: (a[0], qidx(*a[1:]), 0)
             ),
         ]
 
+    if compact:
+        rows, cols = _tri_tables(nq, "row")
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_compact, **kw),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, steps),
+                in_specs=_row_specs(
+                    lambda t, rs, cs: rs[t], lambda t, rs, cs: cs[t]
+                ),
+                out_specs=pl.BlockSpec(
+                    (1, bq, d), lambda b, t, rs, cs: (b, rs[t], 0)
+                ),
+                scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            ),
+            out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            interpret=interpret,
+        )(rows, cols, q, k, v, do, lse, delta)
+        rows_c, cols_c = _tri_tables(nq, "col")
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel_compact, nq=nq, **kw),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, steps),
+                in_specs=_row_specs(
+                    lambda t, rs, cs: rs[t], lambda t, rs, cs: cs[t]
+                ),
+                out_specs=[
+                    pl.BlockSpec(
+                        (1, bk, d), lambda b, t, rs, cs: (b, cs[t], 0)
+                    ),
+                    pl.BlockSpec(
+                        (1, bk, d), lambda b, t, rs, cs: (b, cs[t], 0)
+                    ),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((bk, d), jnp.float32),
+                    pltpu.VMEM((bk, d), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            ],
+            interpret=interpret,
+        )(rows_c, cols_c, q, k, v, do, lse, delta)
+        return dq, dk, dv
+
     dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk
-        ),
-        grid=(bh, sq // bq, sk // bk),
-        in_specs=_common_specs(
+        functools.partial(_dq_kernel, **kw),
+        grid=(bh, nq, nk),
+        in_specs=_row_specs(
             lambda i, j: i,
             lambda i, j: _clamp_j(i, j, bq, bk, causal),
         ),
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, _LANES), jnp.float32),
-        ],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk
-        ),
-        grid=(bh, sk // bk, sq // bq),
-        in_specs=_common_specs(
+        functools.partial(_dkv_kernel, **kw),
+        grid=(bh, nk, nq),
+        in_specs=_row_specs(
             lambda j, i: _clamp_i(i, j, bq, bk, causal),
             lambda j, i: j,
         ),
@@ -373,40 +814,76 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bwd_impl(
+    q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+    kv_len=None, packed=False,
+):
+    delta = _flash_delta_impl(o, do, block_q, interpret, packed)
+    return _flash_bwd_kernels(
+        q, k, v, do, lse, delta, causal, block_q, block_k, interpret,
+        kv_len, packed,
+    )
+
+
+# -- custom VJP --------------------------------------------------------------
+
+
+def _residual_packed(sq: int, block_q: int, bwd_block_q: int) -> bool:
+    return _lse_is_packed(
+        sq, _pick_block(block_q, sq), _pick_block(bwd_block_q, sq)
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_bhsd(q, k, v, causal, block_q, block_k, bwd_block_q, bwd_block_k,
-                interpret):
-    o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return o
+                interpret, kv_len):
+    """Returns (o, lse). The lse output carries NO cotangent path (its
+    incoming gradient is discarded in the VJP) — it exists so callers
+    and `remat_policy="flash"` can hold the softmax statistics."""
+    packed = _residual_packed(q.shape[1], block_q, bwd_block_q)
+    o, lse = _flash_fwd_impl(
+        q, k, v, causal, block_q, block_k, interpret, kv_len, packed
+    )
+    if not packed:
+        lse = lse[:, :, :1]
+    o = checkpoint_name(o, CHECKPOINT_OUT_NAME)
+    lse = checkpoint_name(lse, CHECKPOINT_LSE_NAME)
+    return o, lse
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, bwd_block_q,
-                   bwd_block_k, interpret):
-    o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    # Residual slimming: the kernel writes lse BROADCAST across all 128
-    # lanes (Mosaic's f32 tile shape — a narrower kernel output is
-    # blocked, see the dead-end log), but the backward kernels read only
-    # lane 0. Saving all 128 identical copies as the VJP residual is
-    # 128x the bytes that carry information — at S=16k that's ~64 MB of
-    # activation memory per layer per (batch*head) group of 8. Keep one
-    # lane; the backward re-broadcasts before its pallas_calls. This is
-    # what made batch 2 fit at S=16k under the attention-saving remat
-    # policy (it previously overflowed HBM by 74 MB).
-    return o, (q, k, v, o, lse[:, :, :1])
+                   bwd_block_k, interpret, kv_len):
+    packed = _residual_packed(q.shape[1], block_q, bwd_block_q)
+    o, lse = _flash_fwd_impl(
+        q, k, v, causal, block_q, block_k, interpret, kv_len, packed
+    )
+    # Residual slimming: in the packed layout the lse residual is already
+    # exactly the information (1/128th the old lane-replicated buffer);
+    # the replicated fallback keeps one lane and re-broadcasts in bwd.
+    # checkpoint_name AFTER slimming, so remat_policy="flash" saves the
+    # slim form — these named values are both the primal outputs and the
+    # VJP residuals, which is what lets a checkpoint policy that saves
+    # them dead-code-eliminate the forward kernel from the backward.
+    if not packed:
+        lse = lse[:, :, :1]
+    o = checkpoint_name(o, CHECKPOINT_OUT_NAME)
+    lse = checkpoint_name(lse, CHECKPOINT_LSE_NAME)
+    return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k,
-                   interpret, residuals, do):
-    q, k, v, o, lse_slim = residuals
-    lse = jnp.broadcast_to(
-        lse_slim, lse_slim.shape[:2] + (_LANES,)
-    )
+                   interpret, kv_len, residuals, cts):
+    q, k, v, o, lse = residuals
+    do, _ = cts  # the lse output is statistics-only; its cotangent drops
+    packed = _residual_packed(q.shape[1], block_q, bwd_block_q)
+    lse_layout = _rows_to_layout(_lse_rows(lse, q.shape[1]), packed)
     return _flash_bwd_impl(
-        q, k, v, o, lse, do, causal, bwd_block_q, bwd_block_k, interpret
+        q, k, v, o, lse_layout, do, causal, bwd_block_q, bwd_block_k,
+        interpret, kv_len, packed,
     )
 
 
@@ -424,6 +901,7 @@ def flash_attention(
     bwd_block_q: int | None = None,
     bwd_block_k: int | None = None,
     interpret: bool | None = None,
+    return_lse: bool = False,
 ):
     """Blockwise attention on the MXU. q, k, v: [B, S, H, D] → [B, S, H, D].
 
@@ -431,6 +909,17 @@ def flash_attention(
     never materializing the [S, S] score matrix in HBM — at S=8192 the
     dense path OOMs a 16 GB v5e chip outright; this runs. ``interpret=None``
     autodetects: compiled on TPU, Pallas interpreter elsewhere (tests).
+
+    Sequence lengths that don't divide into 8-aligned blocks are padded
+    internally to the next lane multiple; the tail is masked in-kernel
+    and sliced off the output, so ragged lengths run this kernel instead
+    of falling back to the dense O(S²) path. Causal self-attention runs
+    the compact triangular grid (see module docstring): ~half the grid
+    steps of the rectangular schedule at large S.
+
+    ``return_lse=True`` additionally returns the log-sum-exp as
+    [B, H, S] (float32). The lse return is statistics-only: no gradient
+    flows through it.
 
     Default blocks come from a v5e sweep (B=4, H=16, D=128, causal,
     serialized timing): (1024, 1024) beats the small-block configs at
@@ -440,29 +929,52 @@ def flash_attention(
     lane-aligned divisor, so short sequences are unaffected.
     """
     b, sq, h, d = q.shape
+    sk = k.shape[1]
     interp = _auto_interpret(interpret)
+    sp_q = _pad_to_tileable(block_q, sq)
+    sp_k = _pad_to_tileable(block_k, sk)
+    kv_len = sk if sp_k != sk else None
+    if sp_q != sq or sp_k != sk:
+        pad = lambda x, s: jnp.pad(
+            x, ((0, 0), (0, s - x.shape[1]), (0, 0), (0, 0))
+        )
+        q, k, v = pad(q, sp_q), pad(k, sp_k), pad(v, sp_k)
     # [B, S, H, D] → [B*H, S, D]: head-major layout keeps each grid step's
     # blocks contiguous in HBM.
-    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    # The backward kernels carry bigger VMEM footprints (two extra f32
+    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * h, x.shape[1], d
+    )
+    # The backward kernels carry bigger VMEM footprints (extra f32
     # accumulators), so wide forward tiles can be paired with safer
     # backward tiles; default = same blocks both ways.
-    o = _flash_bhsd(
+    o, lse = _flash_bhsd(
         to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q, block_k,
-        bwd_block_q or block_q, bwd_block_k or block_k, interp
+        bwd_block_q or block_q, bwd_block_k or block_k, interp, kv_len,
     )
-    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    o = o.reshape(b, h, sp_q, d).transpose(0, 2, 1, 3)
+    if sp_q != sq:
+        o = o[:, :sq]
+    if not return_lse:
+        return o
+    lse_rows = _lse_rows(lse, sp_q).reshape(b, h, sp_q)[:, :, :sq]
+    return o, lse_rows
 
 
 def flash_usable(seq_q: int, seq_k: int, block_q: int = 1024,
                  block_k: int = 1024) -> bool:
-    """True when the shapes divide into flash blocks (else use dense)."""
-    try:
-        _pick_block(block_q, seq_q)
-        _pick_block(block_k, seq_k)
-    except ValueError:
-        return False
-    return True
+    """True when `flash_attention` can run these shapes — which, since
+    ragged lengths pad internally, is any positive pair. Kept as the
+    dispatch predicate (`models/transformer._attend`) so call sites
+    don't hard-code the padding contract."""
+    del block_q, block_k
+    return seq_q >= 1 and seq_k >= 1
+
+
+def flash_kernel_tileable(seq: int, block: int = 1024) -> bool:
+    """True when `seq` divides into 8-aligned flash blocks WITHOUT
+    padding. The ring path needs this (chunks must stay congruent across
+    hops, so it cannot pad); everything else should use `flash_usable`."""
+    return _tileable(block, seq)
 
 
 # -- ring flash: sequence-parallel flash attention --------------------------
@@ -475,8 +987,10 @@ def flash_usable(seq_q: int, seq_k: int, block_q: int = 1024,
 # with the standard log-sum-exp algebra; the backward re-walks the ring
 # passing the GLOBAL (o, lse) into the kernel's bwd (whose
 # p = exp(s - lse) and delta = rowsum(do*o) are then the global softmax
-# weights — see _dq_kernel), accumulating dk/dv in the rotating frame and
-# delivering them home with one final rotation.
+# weights), accumulating dk/dv in the rotating frame and delivering them
+# home with one final rotation. delta is the SAME for every hop (it
+# depends only on the global o/do), so the shared-delta precompute runs
+# once per backward, not once per hop.
 
 
 def _flat_heads(x):
@@ -489,22 +1003,32 @@ def _unflat_heads(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+def _ring_packed(chunk: int, bq: int) -> bool:
+    return _lse_is_packed(chunk, _pick_block(bq, chunk))
+
+
 def _hop_branches(qf, kf, vf, bq, bk, interpret):
     """(full, diagonal, skip) branch thunks for one ring hop — the hop
     kind is data-dependent (axis_index), the kernel's causal flag is
-    static, so lax.switch picks among three static traces."""
+    static, so lax.switch picks among three static traces. Each branch
+    returns (o, lse) with lse in per-row [BH, C, 1] form."""
     bh, c, d = qf.shape
+    packed = _ring_packed(c, bq)
 
     def full_blk():
-        return _flash_fwd_impl(qf, kf, vf, False, bq, bk, interpret)
+        o, lse = _flash_fwd_impl(qf, kf, vf, False, bq, bk, interpret,
+                                 None, packed)
+        return o, _lse_rows(lse, c)
 
     def diag_blk():
-        return _flash_fwd_impl(qf, kf, vf, True, bq, bk, interpret)
+        o, lse = _flash_fwd_impl(qf, kf, vf, True, bq, bk, interpret,
+                                 None, packed)
+        return o, _lse_rows(lse, c)
 
     def skip_blk():
         return (
             jnp.zeros((bh, c, d), qf.dtype),
-            jnp.full((bh, c, _LANES), _NEG_INF, jnp.float32),
+            jnp.full((bh, c, 1), _NEG_INF, jnp.float32),
         )
 
     return (full_blk, diag_blk, skip_blk)
@@ -525,15 +1049,17 @@ def _ring_rotate(x, axis: str, n: int):
 
 
 def _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret):
+    from kubeflow_tpu.parallel.collectives import axis_size
+
     b, c, h, d = q.shape
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     qf = _flat_heads(q)
     bh = b * h
 
     acc = jnp.zeros((bh, c, d), jnp.float32)
-    m = jnp.full((bh, c, _LANES), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bh, c, _LANES), jnp.float32)
+    m = jnp.full((bh, c, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, c, 1), jnp.float32)
     k_cur, v_cur = k, v
     for i in range(n):
         src = (my - i) % n
@@ -546,11 +1072,13 @@ def _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret):
             o_i, lse_i = branches[0]()
         # Log-sum-exp merge of the hop's normalized output into the
         # running global softmax (same algebra as the kernel's own
-        # online accumulation, one level up).
+        # online accumulation, one level up), in per-row [BH, C, 1]
+        # space — the lane-replicated merge buffers are gone with the
+        # packed lse layout.
         m_new = jnp.maximum(m, lse_i)
         corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
         w = jnp.where(lse_i == _NEG_INF, 0.0, jnp.exp(lse_i - m_new))
-        acc = acc * corr[:, :, :1] + w[:, :, :1] * o_i.astype(jnp.float32)
+        acc = acc * corr + w * o_i.astype(jnp.float32)
         l = l * corr + w
         m = m_new
         if i + 1 < n:
@@ -558,32 +1086,43 @@ def _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret):
             v_cur = _ring_rotate(v_cur, axis, n)
 
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    o = (acc / safe_l[:, :, :1]).astype(q.dtype)
-    lse_tot = m + jnp.log(safe_l)
+    o = (acc / safe_l).astype(q.dtype)
+    lse_tot = m + jnp.log(safe_l)  # [BH, C, 1] rows form
     return _unflat_heads(o, b, h), lse_tot
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _ring_flash_body(q, k, v, axis, causal, bq, bk, interpret):
-    o, _ = _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret)
+    o, lse = _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret)
+    o = checkpoint_name(o, CHECKPOINT_OUT_NAME)
+    del lse
     return o
-
 
 def _ring_flash_body_fwd(q, k, v, axis, causal, bq, bk, interpret):
     o, lse = _ring_flash_fwd_pass(q, k, v, axis, causal, bq, bk, interpret)
-    # Same residual slimming as _flash_vjp_fwd: the global lse is
-    # lane-broadcast 128 wide; save one lane, re-broadcast in bwd.
-    return o, (q, k, v, o, lse[:, :, :1])
+    # The global lse rides in per-row [BH, C, 1] form — already slim.
+    # Named so remat_policy="flash" can pin (o, lse) and skip re-walking
+    # the forward ring inside the backward.
+    o = checkpoint_name(o, CHECKPOINT_OUT_NAME)
+    lse = checkpoint_name(lse, CHECKPOINT_LSE_NAME)
+    return o, (q, k, v, o, lse)
 
 
 def _ring_flash_body_bwd(axis, causal, bq, bk, interpret, residuals, do):
-    q, k, v, o, lse_slim = residuals
-    lse = jnp.broadcast_to(lse_slim, lse_slim.shape[:2] + (_LANES,))
+    from kubeflow_tpu.parallel.collectives import axis_size
+
+    q, k, v, o, lse_rows = residuals
     b, c, h, d = q.shape
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     qf, of, dof = _flat_heads(q), _flat_heads(o), _flat_heads(do)
     bh = b * h
+    packed = _ring_packed(c, bq)
+    lse_layout = _rows_to_layout(lse_rows, packed)
+    # Shared delta across the whole ring: delta = rowsum(do ∘ o) depends
+    # only on the GLOBAL output and its cotangent, which every hop
+    # shares — one precompute pass feeds all n hops' dq/dkv kernels.
+    delta = _flash_delta_impl(of, dof, bq, interpret, packed)
 
     dq = jnp.zeros((bh, c, d), jnp.float32)
     # dk/dv accumulate in the ROTATING frame: each hop adds its
@@ -597,13 +1136,15 @@ def _ring_flash_body_bwd(axis, causal, bq, bk, interpret, residuals, do):
         kf, vf = _flat_heads(k_cur), _flat_heads(v_cur)
 
         def full_blk():
-            return _flash_bwd_impl(
-                qf, kf, vf, of, lse, dof, False, bq, bk, interpret
+            return _flash_bwd_kernels(
+                qf, kf, vf, dof, lse_layout, delta, False, bq, bk,
+                interpret, None, packed,
             )
 
         def diag_blk():
-            return _flash_bwd_impl(
-                qf, kf, vf, of, lse, dof, True, bq, bk, interpret
+            return _flash_bwd_kernels(
+                qf, kf, vf, dof, lse_layout, delta, True, bq, bk,
+                interpret, None, packed,
             )
 
         def skip_blk():
@@ -659,7 +1200,9 @@ def ring_flash_attention(
     composition that takes the single-chip S=16k flash ceiling to
     ring-size × 16k. Differentiable end-to-end (custom VJP re-walks the
     ring with global statistics). Falls back to single-device flash when
-    the ring is trivial."""
+    the ring is trivial. Ring chunks must tile WITHOUT padding
+    (`flash_kernel_tileable`): padded chunks would de-synchronize the
+    hop algebra."""
     if mesh.shape.get(sp_axis, 1) == 1:
         return flash_attention(
             q, k, v, causal=causal, block_q=block_q, block_k=block_k,
@@ -675,6 +1218,15 @@ def ring_flash_attention(
         raise ValueError(
             f"ring flash attention: sequence length {q.shape[1]} does "
             f"not divide the {sp_axis!r} ring size {ring}"
+        )
+    chunk = q.shape[1] // ring
+    if not flash_kernel_tileable(chunk, block_q) or not (
+        flash_kernel_tileable(chunk, block_k)
+    ):
+        raise ValueError(
+            f"ring flash attention: per-device chunk {chunk} does not "
+            "divide into 8-aligned flash blocks (the ring cannot pad); "
+            "use ring_attention or resize the sp axis"
         )
     spec = P(batch_axes(mesh), sp_axis, heads_axis, None)
     interp = _auto_interpret(interpret)

@@ -18,7 +18,18 @@ from jax import lax
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    """Static size of a named mesh axis, from inside traced code.
+
+    `lax.axis_size` comes and goes across jax versions (absent in the
+    pinned 0.4.x); `core.axis_frame(name)` resolves the same static int
+    from the axis environment, which is what the ring loops need — the
+    hop count must be a Python int so the ring unrolls at trace time.
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    from jax import core
+
+    return core.axis_frame(axis)
 
 
 def axis_index(axis: str) -> jax.Array:
@@ -57,7 +68,7 @@ def ppermute_ring(x: Any, axis: str, *, shift: int = 1) -> Any:
     neighbors "up" the ring; on TPU this lowers to nearest-neighbor ICI
     transfers when `axis` is an innermost mesh axis.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm=perm)
 

@@ -27,6 +27,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kubeflow_tpu.parallel import sharding as shlib
 
 
+def _ensure_partitionable_rng() -> None:
+    """Sharding-invariant initialization: the pinned jax defaults to the
+    non-partitionable threefry, whose draws depend on the physical
+    layout — the SAME PRNGKey then yields different params on a
+    tp-sharded mesh than on one device (the exact semantics drift
+    test_lm_tp_matches_single_device pins: "partitioning must not change
+    semantics"). The partitionable form derives every element's bits
+    from its logical index, so init_state is identical on any mesh.
+
+    Called from Trainer construction — not at import — so merely
+    importing this module never mutates process-global PRNG semantics;
+    only actually binding a sharded trainer opts the process in.
+    """
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)
+
+
 class TrainState(struct.PyTreeNode):
     """Step counter + params + optimizer + BN state, one donate-able pytree."""
 
@@ -71,6 +88,16 @@ class TrainConfig:
     # the MaxText default. The second moment stays f32 (it accumulates
     # squares; bf16 there costs real precision). "float32" opts out.
     adam_mu_dtype: str = "bfloat16"
+    # Whole-step rematerialization: wrap the loss forward in
+    # jax.checkpoint with the named policy ("full", "dots", "attn",
+    # "flash" — resolved by models.transformer.checkpoint_policy). This
+    # is the trainer-level knob for models WITHOUT their own per-block
+    # remat (or with remat_policy="none"): e.g. step_remat="flash" pins
+    # only each attention's output + lse across the whole step, so the
+    # backward recomputes the cheap dense layers but never re-runs a
+    # flash forward kernel. None (default) = no step-level checkpoint;
+    # per-block policies in the model compose underneath either way.
+    step_remat: str | None = None
 
     def __post_init__(self) -> None:
         # A typo ("Full", "all") would silently behave as "loss" and drop
@@ -82,6 +109,13 @@ class TrainConfig:
             )
         if self.optimizer not in ("sgd", "adamw"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.step_remat is not None and self.step_remat not in (
+            "full", "dots", "attn", "flash"
+        ):
+            raise ValueError(
+                f"step_remat must be None, 'full', 'dots', 'attn', or "
+                f"'flash', got {self.step_remat!r}"
+            )
         if self.adam_mu_dtype not in ("bfloat16", "float32"):
             raise ValueError(
                 f"adam_mu_dtype must be 'bfloat16' or 'float32', got "
@@ -155,6 +189,7 @@ class Trainer:
         label_key: str = "label",
         example_input_dtype: Any = jnp.float32,
     ):
+        _ensure_partitionable_rng()
         self.model = model
         self.config = config
         self.mesh = mesh
@@ -253,9 +288,22 @@ class Trainer:
                 if state.batch_stats:
                     variables["batch_stats"] = state.batch_stats
                     mutable.append("batch_stats")
-                logits, new_vars = state.apply_fn(
-                    variables, batch[input_key], train=True, mutable=mutable
-                )
+
+                def forward(variables):
+                    return state.apply_fn(
+                        variables, batch[input_key], train=True,
+                        mutable=mutable,
+                    )
+
+                if cfg.step_remat is not None:
+                    from kubeflow_tpu.models.transformer import (
+                        checkpoint_policy,
+                    )
+
+                    forward = jax.checkpoint(
+                        forward, policy=checkpoint_policy(cfg.step_remat)
+                    )
+                logits, new_vars = forward(variables)
                 loss = softmax_cross_entropy(
                     logits, batch[label_key], cfg.label_smoothing
                 )

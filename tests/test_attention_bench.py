@@ -1,0 +1,56 @@
+"""CI smoke for `bench.py --workload attention` (docs/perf.md): the
+kernel microbench must run end-to-end at tiny interpreted shapes and emit
+driver-parsable JSON metric lines, including the schedule accounting the
+attention overhaul is gated on (compact grid steps, packed lse bytes)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_attention_bench_smoke_emits_parsable_metrics():
+    result = subprocess.run(
+        [
+            sys.executable, "bench.py", "--workload", "attention",
+            "--attn-seq-lens", "128,256", "--steps", "1",
+            "--warmup-steps", "1", "--batch-size", "1",
+            "--head-dim", "32", "--attn-heads", "2",
+            "--flash-block-q", "128", "--flash-block-k", "128",
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=280,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    metrics = {}
+    for line in result.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        m = json.loads(line)
+        # The driver's parse contract — same shape as every other bench.
+        assert set(m) == {"metric", "value", "unit", "vs_baseline"}, m
+        assert isinstance(m["value"], (int, float)) and m["value"] > 0, m
+        metrics[m["metric"]] = m
+    for s in (128, 256):
+        for stem in (
+            "attention_flash_fwd_tflops",
+            "attention_flash_fwdbwd_tflops",
+            "attention_causal_grid_steps",
+            "attention_lse_hbm_bytes",
+        ):
+            assert f"{stem}_s{s}" in metrics, (stem, s, sorted(metrics))
+    # The schedule accounting must show the overhaul: at S=256 with
+    # 128-wide blocks the compact grid runs 3 of the rectangle's 4
+    # steps, and the packed lse is 1/128th the replicated bytes.
+    grid = metrics["attention_causal_grid_steps_s256"]
+    assert grid["value"] == 3 and grid["vs_baseline"] == 0.75, grid
+    lse = metrics["attention_lse_hbm_bytes_s256"]
+    assert abs(lse["vs_baseline"] - 1 / 128) < 1e-6, lse
+    # Dense ran at these lengths, so the TFLOP/s rows carry a real ratio.
+    assert metrics["attention_flash_fwd_tflops_s256"]["vs_baseline"] > 0

@@ -154,6 +154,43 @@ def test_no_deepcopy_in_dispatch_or_fanout_paths():
     )
 
 
+def test_flash_attention_hot_path_stays_blockwise():
+    """Lint-style perf gate (docs/perf.md, ISSUE 3): the flash kernel's
+    compiled path must never rematerialize attention's quadratic
+    intermediates in HBM. Two regressions this pins:
+
+    - a `jnp.einsum` creeping into ops/flash.py — the dense reference's
+      score-matrix formulation (einsum lives in ops/attention.py, the
+      O(S²) path flash exists to replace);
+    - an [S, S]-shaped kernel output (`out_shape` carrying both sequence
+      dims) — every legitimate output is O(S·d) or an O(S) lse/delta
+      tile, so `(bh, sq, sk)`-ish ShapeDtypeStructs mean someone started
+      writing scores back to HBM.
+    """
+    import inspect
+    import re
+
+    from kubeflow_tpu.ops import flash
+
+    src = inspect.getsource(flash)
+    assert "einsum" not in src, (
+        "jnp.einsum reappeared in ops/flash.py — the score matrix must "
+        "stay blockwise on-chip (dense formulations live in "
+        "ops/attention.py)"
+    )
+    score_shaped = re.findall(
+        r"ShapeDtypeStruct\(\s*\(\s*bh\s*,\s*s[qk]\s*,\s*s[qk]\b", src
+    )
+    assert not score_shaped, (
+        f"[S, S]-shaped HBM output reappeared in ops/flash.py: "
+        f"{score_shaped} — kernel outputs must be O(S·d) tiles or "
+        "O(S) lse/delta tiles (see docs/perf.md)"
+    )
+    # The lane-packed lse layout is the hot-path layout; its helper
+    # disappearing means the 128x-replicated buffer came back silently.
+    assert "_lse_is_packed" in src and "_pack_rows" in src
+
+
 def test_gcb_template():
     result = subprocess.run(
         [sys.executable, "tools/gcb/template.py", "--commit", "abc123"],

@@ -208,6 +208,91 @@ def test_remat_policies_agree():
             assert jnp.allclose(a, b, atol=1e-3), (name, a - b)
 
 
+def test_flash_remat_policy_skips_forward_rerun():
+    """remat_policy="flash" (ISSUE 3): same numerics as no-remat with the
+    real flash kernel engaged, AND the backward jaxpr must not contain a
+    second forward-kernel trace — the policy pins the kernel's named
+    (out, lse) residuals, so partial eval dead-codes the flash forward
+    from the backward. "full" re-runs it; that contrast is the test."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, head_dim=16,
+        d_ff=64, dtype=jnp.float32, attention_impl="flash",
+        remat_policy="none",
+    )
+    tokens = jnp.arange(2 * 32, dtype=jnp.int32).reshape(2, 32) % 64
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def grads_and_fwd_traces(policy):
+        m = TransformerLM(dataclasses.replace(cfg, remat_policy=policy))
+
+        def loss(p):
+            return m.apply(p, tokens).astype(jnp.float32).sum()
+
+        jaxpr = str(jax.make_jaxpr(jax.grad(loss))(params))
+        return (
+            (float(loss(params)), jax.grad(loss)(params)),
+            jaxpr.count("_fwd_kernel"),
+        )
+
+    (ref_loss, ref_grads), fwd_none = grads_and_fwd_traces("none")
+    (flash_loss, flash_grads), fwd_flash = grads_and_fwd_traces("flash")
+    (_, _), fwd_full = grads_and_fwd_traces("full")
+
+    assert abs(ref_loss - flash_loss) < 1e-4
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_grads),
+        jax.tree_util.tree_leaves(flash_grads),
+    ):
+        assert jnp.allclose(a, b, atol=1e-3), float(jnp.abs(a - b).max())
+    # "full" re-traces the forward kernel inside the backward; "flash"
+    # must not (it matches the no-remat trace count).
+    assert fwd_flash == fwd_none, (fwd_flash, fwd_none)
+    assert fwd_full > fwd_flash, (fwd_full, fwd_flash)
+
+
+def test_trainer_step_remat_flash_matches_baseline():
+    """TrainConfig.step_remat="flash": whole-step jax.checkpoint with the
+    flash policy — the trainer-level knob for models without per-block
+    remat — must not change the training math."""
+    cfg = dataclasses.replace(
+        TINY, attention_impl="flash", remat=False, dtype=jnp.float32
+    )
+    mesh = build_mesh(MeshSpec(), jax.devices()[:1])
+
+    def one_step(step_remat):
+        tcfg = TrainConfig(
+            batch_size=4, learning_rate=1e-2, total_steps=10,
+            optimizer="adamw", label_smoothing=0.0, fsdp_params=False,
+            train_metrics="loss", step_remat=step_remat,
+        )
+        trainer = Trainer(
+            TransformerLM(cfg), tcfg, mesh,
+            example_input_shape=(2, 16), example_input_dtype=jnp.int32,
+            input_key="tokens", label_key="labels",
+        )
+        state = trainer.init_state(jax.random.PRNGKey(0))
+        data = SyntheticTokens(
+            mesh, batch_size=4, seq_len=16, vocab_size=cfg.vocab_size
+        )
+        state, metrics = trainer.make_train_step()(state, next(iter(data)))
+        return float(metrics["loss"]), state.params
+
+    loss_plain, params_plain = one_step(None)
+    loss_remat, params_remat = one_step("flash")
+    assert abs(loss_plain - loss_remat) < 1e-5
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_plain),
+        jax.tree_util.tree_leaves(params_remat),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
+
+    with pytest.raises(ValueError, match="step_remat"):
+        TrainConfig(step_remat="bogus")
+
+
 def test_unknown_remat_policy_rejected():
     cfg = TransformerConfig(
         vocab_size=64, d_model=32, n_layers=1, n_heads=2, head_dim=16,
