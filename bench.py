@@ -63,7 +63,7 @@ def main() -> None:
         "--workload",
         choices=(
             "all", "resnet", "lm", "serving", "study", "chaos",
-            "controlplane", "attention",
+            "controlplane", "attention", "pipeline",
         ),
         default="all",
         help="all (default) = resnet then lm, so the driver artifact "
@@ -78,7 +78,11 @@ def main() -> None:
         "the HTTP facade against both store backends; attention = "
         "per-seq-len flash kernel TFLOP/s (fwd and fwd+bwd) vs the dense "
         "reference, plus grid-step and lse-HBM-byte accounting from the "
-        "static schedule",
+        "static schedule; pipeline = interleaved-vs-GPipe pipeline "
+        "schedule on the CPU dryrun mesh: tokens/sec per schedule, "
+        "measured ticks (read from the traced program) vs the "
+        "M + S/v - 1 model, and the scalar-only cross-pp collective "
+        "contract from the compiled HLO",
     )
     parser.add_argument(
         "--chaos-seed",
@@ -203,6 +207,8 @@ def main() -> None:
         return bench_lm(args)
     if args.workload == "attention":
         return bench_attention(args)
+    if args.workload == "pipeline":
+        return bench_pipeline(args)
     if args.workload == "serving":
         return bench_serving(args)
     if args.workload == "study":
@@ -1281,6 +1287,238 @@ def bench_attention(args) -> None:
             f"{sched['lse_bytes'] * bh}B (packed={sched['lse_packed']})",
             file=sys.stderr,
         )
+
+
+def bench_pipeline(args) -> None:
+    """Pipeline-schedule bench: interleaved (circular) vs GPipe on the
+    CPU dryrun mesh (8 virtual devices, pp=2 x dp=2 for throughput plus
+    a pp-only pair for the wire audit).
+
+    Three families of numbers, all from the program that actually ran:
+
+    - `pipeline_lm_tokens_per_sec_v{1,2}`: end-to-end trainer throughput
+      of the pipelined LM under each schedule (CPU wall-clock — a
+      schedule-shape comparison, not a chip headline; v2's vs_baseline
+      is its speedup over v1, measured in-run).
+    - `pipeline_stage_ticks_v{1,2}`: the schedule's tick count READ OUT
+      OF THE TRACED PROGRAM (the pipeline `lax.scan`'s trip count via
+      `testing.hlo.scan_lengths`), normalized to GPipe-equivalent stage
+      ticks (loop ticks / v), vs the published `M + S/v - 1` model
+      roofline from BASELINE.json — the run fails if measured exceeds
+      the model.
+    - `pipeline_fullact_allreduces`: all-reduces of full-batch-activation
+      size or larger in the compiled fwd+bwd HLO, vs the published
+      baseline of 1 (the seed's terminal `lax.psum` of the whole output
+      buffer). Scalar-only cross-pp traffic means 0.
+
+    Shapes are fixed (M=8 microbatches, pp=2, 4 layers) so the published
+    tick baselines always apply.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8".strip()
+        )
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise SystemExit(
+            "pipeline bench needs >= 4 devices (pp=2 x dp=2); a backend "
+            "with fewer was already initialized — run standalone so the "
+            "virtual-CPU flag lands before jax starts"
+        )
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.transformer import (
+        PipelinedTransformerLM,
+        TransformerConfig,
+    )
+    from kubeflow_tpu.parallel import (
+        MeshSpec,
+        build_mesh,
+        bubble_fraction,
+        pipeline_schedule,
+    )
+    from kubeflow_tpu.testing.hlo import (
+        allreduce_element_counts,
+        collective_counts,
+        compiled_hlo,
+        scan_lengths,
+    )
+    from kubeflow_tpu.train import SyntheticTokens, TrainConfig, Trainer
+
+    pp, dp, n_mb, seq = 2, 2, 8, 128
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=4, n_heads=4, head_dim=16,
+        d_ff=128, dtype=jnp.float32, remat=False, attention_impl="dense",
+    )
+    mesh = build_mesh(MeshSpec(pp=pp, dp=dp), devices[:pp * dp])
+    # One microbatch = 2 examples per batch shard.
+    batch = 2 * n_mb * dp
+    audit_mesh = build_mesh(MeshSpec(pp=pp), devices[:pp])
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (2 * n_mb, seq), 0, cfg.vocab_size
+    )
+    labels = jax.random.randint(
+        jax.random.PRNGKey(1), (2 * n_mb, seq), 0, cfg.vocab_size
+    )
+    full_act = tokens.shape[0] * seq * cfg.d_model
+
+    tokens_per_sec = {}
+    for v in (1, 2):
+        n_stages = v * pp
+        sched = pipeline_schedule(n_stages, n_mb, v)
+
+        # -- throughput through the Trainer (loss_in_model hot path) ---
+        model = PipelinedTransformerLM(
+            cfg, n_stages=n_stages, num_microbatches=n_mb, mesh=mesh,
+            interleave=v,
+        )
+        trainer = Trainer(
+            model,
+            TrainConfig(
+                batch_size=batch, learning_rate=1e-3, warmup_steps=2,
+                total_steps=10_000, optimizer="adamw",
+                label_smoothing=0.0, train_metrics="loss",
+                loss_in_model=True,
+            ),
+            mesh,
+            # The init dummy must itself divide into the M microbatches.
+            example_input_shape=(batch, seq),
+            example_input_dtype=jnp.int32,
+            input_key="tokens",
+            label_key="labels",
+        )
+        data = SyntheticTokens(
+            mesh, batch_size=batch, seq_len=seq, vocab_size=cfg.vocab_size
+        )
+        state = trainer.init_state(jax.random.PRNGKey(2))
+        elapsed, final_loss = timed_run(
+            trainer.make_train_step(), state, iter(data),
+            args.warmup_steps, args.steps,
+        )
+        tokens_per_sec[v] = batch * seq * args.steps / elapsed
+
+        # -- measured ticks, read from the traced program --------------
+        audit_model = PipelinedTransformerLM(
+            cfg, n_stages=n_stages, num_microbatches=n_mb,
+            mesh=audit_mesh, interleave=v,
+        )
+        params = nn.meta.unbox(
+            jax.jit(audit_model.init)(jax.random.PRNGKey(3), tokens)
+        )["params"]
+
+        def loss_grad(p):
+            return jax.value_and_grad(
+                lambda q: audit_model.apply(
+                    {"params": q}, tokens, labels=labels
+                )
+            )(p)
+
+        # The pipeline loop is the longest scan in the program (M*v+pp-1
+        # ticks; the runner-up is the M-long per-microbatch loss map), so
+        # the MEASURED tick count is max(scan lengths) — read from the
+        # traced program, not from the schedule formula. A schedule
+        # regression that adds ticks grows this number and trips the
+        # model gate below.
+        lengths = scan_lengths(loss_grad, params)
+        measured_loop = max(lengths, default=0)
+        if measured_loop < n_mb:
+            raise SystemExit(
+                f"pipeline v={v}: no pipeline-loop-sized scan in the "
+                f"traced program (scan lengths {sorted(lengths)}) — the "
+                f"schedule did not run as a scanned loop"
+            )
+        measured_ticks = measured_loop / v
+        model_ticks = _published_baseline(
+            f"pipeline_model_stage_ticks_v{v}"
+        ) or sched["model_stage_ticks"]
+        if measured_ticks > model_ticks:
+            raise SystemExit(
+                f"pipeline v={v}: measured {measured_ticks} stage ticks "
+                f"(longest scan {measured_loop} / v) exceeds the "
+                f"M + S/v - 1 model ({model_ticks})"
+            )
+
+        # -- wire audit: scalar-only cross-pp contract -----------------
+        hlo = compiled_hlo(jax.jit(loss_grad), params)
+        counts = collective_counts(hlo)
+        big = [
+            s for s in allreduce_element_counts(hlo) if s >= full_act
+        ]
+
+        for metric, value, unit, vs in (
+            (
+                f"pipeline_lm_tokens_per_sec_v{v}",
+                round(tokens_per_sec[v], 1),
+                f"tokens/sec ({pp * dp} virtual CPU devices, pp={pp} x "
+                f"dp={dp}, M={n_mb}; schedule-shape comparison, not a "
+                "chip headline)",
+                round(tokens_per_sec[v] / tokens_per_sec[1], 4)
+                if v > 1
+                else None,
+            ),
+            (
+                f"pipeline_stage_ticks_v{v}",
+                measured_ticks,
+                f"GPipe-equivalent stage ticks (longest traced scan "
+                f"{measured_loop} / v={v}, from the jaxpr; model "
+                f"M + S/v - 1 = {sched['model_stage_ticks']:g}, bubble "
+                f"{bubble_fraction(n_stages, n_mb, v):.3f})",
+                round(measured_ticks / model_ticks, 4),
+            ),
+            (
+                f"pipeline_fullact_allreduces_v{v}",
+                len(big),
+                f"cross-pp all-reduces >= full-batch activation size "
+                f"({full_act} elements) in fwd+bwd HLO "
+                f"(collective-permute={counts['collective-permute']}, "
+                f"all-reduce={counts['all-reduce']})",
+                round(
+                    len(big)
+                    / (
+                        _published_baseline(
+                            "pipeline_fullact_allreduce_per_step"
+                        )
+                        or 1.0
+                    ),
+                    4,
+                ),
+            ),
+        ):
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": value,
+                        "unit": unit,
+                        "vs_baseline": vs,
+                    }
+                )
+            )
+        print(
+            f"# pipeline v={v}: n_stages={n_stages} M={n_mb} "
+            f"loop_ticks={sched['loop_ticks']} stage_ticks="
+            f"{measured_ticks:g} (model {sched['model_stage_ticks']:g}) "
+            f"bubble={bubble_fraction(n_stages, n_mb, v):.3f} "
+            f"tokens/s={tokens_per_sec[v]:.0f} loss={final_loss:.3f} "
+            f"big-allreduces={len(big)}",
+            file=sys.stderr,
+        )
+        if big:
+            raise SystemExit(
+                f"pipeline v={v}: {len(big)} activation-sized "
+                f"all-reduce(s) in the compiled step ({big[:4]}... "
+                f"elements vs full activation {full_act}) — the "
+                f"scalar-only cross-pp contract regressed"
+            )
 
 
 def bench_lm(args) -> None:

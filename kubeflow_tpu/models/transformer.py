@@ -177,6 +177,35 @@ def _dense(features, names, name=None, dtype=jnp.bfloat16):
     )
 
 
+def lm_head(x, embed, *, dtype):
+    """Tied output head: bf16 operands, f32 accumulation, stated
+    explicitly rather than via an f32×f32 einsum. XLA's
+    allow_excess_precision can demote the latter to the same MXU path
+    (measured neutral on v5e with that flag set), but the flag is
+    environment-dependent — don't leave ~11% of the model's FLOPs
+    relying on it. ONE definition shared by the flat model, the
+    pipelined logits path, and the pipelined last-stage loss — the
+    three must stay numerically identical (the grad-parity tests pin
+    it), so the contract lives in exactly one place."""
+    return jnp.einsum(
+        "bsd,vd->bsv",
+        x.astype(dtype),
+        embed.astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def rms_norm(x, scale, *, dtype, eps: float = 1e-6):
+    """Module-free RMSNorm — the math `RMSNorm` wraps, shared with the
+    pipelined loss path (which applies the final norm from a raw param
+    value inside `spmd_pipeline`'s per-microbatch objective)."""
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps
+    )
+    return (norm * scale).astype(dtype)
+
+
 class RMSNorm(nn.Module):
     dtype: Any = jnp.bfloat16
     eps: float = 1e-6
@@ -189,11 +218,7 @@ class RMSNorm(nn.Module):
             (x.shape[-1],),
             jnp.float32,
         )
-        x32 = x.astype(jnp.float32)
-        norm = x32 * jax.lax.rsqrt(
-            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
-        )
-        return (norm * scale).astype(self.dtype)
+        return rms_norm(x, scale, dtype=self.dtype, eps=self.eps)
 
 
 def rope(x, positions, theta: float):
@@ -437,31 +462,71 @@ class Block(nn.Module):
         return x
 
 
+class _PipelineStage(nn.Module):
+    """`layers_per_stage` sequential Blocks = one pipeline stage.
+
+    Shared by both pipelined execution paths: the logits path stacks it
+    with `nn.vmap` (partition axis "stage"), the loss path initializes
+    the same stacked tree functionally and applies one slice per
+    `spmd_pipeline` tick — so the two paths can never drift apart in
+    weight structure."""
+
+    config: TransformerConfig
+    layers_per_stage: int
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        block_cls = _block_cls(self.config)
+        for i in range(self.layers_per_stage):
+            x = block_cls(self.config, self.mesh, name=f"layer_{i}")(
+                x, positions
+            )
+        return x
+
+
 class PipelinedTransformerLM(nn.Module):
     """TransformerLM with layers split into `n_stages` pipeline stages
-    over the `pp` mesh axis (GPipe schedule, `num_microbatches` deep).
+    over the `pp` mesh axis, `num_microbatches` deep.
 
-    The schedule is expressed with stacked-stage params (`nn.vmap` with a
-    "stage" partition axis → the `pp` sharding rule) and a roll of the
-    stage-stacked activation buffer each tick — on a pp-sharded mesh XLA
-    lowers the roll to collective-permutes between neighbor stages, the
-    same wire pattern `parallel.pipeline.spmd_pipeline` spells manually.
+    Two execution paths share one weight tree:
+
+    - **Logits path** (`labels=None`): the GPipe schedule expressed with
+      stacked-stage params (`nn.vmap` with a "stage" partition axis →
+      the `pp` sharding rule) and a roll of the stage-stacked activation
+      buffer each tick — on a pp-sharded mesh XLA lowers the roll to
+      collective-permutes between neighbor stages. Returns `[B, S, V]`
+      logits (which necessarily replicates the last stage's outputs
+      across pp — fine for eval, NOT the training hot path).
+    - **Loss path** (`labels=[B, S]` given): the training hot path, run
+      as a compiled SPMD program through
+      `parallel.pipeline.spmd_pipeline` — supports the interleaved
+      (circular) schedule (`interleave=v`, `n_stages = v * pp`) and
+      computes each microbatch's cross-entropy on the LAST stage, where
+      the logits live, so the only cross-pp collective in fwd+bwd is the
+      scalar loss psum (gradients ride the ppermute transposes). Returns
+      the scalar mean loss. Wire this up via
+      `TrainConfig.loss_in_model=True`.
+
     The reference has no pipeline parallelism anywhere (SURVEY.md §2.2).
 
     Weights match `TransformerLM` block-for-block: the stacked params
     live at `params/stages/blocks/layer_<i>` with a leading stage axis,
     and `params/stages/blocks/layer_i[s]` equals the flat model's
     `params/layer_{s * layers_per_stage + i}` (the equivalence test
-    restacks one into the other). MoE stages are not supported (the
-    aux-loss channel would accumulate bubble garbage)."""
+    restacks one into the other; the interleaved slice-to-rank
+    assignment is internal to `spmd_pipeline`, so stacked index `s` is
+    pipeline stage `s` under every schedule). MoE stages are not
+    supported (the aux-loss channel would accumulate bubble garbage)."""
 
     config: TransformerConfig
     n_stages: int
     num_microbatches: int
     mesh: Mesh | None = None
+    interleave: int = 1
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, labels=None):
         cfg = self.config
         if cfg.num_experts > 0:
             raise ValueError("pipelined transformer does not support MoE")
@@ -475,14 +540,11 @@ class PipelinedTransformerLM(nn.Module):
                 f"batch ({tokens.shape[0]}) must divide into "
                 f"{self.num_microbatches} microbatches"
             )
-        if self.mesh is not None:
-            pp = dict(self.mesh.shape).get("pp")
-            if pp is None or self.n_stages % pp:
-                raise ValueError(
-                    f"mesh needs a 'pp' axis whose size divides n_stages="
-                    f"{self.n_stages}; mesh axes: {dict(self.mesh.shape)}"
-                )
-        layers_per_stage = cfg.n_layers // self.n_stages
+        if self.interleave < 1 or self.n_stages % self.interleave:
+            raise ValueError(
+                f"interleave ({self.interleave}) must be >= 1 and divide "
+                f"n_stages ({self.n_stages})"
+            )
 
         embed = self.param(
             "embedding",
@@ -492,7 +554,31 @@ class PipelinedTransformerLM(nn.Module):
             (cfg.vocab_size, cfg.d_model),
             jnp.float32,
         )
+        if labels is not None:
+            # The loss path hands RAW TOKENS to the pipeline and embeds
+            # at injection (spmd_pipeline's inject_fn): an int batch has
+            # no cotangent, so no [B, S, d_model]-sized gradient ever
+            # all-reduces across pp at the shard_map boundary — the
+            # embedding's own gradient rides the replicated-weight psum.
+            return self._pipeline_loss(tokens, labels, embed)
         x = embed.astype(cfg.dtype)[tokens]
+        if self.interleave != 1 and not self.is_initializing():
+            # Weights are schedule-independent, so init may run through
+            # this (GPipe) path regardless; actually COMPUTING logits
+            # under the circular schedule is not supported.
+            raise ValueError(
+                "the logits path runs the plain GPipe schedule; the "
+                "interleaved (circular) schedule is a training-schedule "
+                "feature — call with labels= for the last-stage loss path"
+            )
+        if self.mesh is not None:
+            pp = dict(self.mesh.shape).get("pp")
+            if pp is None or self.n_stages % pp:
+                raise ValueError(
+                    f"mesh needs a 'pp' axis whose size divides n_stages="
+                    f"{self.n_stages}; mesh axes: {dict(self.mesh.shape)}"
+                )
+        layers_per_stage = cfg.n_layers // self.n_stages
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
         )
@@ -514,18 +600,6 @@ class PipelinedTransformerLM(nn.Module):
                 ),
             )
 
-        class Stage(nn.Module):
-            """`layers_per_stage` sequential Blocks = one pipeline stage."""
-
-            @nn.compact
-            def __call__(self, x, positions):
-                block_cls = _block_cls(cfg)
-                for i in range(layers_per_stage):
-                    x = block_cls(cfg, outer_mesh, name=f"layer_{i}")(
-                        x, positions
-                    )
-                return x
-
         class Tick(nn.Module):
             """One pipeline tick: inject, apply all stages in parallel
             (vmap over the stacked stage axis), emit, rotate."""
@@ -535,14 +609,14 @@ class PipelinedTransformerLM(nn.Module):
                 states, outputs = carry
                 t, inject = xs
                 stages = nn.vmap(
-                    Stage,
+                    _PipelineStage,
                     in_axes=(0, None),
                     out_axes=0,
                     variable_axes={"params": 0},
                     split_rngs={"params": True},
                     axis_size=n_stages,
                     metadata_params={nn.meta.PARTITION_NAME: "stage"},
-                )(name="blocks")
+                )(cfg, layers_per_stage, outer_mesh, name="blocks")
                 states = states.at[0].set(
                     jnp.where(t < n_mb, inject, states[0])
                 )
@@ -581,16 +655,103 @@ class PipelinedTransformerLM(nn.Module):
         del final_states
         x = outputs.reshape(x.shape)
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
-        # Same head contract as TransformerLM: bf16 operands, f32
-        # accumulation — the pipelined and flat models must stay
-        # numerically identical block-for-block AND head-for-head.
-        logits = jnp.einsum(
-            "bsd,vd->bsv",
-            x.astype(cfg.dtype),
-            embed.astype(cfg.dtype),
-            preferred_element_type=jnp.float32,
+        # The pipelined and flat models must stay numerically identical
+        # block-for-block AND head-for-head.
+        return lm_head(x, embed, dtype=cfg.dtype)
+
+    def _pipeline_loss(self, tokens, labels, embed):
+        """The training hot path: `spmd_pipeline` over the pp ring with
+        the per-microbatch cross-entropy computed on the last stage.
+
+        Declares the SAME parameter tree the logits path's module
+        machinery creates (`stages/blocks/layer_i` stacked on a leading
+        "stage" axis, `ln_final/scale`), so one checkpoint serves both
+        paths; flax validates the shapes against these declarations on
+        every retrieval."""
+        from kubeflow_tpu.parallel.pipeline import spmd_pipeline
+        from kubeflow_tpu.train.trainer import softmax_cross_entropy
+
+        cfg = self.config
+        layers_per_stage = cfg.n_layers // self.n_stages
+        template = _PipelineStage(cfg, layers_per_stage, mesh=None)
+        seq = tokens.shape[1]
+
+        def init_stages(rng):
+            dummy = jnp.zeros((1, seq, cfg.d_model), cfg.dtype)
+            dpos = jnp.zeros((1, seq), jnp.int32)
+            stacked = jax.vmap(
+                lambda r: template.init(r, dummy, dpos)["params"]
+            )(jax.random.split(rng, self.n_stages))
+            # Tag the new leading axis exactly as nn.vmap's
+            # metadata_params would, so init through EITHER path yields
+            # identical logical annotations (→ identical shardings).
+            return {
+                "blocks": jax.tree_util.tree_map(
+                    lambda b: b.add_axis(
+                        0, {nn.meta.PARTITION_NAME: "stage"}
+                    )
+                    if isinstance(b, nn.meta.AxisMetadata)
+                    else b,
+                    stacked,
+                    is_leaf=lambda b: isinstance(b, nn.meta.AxisMetadata),
+                )
+            }
+
+        stages = self.param("stages", init_stages)["blocks"]
+        ln_scale = self.param(
+            "ln_final",
+            lambda rng: {
+                "scale": nn.with_logical_partitioning(
+                    nn.initializers.ones, ("norm",)
+                )(rng, (cfg.d_model,), jnp.float32)
+            },
+        )["scale"]
+
+        def stage_fn(p, x_mb):
+            positions = jnp.broadcast_to(
+                jnp.arange(x_mb.shape[1], dtype=jnp.int32), x_mb.shape[:2]
+            )
+            return template.apply({"params": p}, x_mb, positions)
+
+        def inject_fn(tokens_mb, lp):
+            return lp["embed"].astype(cfg.dtype)[tokens_mb]
+
+        def ce_fn(out_mb, labels_mb, lp):
+            # Same head contract as the flat model: final RMSNorm, then
+            # the shared tied-embedding head.
+            h = rms_norm(out_mb, lp["ln_scale"], dtype=cfg.dtype)
+            logits = lm_head(h, lp["embed"], dtype=cfg.dtype)
+            return softmax_cross_entropy(logits, labels_mb)
+
+        loss_params = {"embed": embed, "ln_scale": ln_scale}
+        if self.mesh is None:
+            # No mesh to pipeline over: the sequential reference (stacked
+            # index s IS pipeline stage s), same objective.
+            x = inject_fn(tokens, loss_params)
+            for s in range(self.n_stages):
+                x = stage_fn(
+                    jax.tree_util.tree_map(lambda p: p[s], stages), x
+                )
+            return ce_fn(x, labels, loss_params)
+        pp = dict(self.mesh.shape).get("pp")
+        if pp is None or self.n_stages != self.interleave * pp:
+            raise ValueError(
+                f"the pipeline loss path needs n_stages "
+                f"({self.n_stages}) == interleave ({self.interleave}) x "
+                f"pp; mesh axes: {dict(self.mesh.shape)}"
+            )
+        return spmd_pipeline(
+            stage_fn,
+            stages,
+            tokens,
+            mesh=self.mesh,
+            num_microbatches=self.num_microbatches,
+            interleave=self.interleave,
+            loss_fn=ce_fn,
+            targets=labels,
+            loss_params=loss_params,
+            inject_fn=inject_fn,
         )
-        return logits
 
 
 class TransformerLM(nn.Module):
@@ -618,16 +779,4 @@ class TransformerLM(nn.Module):
         for i in range(cfg.n_layers):
             x = block_cls(cfg, self.mesh, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
-        # Tied output head: bf16 operands, f32 accumulation, stated
-        # explicitly rather than via an f32×f32 einsum. XLA's
-        # allow_excess_precision can demote the latter to the same MXU
-        # path (measured neutral on v5e with that flag set), but the
-        # flag is environment-dependent — don't leave ~11% of the
-        # model's FLOPs relying on it.
-        logits = jnp.einsum(
-            "bsd,vd->bsv",
-            x.astype(cfg.dtype),
-            embed.astype(cfg.dtype),
-            preferred_element_type=jnp.float32,
-        )
-        return logits
+        return lm_head(x, embed, dtype=cfg.dtype)

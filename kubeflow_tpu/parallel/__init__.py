@@ -16,6 +16,7 @@ from kubeflow_tpu.parallel.mesh import (
 )
 from kubeflow_tpu.parallel.sharding import (
     LogicalRules,
+    batch_shard_count,
     batch_sharding,
     default_rules,
     logical_sharding,
@@ -27,4 +28,8 @@ from kubeflow_tpu.parallel.distributed import (
     ProcessEnv,
     initialize_from_env,
 )
-from kubeflow_tpu.parallel.pipeline import bubble_fraction, spmd_pipeline
+from kubeflow_tpu.parallel.pipeline import (
+    bubble_fraction,
+    pipeline_schedule,
+    spmd_pipeline,
+)

@@ -82,6 +82,17 @@ def batch_axes(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in BATCH_AXES if a in mesh.axis_names)
 
 
+def batch_shard_count(mesh: Mesh) -> int:
+    """How many shards the leading (example) axis of a global batch is
+    split into on `mesh` — the product of the batch-axis sizes. The
+    divisibility contract every batch consumer validates against
+    (pipeline microbatching, trainer init shapes, data synthesis)."""
+    n = 1
+    for a in batch_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Shard dim 0 over the batch axes, replicate the rest."""
     return NamedSharding(mesh, P(batch_axes(mesh), *([None] * (ndim - 1))))

@@ -98,6 +98,28 @@ class TrainConfig:
     # flash forward kernel. None (default) = no step-level checkpoint;
     # per-block policies in the model compose underneath either way.
     step_remat: str | None = None
+    # Per-microbatch gradient accumulation: split each batch into
+    # `accum_steps` microbatches and run them through a `lax.scan` whose
+    # per-tick forward is wrapped in `jax.checkpoint`, differentiating
+    # through the scan — the backward walks the microbatches in reverse,
+    # recomputing each tick's forward, so activation memory is bounded
+    # by ONE microbatch in flight instead of the whole batch. Composes
+    # with `step_remat` and the model's per-block `remat_policy` (those
+    # govern what the per-tick recompute itself saves — e.g. "flash"
+    # still pins attention outputs + lse within a tick). Works on any
+    # mesh, pp or not; grads and loss equal the full-batch step's (mean
+    # of equal-sized microbatch means). 1 = off.
+    accum_steps: int = 1
+    # The model computes its own objective: the train/eval steps call
+    # `apply(variables, batch[input], train=..., labels=batch[label])`
+    # and take the returned SCALAR as the loss instead of computing
+    # cross-entropy on returned logits. This is how the pipelined
+    # transformer's last-stage loss path is driven (the logits never
+    # leave the last pp stage — only the loss scalar crosses the pp
+    # axis). Requires train_metrics="loss" (no logits → no accuracy)
+    # and label_smoothing=0.0 (the model's objective, not the
+    # trainer's, defines any smoothing).
+    loss_in_model: bool = False
 
     def __post_init__(self) -> None:
         # A typo ("Full", "all") would silently behave as "loss" and drop
@@ -121,6 +143,27 @@ class TrainConfig:
                 f"adam_mu_dtype must be 'bfloat16' or 'float32', got "
                 f"{self.adam_mu_dtype!r}"
             )
+        if self.accum_steps < 1:
+            raise ValueError(
+                f"accum_steps must be >= 1, got {self.accum_steps}"
+            )
+        if self.batch_size % self.accum_steps:
+            raise ValueError(
+                f"batch_size ({self.batch_size}) must divide into "
+                f"{self.accum_steps} accumulation microbatches"
+            )
+        if self.loss_in_model:
+            if self.train_metrics != "loss":
+                raise ValueError(
+                    "loss_in_model=True returns no logits; accuracy is "
+                    "unavailable — set train_metrics='loss'"
+                )
+            if self.label_smoothing:
+                raise ValueError(
+                    "loss_in_model=True delegates the objective to the "
+                    "model; TrainConfig.label_smoothing would be "
+                    "silently ignored — set it to 0.0"
+                )
 
 
 def decay_mask(params) -> Any:
@@ -201,9 +244,7 @@ class Trainer:
         self.tx = make_optimizer(config)
         # The init dummy batch must divide evenly over the mesh batch axes
         # (model code may shard_map over them, e.g. ring attention).
-        dp_total = 1
-        for a in shlib.batch_axes(mesh):
-            dp_total *= mesh.shape[a]
+        dp_total = shlib.batch_shard_count(mesh)
         lead = example_input_shape[0]
         if lead % dp_total:
             lead = dp_total * max(1, -(-lead // dp_total))
@@ -274,26 +315,49 @@ class Trainer:
     def make_train_step(self):
         cfg = self.config
         input_key = self.input_key
-
         label_key = self.label_key
+        mesh = self.mesh
+        batch_parts = tuple(shlib.batch_axes(mesh))
+        # Accuracy needs logits; the loss-in-model path never sees them.
+        has_acc = cfg.train_metrics == "full" and not cfg.loss_in_model
 
         def train_step(state: TrainState, batch):
-            def loss_fn(params):
+            def forward_loss(params, mb, stats_in):
+                """(loss, (batch_stats, accuracy)) for one (micro)batch.
+
+                Metrics that survive accumulation are SCALARS computed
+                in here (accuracy is an argmax reduced to a mean, never
+                the logits themselves), so the per-tick backward frees
+                each microbatch's logits before the next tick runs.
+                `stats_in` is the batch_stats this tick reads — under
+                accumulation each microbatch consumes the previous
+                tick's updated stats (sequential BN semantics), not the
+                step's starting stats."""
                 variables = {"params": params}
                 # "losses" is the dedicated channel for scalar auxiliary
                 # losses (MoE load balancing etc.) — kept separate from
                 # flax's general-purpose "intermediates" so diagnostics
                 # never leak into the objective.
                 mutable = ["losses"]
-                if state.batch_stats:
-                    variables["batch_stats"] = state.batch_stats
+                if stats_in:
+                    variables["batch_stats"] = stats_in
                     mutable.append("batch_stats")
 
-                def forward(variables):
-                    return state.apply_fn(
-                        variables, batch[input_key], train=True,
-                        mutable=mutable,
-                    )
+                if cfg.loss_in_model:
+                    # The model owns the objective (e.g. the pipelined
+                    # transformer's last-stage per-microbatch CE): apply
+                    # returns the scalar loss directly.
+                    def forward(variables):
+                        return state.apply_fn(
+                            variables, mb[input_key], train=True,
+                            labels=mb[label_key], mutable=mutable,
+                        )
+                else:
+                    def forward(variables):
+                        return state.apply_fn(
+                            variables, mb[input_key], train=True,
+                            mutable=mutable,
+                        )
 
                 if cfg.step_remat is not None:
                     from kubeflow_tpu.models.transformer import (
@@ -303,33 +367,90 @@ class Trainer:
                     forward = jax.checkpoint(
                         forward, policy=checkpoint_policy(cfg.step_remat)
                     )
-                logits, new_vars = forward(variables)
-                loss = softmax_cross_entropy(
-                    logits, batch[label_key], cfg.label_smoothing
-                )
+                out, new_vars = forward(variables)
+                if cfg.loss_in_model:
+                    loss = out
+                    acc = jnp.zeros(())
+                else:
+                    loss = softmax_cross_entropy(
+                        out, mb[label_key], cfg.label_smoothing
+                    )
+                    acc = (
+                        jnp.mean(
+                            (jnp.argmax(out, -1) == mb[label_key])
+                            .astype(jnp.float32)
+                        )
+                        if has_acc
+                        else jnp.zeros(())
+                    )
                 for aux in jax.tree_util.tree_leaves(
                     new_vars.get("losses", {})
                 ):
                     loss = loss + aux
-                # "loss" mode drops the logits from the aux output: kept
-                # alive only for accuracy, they'd otherwise pin a
-                # [B, S, vocab] f32 buffer through the whole backward.
-                aux_logits = logits if cfg.train_metrics == "full" else None
-                return loss, (new_vars, aux_logits)
-
-            (loss, (new_vars, logits)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(state.params)
-            state = state.apply_gradients(
-                grads=grads,
-                batch_stats=new_vars.get("batch_stats", state.batch_stats),
-            )
-            metrics = {"loss": loss}
-            if logits is not None:
-                metrics["accuracy"] = jnp.mean(
-                    (jnp.argmax(logits, -1) == batch[label_key])
-                    .astype(jnp.float32)
+                return loss, (
+                    new_vars.get("batch_stats", stats_in), acc
                 )
+
+            accum = cfg.accum_steps
+            if accum == 1:
+                (loss, (bstats, acc)), grads = jax.value_and_grad(
+                    forward_loss, has_aux=True
+                )(state.params, batch, state.batch_stats)
+            else:
+                lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
+                if lead % accum:
+                    raise ValueError(
+                        f"batch ({lead}) must divide into "
+                        f"{accum} accumulation microbatches"
+                    )
+                microbatches = jax.tree_util.tree_map(
+                    lambda a: a.reshape(
+                        (accum, a.shape[0] // accum) + a.shape[1:]
+                    ),
+                    batch,
+                )
+                # Each microbatch keeps the batch sharding on its (now
+                # second) example axis; the scan axis is unsharded.
+                microbatches = jax.lax.with_sharding_constraint(
+                    microbatches,
+                    NamedSharding(mesh, P(None, batch_parts)),
+                )
+                # Per-tick checkpoint: differentiating through the scan
+                # re-runs ONE microbatch's forward per backward tick —
+                # activation memory is bounded by microbatches in
+                # flight, not the whole batch. step_remat / the model's
+                # remat_policy still govern what that per-tick
+                # recompute itself saves.
+                tick = jax.checkpoint(forward_loss)
+
+                def accum_loss(params):
+                    def body(carry, mb):
+                        lsum, asum, bs = carry
+                        # Thread batch_stats tick to tick: each
+                        # microbatch's BN update builds on the previous
+                        # one's, so the step's final stats reflect
+                        # EVERY microbatch (sequential-small-batch
+                        # semantics), not just the last.
+                        loss, (bs, acc) = tick(params, mb, bs)
+                        return (lsum + loss, asum + acc, bs), None
+
+                    carry0 = (jnp.zeros(()), jnp.zeros(()),
+                              state.batch_stats)
+                    (lsum, asum, bstats), _ = jax.lax.scan(
+                        body, carry0, microbatches
+                    )
+                    # Mean over equal-sized microbatches == the
+                    # full-batch mean, so grads match accum_steps=1.
+                    return lsum / accum, (bstats, asum / accum)
+
+                (loss, (bstats, acc)), grads = jax.value_and_grad(
+                    accum_loss, has_aux=True
+                )(state.params)
+
+            state = state.apply_gradients(grads=grads, batch_stats=bstats)
+            metrics = {"loss": loss}
+            if has_acc:
+                metrics["accuracy"] = acc
             return state, metrics
 
         return jax.jit(
@@ -339,12 +460,23 @@ class Trainer:
         )
 
     def make_eval_step(self):
+        cfg = self.config
         input_key, label_key = self.input_key, self.label_key
 
         def eval_step(state: TrainState, batch):
             variables = {"params": state.params}
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
+            if cfg.loss_in_model:
+                # The model computes its own objective; no logits ever
+                # reach the host side of the step, so loss is the only
+                # eval metric on this path.
+                return {
+                    "loss": state.apply_fn(
+                        variables, batch[input_key], train=False,
+                        labels=batch[label_key],
+                    )
+                }
             logits = state.apply_fn(variables, batch[input_key], train=False)
             return {
                 "loss": softmax_cross_entropy(logits, batch[label_key]),

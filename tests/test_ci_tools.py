@@ -191,6 +191,39 @@ def test_flash_attention_hot_path_stays_blockwise():
     assert "_lse_is_packed" in src and "_pack_rows" in src
 
 
+def test_pipeline_hot_path_psums_scalars_only():
+    """Lint-style perf gate (docs/perf.md, ISSUE 4): the pipeline layer
+    must never all-reduce a non-scalar buffer across pp. The seed design
+    ended every step with `lax.psum(outputs, pp)` — an all-reduce of the
+    entire [M, mb, ...] activation buffer for data only the last stage
+    produced. The overhaul's contract: the ONLY `lax.psum` in
+    parallel/pipeline.py is the scalar loss reduction (activations move
+    by ppermute; the eval path broadcasts by ring rotation), and the
+    transformer's pipelined path adds no psum of its own."""
+    import inspect
+    import re
+
+    from kubeflow_tpu.models import transformer
+    from kubeflow_tpu.parallel import pipeline
+
+    src = inspect.getsource(pipeline)
+    assert "lax.psum(outputs" not in src, (
+        "the terminal activation-buffer all-reduce came back to "
+        "parallel/pipeline.py — the loss path must psum scalars only "
+        "(see docs/perf.md)"
+    )
+    psums = re.findall(r"lax\.psum\(\s*([A-Za-z_][A-Za-z0-9_]*)", src)
+    assert psums == ["local_loss"], (
+        f"unexpected lax.psum call(s) in parallel/pipeline.py: {psums} — "
+        "the pipeline hot path's only cross-pp all-reduce is the scalar "
+        "loss"
+    )
+    assert "lax.psum(" not in inspect.getsource(transformer), (
+        "a psum appeared in models/transformer.py — the pipelined paths "
+        "must leave cross-pp reduction to spmd_pipeline's scalar loss"
+    )
+
+
 def test_gcb_template():
     result = subprocess.run(
         [sys.executable, "tools/gcb/template.py", "--commit", "abc123"],

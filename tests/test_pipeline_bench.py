@@ -1,0 +1,52 @@
+"""CI smoke for `bench.py --workload pipeline` (docs/perf.md): the bench
+must run end-to-end on the CPU dryrun mesh, report measured stage ticks
+within the `M + S/v - 1` model for both schedules, keep the scalar-only
+cross-pp contract (zero activation-sized all-reduces), and emit
+driver-parsable JSON with non-null vs_baseline for the schedule metrics
+(the BASELINE.json pipeline baselines)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_pipeline_bench_smoke_ticks_and_wire_contract():
+    result = subprocess.run(
+        [
+            sys.executable, "bench.py", "--workload", "pipeline",
+            "--steps", "1", "--warmup-steps", "1",
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    metrics = {}
+    for line in result.stdout.splitlines():
+        if line.startswith("{"):
+            m = json.loads(line)
+            # The driver's parse contract — same shape as every bench.
+            assert set(m) == {"metric", "value", "unit", "vs_baseline"}, m
+            metrics[m["metric"]] = m
+    for v in (1, 2):
+        ticks = metrics[f"pipeline_stage_ticks_v{v}"]
+        # Measured (from the traced program) within the model roofline,
+        # and vs_baseline non-null because BASELINE.json records the
+        # model baselines.
+        assert ticks["vs_baseline"] is not None
+        assert ticks["vs_baseline"] <= 1.0, ticks
+        wires = metrics[f"pipeline_fullact_allreduces_v{v}"]
+        assert wires["value"] == 0, wires
+        assert wires["vs_baseline"] == 0.0, wires
+        assert metrics[f"pipeline_lm_tokens_per_sec_v{v}"]["value"] > 0
+    # Interleave strictly beats GPipe's tick count at this shape.
+    assert (
+        metrics["pipeline_stage_ticks_v2"]["value"]
+        < metrics["pipeline_stage_ticks_v1"]["value"]
+    )
