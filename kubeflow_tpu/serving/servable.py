@@ -111,14 +111,16 @@ class Servable:
         variables = jax.eval_shape(
             lambda: module.init(jax.random.PRNGKey(0), example_input)
         )
-        ckpt = Checkpointer(ckpt_dir)
+        # read_only: serving must never rename a training run's steps
+        # (e.g. a committed save whose manifest is still in flight).
+        ckpt = Checkpointer(ckpt_dir, read_only=True)
         try:
             restored = ckpt.restore_latest(variables)
         finally:
             ckpt.close()
         if restored is None:
             raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-        variables, step = restored
+        variables, step = restored.state, restored.step
         return cls.from_module(
             name, module, variables,
             version=max(step, 1), max_batch=max_batch,

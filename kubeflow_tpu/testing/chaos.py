@@ -216,6 +216,287 @@ class FaultSchedule:
             return len(self._pending)
 
 
+# ---------------------------------------------------------------------------
+# Training fault plans (docs/resilience.md)
+#
+# The control-plane proxy above injects faults on the wire; training
+# faults are injected against a real `fit()` run instead — the process,
+# its checkpoints, and its data. Same discipline: a finite SEEDED plan,
+# consumed by a driver that runs subprocess incarnations, with coverage
+# accounting so a soak that quietly exercised nothing fails its gate.
+# ---------------------------------------------------------------------------
+
+TRAIN_FAULT_CLASSES = (
+    # process faults — one crash boundary each
+    "kill",                  # SIGKILL between steps: no warning, no save
+    "sigterm",               # SIGTERM mid-step: fit must exit Preempted
+                             # at the boundary after an emergency save
+    # storage faults — applied between incarnations, against the newest
+    # checkpoint (each exercises a distinct verification path)
+    "truncate_checkpoint",   # torn write: a committed file loses its tail
+    "corrupt_checkpoint",    # bit rot: same size, flipped bytes
+    "corrupt_manifest",      # the verifier's own record is garbage
+    # data faults — identical in the baseline run (part of the data)
+    "loss_spike",            # a poison batch the AnomalyGuard must skip
+)
+
+_PROCESS_CLASSES = ("kill", "sigterm")
+_STORAGE_CLASSES = ("truncate_checkpoint", "corrupt_checkpoint", "corrupt_manifest")
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainFault:
+    """One planned training fault. `at_step` is the 0-based batch
+    position it binds to (process/data faults; 0 for storage faults);
+    `after_crash` is the 0-based crash-boundary index a storage fault is
+    applied at, and `offset` which checkpoint it targets (0 = newest,
+    1 = second-newest, ...) — faults stacked on one boundary get
+    distinct offsets so each one's verification path is actually
+    exercised by the newest-first fallback walk, not masked by a
+    sibling fault on the same step."""
+
+    cls: str
+    at_step: int = 0
+    after_crash: int = 0
+    offset: int = 0
+
+
+class TrainFaultSchedule:
+    """A finite, seeded fault plan for a kill-and-resume soak.
+
+    Pure function of (seed, total_steps, save_interval,
+    faults_per_class): two schedules from the same arguments have
+    identical plans — the reproducibility contract the soak pins, same
+    as `FaultSchedule`. The plan always covers EVERY class:
+
+    - `faults_per_class` kills and sigterms, placed at ascending step
+      positions spaced >= 3*save_interval + 2 apart (and at least that
+      far in), so every incarnation both finds >= 3 prior checkpoints
+      (max_to_keep's worth) to fall back through and makes save
+      progress before dying;
+    - `faults_per_class` of each storage class, distributed round-robin
+      over the crash boundaries with per-boundary distinct `offset`s
+      (newest, second-newest, ...), so stacked faults damage DIFFERENT
+      steps and the fallback walk meets every one;
+    - `faults_per_class` loss spikes at positions the guard's EWMA has
+      warmed up for, disjoint from the crash steps.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        total_steps: int,
+        *,
+        save_interval: int,
+        faults_per_class: int = 1,
+        guard_warmup: int = 3,
+    ):
+        self.seed = seed
+        self.total_steps = total_steps
+        self.save_interval = save_interval
+        rng = random.Random(seed)
+
+        k = faults_per_class
+        spacing = 3 * save_interval + 2
+        first = spacing
+        last = total_steps - 2
+        n_crashes = 2 * k
+        if first + (n_crashes - 1) * spacing > last:
+            raise ValueError(
+                f"total_steps={total_steps} too small for {n_crashes} "
+                f"crashes spaced {spacing} (save_interval={save_interval})"
+            )
+        # Ascending crash positions with guaranteed spacing: distribute
+        # the slack between the minimum-spacing slots.
+        slack = last - (first + (n_crashes - 1) * spacing)
+        offsets = sorted(rng.randint(0, slack) for _ in range(n_crashes))
+        steps = [first + i * spacing + offsets[i] for i in range(n_crashes)]
+        kinds = [_PROCESS_CLASSES[i % 2] for i in range(n_crashes)]
+        rng.shuffle(kinds)
+        self.crash_faults: tuple[TrainFault, ...] = tuple(
+            TrainFault(cls, at_step=s) for cls, s in zip(kinds, steps)
+        )
+
+        storage = [cls for cls in _STORAGE_CLASSES for _ in range(k)]
+        rng.shuffle(storage)
+        per_boundary: dict[int, int] = {}
+        storage_faults = []
+        for i, cls in enumerate(storage):
+            boundary = i % n_crashes
+            offset = per_boundary.get(boundary, 0)
+            per_boundary[boundary] = offset + 1
+            storage_faults.append(
+                TrainFault(cls, after_crash=boundary, offset=offset)
+            )
+        self.storage_faults: tuple[TrainFault, ...] = tuple(storage_faults)
+
+        crash_steps = {f.at_step for f in self.crash_faults}
+        candidates = [
+            s for s in range(max(guard_warmup + 2, 3), total_steps - 1)
+            if s not in crash_steps
+        ]
+        spikes = sorted(rng.sample(candidates, k))
+        self.spike_faults: tuple[TrainFault, ...] = tuple(
+            TrainFault("loss_spike", at_step=s) for s in spikes
+        )
+
+        self.plan: tuple[TrainFault, ...] = (
+            self.crash_faults + self.storage_faults + self.spike_faults
+        )
+        self._injected: dict[str, int] = {c: 0 for c in TRAIN_FAULT_CLASSES}
+        self._lock = threading.Lock()
+
+    @property
+    def spike_steps(self) -> tuple[int, ...]:
+        return tuple(f.at_step for f in self.spike_faults)
+
+    def storage_after(self, crash_idx: int) -> tuple[TrainFault, ...]:
+        """Storage faults the driver applies after crash boundary
+        `crash_idx` (the newest checkpoint is the target)."""
+        return tuple(
+            f for f in self.storage_faults if f.after_crash == crash_idx
+        )
+
+    def mark_injected(self, fault: TrainFault) -> None:
+        """The fault's effect verifiably happened (the driver observed
+        the kill/exit code, mutated a real file, or counted the guard
+        skip)."""
+        with self._lock:
+            self._injected[fault.cls] += 1
+
+    def coverage(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._injected)
+
+    def __repr__(self) -> str:
+        return (
+            f"TrainFaultSchedule(seed={self.seed}, "
+            f"planned={len(self.plan)}, coverage={self.coverage()})"
+        )
+
+
+def apply_checkpoint_fault(ckpt_dir, cls: str, offset: int = 0) -> str | None:
+    """Mutate the checkpoint `offset` steps back from the newest under
+    `ckpt_dir` (0 = newest) per the storage fault class. Returns a
+    description of what was damaged, or None when there was nothing to
+    damage at that offset (the driver must treat that as a scheduling
+    bug — storage faults are planned after >= max_to_keep saves)."""
+    from pathlib import Path
+
+    from kubeflow_tpu.train.checkpoint import MANIFEST_NAME
+
+    root = Path(ckpt_dir)
+    steps = sorted(
+        (int(p.name), p) for p in root.iterdir()
+        if p.is_dir() and p.name.isdigit()
+    )
+    if len(steps) <= offset:
+        return None
+    step, step_dir = steps[-1 - offset]
+    if cls == "corrupt_manifest":
+        target = step_dir / MANIFEST_NAME
+        # Unparsable JSON: the verifier must treat it as corruption, not
+        # crash on it.
+        target.write_bytes(b'{"files": {broken')
+        return f"corrupt_manifest step={step}"
+    files = sorted(
+        (p for p in step_dir.rglob("*")
+         if p.is_file() and p.name != MANIFEST_NAME),
+        key=lambda p: p.stat().st_size,
+    )
+    if not files:
+        return None
+    target = files[-1]  # the largest payload file: real tensor bytes
+    data = target.read_bytes()
+    if cls == "truncate_checkpoint":
+        target.write_bytes(data[: max(1, len(data) // 2)])
+        return f"truncate_checkpoint step={step} file={target.name}"
+    if cls == "corrupt_checkpoint":
+        mid = len(data) // 2
+        flipped = bytes(b ^ 0xFF for b in data[mid:mid + 16])
+        target.write_bytes(data[:mid] + flipped + data[mid + 16:])
+        return f"corrupt_checkpoint step={step} file={target.name}"
+    raise ValueError(f"unknown storage fault class {cls!r}")
+
+
+class ResumableWrapper:
+    """Base for fault-injecting wrappers over a resumable data iterable:
+    forwards the whole resumable-data protocol (docs/resilience.md) so a
+    wrapped stream checkpoints/restores/perturbs exactly like the bare
+    one, and exposes `position` — the upcoming batch's 0-based index —
+    in either state dialect (the synthetic streams count "position",
+    RecordDataset counts "batches_delivered")."""
+
+    def __init__(self, data):
+        self._data = data
+
+    @property
+    def position(self) -> int:
+        state = self._data.state_dict()
+        if "position" in state:
+            return int(state["position"])
+        return int(state["batches_delivered"])
+
+    def state_dict(self) -> dict:
+        return self._data.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self._data.load_state_dict(state)
+
+    def __getattr__(self, name):
+        # `perturb` is OPTIONAL in the protocol: expose it only when
+        # the wrapped data actually has one, so capability probes
+        # (e.g. fit()'s rollback precondition, which must refuse
+        # non-perturbable data rather than run futile identical
+        # retries) see the truth through the wrapper.
+        if name == "perturb" and "_data" in self.__dict__:
+            return getattr(self._data, "perturb")
+        raise AttributeError(name)
+
+    def __iter__(self):
+        it = iter(self._data)
+        while True:
+            pos = self.position
+            try:
+                batch = next(it)
+            except StopIteration:
+                # PEP 479: a StopIteration escaping a generator body
+                # becomes RuntimeError — end cleanly instead, so finite
+                # wrapped streams (e.g. bounded-epoch RecordDatasets)
+                # still signal exhaustion to the training loop.
+                return
+            yield self.transform(pos, batch)
+
+    def transform(self, pos: int, batch):
+        """Override: the (possibly faulted) batch for position `pos`."""
+        return batch
+
+
+class SpikedData(ResumableWrapper):
+    """Deterministic loss-spike injector over a resumable data iterable.
+
+    At each position in `positions`, the yielded batch's float fields
+    are scaled by `scale` — a poison batch whose loss/grad-norm the
+    AnomalyGuard must reject. The spike is a pure function of the
+    position, so a resumed (or baseline) run sees the identical poison
+    at the identical step — the spikes are part of the data, which is
+    what lets the soak assert exact final-state parity against an
+    uninterrupted run."""
+
+    def __init__(self, data, positions, scale: float = 1e4):
+        super().__init__(data)
+        self.positions = frozenset(int(p) for p in positions)
+        self.scale = scale
+
+    def transform(self, pos: int, batch):
+        if pos not in self.positions:
+            return batch
+        return {
+            k: v * self.scale if v.dtype.kind == "f" else v
+            for k, v in batch.items()
+        }
+
+
 def _abort(sock: socket.socket) -> None:
     """Hard-close: RST instead of FIN (SO_LINGER 0), so the peer sees a
     connection *failure*, not a clean end-of-stream."""

@@ -1,0 +1,28 @@
+"""Tiny test-support models for the resilience suites.
+
+`TinyMLP` is deliberately normalization-free: BatchNorm/LayerNorm models
+normalize a scaled poison batch away before it reaches the loss, so
+fault-injection suites (the guard unit tests and the kill-and-resume
+soak) would never see their scheduled loss spikes. This invariant is
+load-bearing — keep this model free of any normalization layer.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TinyMLP(nn.Module):
+    """No normalization anywhere: input scale reaches the loss and the
+    gradients at full magnitude, so a scheduled loss_spike fault
+    actually spikes."""
+
+    num_classes: int = 10
+    hidden: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_classes)(x).astype(jnp.float32)

@@ -8,8 +8,14 @@ studies, and benchmarks all drive one code path.
 
 from kubeflow_tpu.train.trainer import Trainer, TrainConfig, TrainState
 from kubeflow_tpu.train.data import SyntheticImages, SyntheticTokens
-from kubeflow_tpu.train.checkpoint import Checkpointer
-from kubeflow_tpu.train.loop import FitResult, TrainingDiverged, fit
+from kubeflow_tpu.train.checkpoint import Checkpointer, Restored
+from kubeflow_tpu.train.guard import AnomalyGuard, GuardConfig
+from kubeflow_tpu.train.loop import (
+    FitResult,
+    Preempted,
+    TrainingDiverged,
+    fit,
+)
 from kubeflow_tpu.train.profiling import (
     MetricsLogger,
     Profiler,

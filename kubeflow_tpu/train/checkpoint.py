@@ -1,25 +1,161 @@
-"""Checkpoint / auto-resume.
+"""Checkpoint / auto-resume with crash-consistent, verified saves.
 
 The reference had no checkpoint story at all — training state was "the
 job's problem" and platform-level resume meant idempotent re-apply
 (SURVEY.md §5, checkpoint row). On TPU slices that is untenable: one host
 failure kills the whole gang (§7.3), so save/restore is a core library.
 
-Built on orbax CheckpointManager: async saves (training continues while the
-write completes), retention policy, and sharded restore — each device reads
-only its own shards, laid out by the NamedShardings of the abstract state.
+Built on orbax CheckpointManager: async saves (training continues while
+the write completes), retention policy, and sharded restore — each device
+reads only its own shards, laid out by the NamedShardings of the abstract
+state. On top of orbax, this module adds the durability contract a
+preemptible fleet actually needs (docs/resilience.md):
+
+- **Verification manifest.** After each save COMMITS, a background
+  worker writes `kftpu_manifest.json` into the step directory: size +
+  sha256 for every file orbax wrote, plus the data-iterator state
+  captured at the step boundary. The manifest is written atomically
+  (tmp + fsync + rename), so its presence certifies a complete,
+  uncorrupted checkpoint — a SIGKILL between orbax's commit and the
+  manifest write leaves an unverifiable step that restore treats as
+  garbage, never a torn read.
+- **Fallback restore.** `restore_latest` verifies the newest step
+  against its manifest (and survives orbax restore errors); a step that
+  fails is QUARANTINED (renamed out of the numeric step namespace, so a
+  later save at the same step can't collide) and the next-newest valid
+  checkpoint is tried. Corruption costs the steps since the last good
+  save, not the run.
+- **Resumable data.** The manifest carries the training data iterator's
+  `state_dict()` so resume continues the batch sequence exactly —
+  neither repeating nor skipping examples (`train/data.py` protocol).
+
+**Single-writer contract.** One process owns a checkpoint directory's
+mutations: saves, manifest writes, and quarantine renames. Everything
+else opens the directory with `read_only=True` (saves refused, invalid
+steps skipped non-destructively, directory never created). In a
+multi-host gang, that writer is process 0 of a single-controller setup
+— running N writer-mode Checkpointers over one shared directory is NOT
+supported: each would re-hash every host's shards after every save
+(O(N × checkpoint size) redundant reads) and their quarantine renames
+could race another host's in-flight sharded restore, leaving hosts
+resumed at different steps. Cross-host restore agreement (all hosts
+picking the same fallback step) requires a collective the platform's
+gang-restart path provides by restarting the whole gang from one
+process's decision; see docs/resilience.md.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
+import os
+import queue
+import threading
 from pathlib import Path
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import orbax.checkpoint as ocp
 
 log = logging.getLogger(__name__)
+
+# Inside each step dir, next to orbax's files (which never collide with
+# it); the checksums cover every file EXCEPT the manifest itself.
+MANIFEST_NAME = "kftpu_manifest.json"
+# Non-numeric prefix = invisible to orbax's step scan.
+QUARANTINE_PREFIX = "corrupt-"
+
+
+class Restored(NamedTuple):
+    """`restore_latest` result: the state pytree, the step it was saved
+    at, and the data-iterator state captured at that boundary (None for
+    checkpoints saved without one)."""
+
+    state: Any
+    step: int
+    data_state: dict | None
+
+
+def _file_digest(path: Path) -> tuple[int, str]:
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            size += len(chunk)
+            h.update(chunk)
+    return size, h.hexdigest()
+
+
+def write_manifest(step_dir: Path, data_state: dict | None) -> dict:
+    """Checksum every committed file under `step_dir` and write the
+    manifest atomically. Returns the manifest dict."""
+    files: dict[str, dict] = {}
+    for p in sorted(step_dir.rglob("*")):
+        # Skip the manifest AND any leftover .tmp from a failed prior
+        # attempt — checksumming a file that os.replace then removes
+        # would make the manifest permanently self-invalidating.
+        if not p.is_file() or p.name.startswith(MANIFEST_NAME):
+            continue
+        size, digest = _file_digest(p)
+        files[str(p.relative_to(step_dir))] = {"size": size, "sha256": digest}
+    if not files:
+        # The checksum walk found NOTHING: retention eviction's rmtree
+        # emptied the directory under us (files go before the dir). A
+        # vacuous manifest would verify trivially yet restore nothing —
+        # and writing it into the half-deleted dir can even break
+        # rmtree's final rmdir (ENOTEMPTY), leaving a trap in the
+        # numeric step namespace. Report it like any other vanished-
+        # file race instead.
+        raise FileNotFoundError(f"no files to certify under {step_dir}")
+    manifest = {"version": 1, "files": files, "data_state": data_state}
+    _replace_manifest(step_dir, manifest)
+    return manifest
+
+
+def _replace_manifest(step_dir: Path, manifest: dict) -> None:
+    tmp = step_dir / (MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # rename is the commit point: a crash leaves either no manifest
+    # (unverifiable step -> restore falls back) or a complete one.
+    os.replace(tmp, step_dir / MANIFEST_NAME)
+
+
+def verify_manifest(step_dir: Path) -> dict | None:
+    """The manifest if `step_dir` is a complete, uncorrupted checkpoint;
+    None for anything else (missing/garbled manifest, missing file,
+    size or checksum mismatch) — the caller falls back, never crashes."""
+    try:
+        with open(step_dir / MANIFEST_NAME) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError, TypeError):
+        # Unreadable, non-JSON, or JSON of the wrong shape (a list, a
+        # null, a missing key): all just "corrupt manifest".
+        return None
+    if not isinstance(files, dict) or not files:
+        # A manifest certifying ZERO files certifies nothing — it can
+        # only come from a manifest write racing eviction (or hand
+        # tampering) and a step that "verifies" but cannot restore
+        # would turn the fallback walk into a hard crash.
+        return None
+    for rel, want in files.items():
+        p = step_dir / rel
+        try:
+            size, digest = _file_digest(p)
+        except OSError:
+            return None
+        if not isinstance(want, dict):
+            return None
+        if size != want.get("size") or digest != want.get("sha256"):
+            return None
+    return manifest
 
 
 class Checkpointer:
@@ -31,52 +167,334 @@ class Checkpointer:
         *,
         save_interval_steps: int = 100,
         max_to_keep: int = 3,
+        verify: bool = True,
+        read_only: bool = False,
     ):
+        """`read_only=True` marks a restore-only consumer (serving, an
+        inspection job): `save()` is refused, the directory is never
+        created (a mistyped path raises FileNotFoundError instead of
+        mkdir-ing junk on the restore path), and invalid steps are
+        SKIPPED non-destructively during restore instead of quarantined
+        — renaming belongs to the directory's single writer, whose own
+        restore must clear a torn step out of the numeric namespace
+        before it can save there again. Read-only consumers may race
+        that writer's in-flight saves (a committed step whose manifest
+        is still being written looks unverifiable); skipping costs them
+        freshness, renaming would cost the writer its checkpoint."""
         self.directory = Path(directory).absolute()
-        self._mgr = ocp.CheckpointManager(
+        self.verify = verify
+        self.read_only = read_only
+        if read_only and not self.directory.is_dir():
+            raise FileNotFoundError(
+                f"checkpoint directory {self.directory} does not exist "
+                "(read_only Checkpointer never creates it)"
+            )
+        self._save_interval_steps = save_interval_steps
+        self._max_to_keep = max_to_keep
+        self._mgr = self._make_mgr()
+        # Manifest writer: one worker drains (step, data_state) items,
+        # waiting for the orbax commit before checksumming — saves stay
+        # async for the training loop, but every committed step gets a
+        # manifest without the step loop ever blocking on hashing.
+        self._manifest_q: queue.Queue = queue.Queue()
+        self._manifest_errors: list[Exception] = []
+        self._manifest_thread: threading.Thread | None = None
+
+    def _make_mgr(self) -> ocp.CheckpointManager:
+        return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                save_interval_steps=save_interval_steps,
-                max_to_keep=max_to_keep,
-                create=True,
+                save_interval_steps=self._save_interval_steps,
+                max_to_keep=self._max_to_keep,
+                create=not self.read_only,
                 enable_async_checkpointing=True,
             ),
         )
 
-    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        """Maybe-save (respects save_interval_steps unless force)."""
-        return self._mgr.save(
+    # -- manifest worker ---------------------------------------------------
+
+    def _manifest_loop(self) -> None:
+        while True:
+            item = self._manifest_q.get()
+            try:
+                if item is None:
+                    return
+                step, data_state = item
+                try:
+                    # Block THIS thread (not the step loop) until the
+                    # async save commits, then certify what landed on
+                    # disk. A commit FAILURE (disk full, IO error) is
+                    # always an error — the step was never durable.
+                    self._mgr.wait_until_finished()
+                except Exception as e:
+                    log.exception("async save for step %s failed", step)
+                    self._manifest_errors.append(e)
+                    continue
+                step_dir = self.directory / str(step)
+                # Retention eviction can race the checksum pass: rmtree
+                # deletes files before the directory, so a first failure
+                # with the dir still present may just be mid-eviction —
+                # retry once, and only record an error if the dir
+                # SURVIVES a failed retry (a real IO problem, not an
+                # evicted step that needs no manifest anyway).
+                for attempt in (0, 1):
+                    try:
+                        if step_dir.is_dir():
+                            write_manifest(step_dir, data_state)
+                        else:
+                            log.info(
+                                "checkpoint step %s evicted before its "
+                                "manifest was written", step,
+                            )
+                        break
+                    except FileNotFoundError:
+                        # rmtree deletes files before the directory: a
+                        # file vanishing beneath the checksum walk is
+                        # retention eviction in progress even when the
+                        # dir still exists on the immediate retry (a
+                        # large step can stay mid-rmtree across both
+                        # attempts). The evicted step needs no manifest
+                        # — and if its files vanished for any other
+                        # reason the step is simply unverifiable, which
+                        # restore already treats as invalid. Either way
+                        # it is never a durability failure of the save
+                        # that just committed, so don't poison a later
+                        # clean-exit wait() with it.
+                        log.info(
+                            "checkpoint step %s files vanished mid-"
+                            "checksum (eviction in progress)", step,
+                        )
+                        break
+                    except Exception as e:
+                        if not step_dir.is_dir():
+                            log.info(
+                                "checkpoint step %s evicted mid-"
+                                "checksum", step,
+                            )
+                            break
+                        if attempt:  # recorded; surfaced by wait()
+                            log.exception(
+                                "manifest write for step %s failed", step
+                            )
+                            self._manifest_errors.append(e)
+            finally:
+                self._manifest_q.task_done()
+
+    def _enqueue_manifest(self, step: int, data_state: dict | None) -> None:
+        if self._manifest_thread is None or not self._manifest_thread.is_alive():
+            self._manifest_thread = threading.Thread(
+                target=self._manifest_loop, name="ckpt-manifest", daemon=True
+            )
+            self._manifest_thread.start()
+        self._manifest_q.put((step, data_state))
+
+    # -- save --------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        force: bool = False,
+        data_state: dict | None = None,
+    ) -> bool:
+        """Maybe-save (respects save_interval_steps unless force).
+        `data_state` is the data iterator's `state_dict()` captured at
+        this step boundary; it rides in the verification manifest so
+        resume continues the exact batch sequence."""
+        if self.read_only:
+            raise RuntimeError(
+                f"Checkpointer({self.directory}) is read_only: save() "
+                "refused — only the directory's single writer may write"
+            )
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
+        if saved:
+            self._enqueue_manifest(step, data_state)
+        return saved
+
+    def update_data_state(
+        self, step: int, data_state: dict | None
+    ) -> bool:
+        """Atomically replace the data-iterator state carried by an
+        EXISTING step's manifest — files and checksums untouched, so
+        the step still verifies. Divergence rollback uses this to make
+        the perturbed salt durable immediately: a crash between the
+        rollback and the next periodic save must resume onto the NEW
+        trajectory, not replay the one that already diverged. Returns
+        False when the step has no readable manifest to update (a
+        verify=False or legacy writer's step)."""
+        if self.read_only:
+            raise RuntimeError(
+                f"Checkpointer({self.directory}) is read_only: "
+                "update_data_state() refused"
+            )
+        step_dir = self.directory / str(step)
+        try:
+            with open(step_dir / MANIFEST_NAME) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if not isinstance(manifest, dict):
+            return False
+        manifest["data_state"] = data_state
+        _replace_manifest(step_dir, manifest)
+        return True
 
     def should_save(self, step: int) -> bool:
         """Would `save(step)` actually write? Lets callers run pre-save
         validation (e.g. divergence checks) only when it matters."""
-        return self._mgr.should_save(step)
+        return not self.read_only and self._mgr.should_save(step)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore_latest(self, abstract_state: Any) -> tuple[Any, int] | None:
-        """Restore the newest checkpoint onto `abstract_state`'s shardings.
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    # -- restore -----------------------------------------------------------
+
+    def _quarantine(self, step: int) -> None:
+        """Move an invalid step out of the numeric namespace (orbax's
+        step scan ignores it) and rebuild the manager so its cached step
+        list forgets the step — a later save at the same number must not
+        collide with the corpse."""
+        step_dir = self.directory / str(step)
+        target = self.directory / f"{QUARANTINE_PREFIX}{step}"
+        n = 0
+        while target.exists():
+            n += 1
+            target = self.directory / f"{QUARANTINE_PREFIX}{step}.{n}"
+        try:
+            os.rename(step_dir, target)
+            log.warning(
+                "quarantined invalid checkpoint step %d -> %s",
+                step, target.name,
+            )
+        except OSError:
+            if step_dir.exists():
+                # The rename failed but the corpse is still there (a
+                # read-only mount, missing permissions): we can neither
+                # clear nor reuse the step — surface it instead of
+                # looping over the same invalid step forever.
+                raise
+            # Already gone (e.g. another process's retention eviction
+            # raced us) — refreshing the manager below is all we need.
+            log.warning("invalid checkpoint step %d disappeared", step)
+        self._mgr.close()
+        self._mgr = self._make_mgr()
+
+    def restore_latest(self, abstract_state: Any) -> Restored | None:
+        """Restore the newest VALID checkpoint onto `abstract_state`'s
+        shardings.
 
         `abstract_state` is a pytree of jax.ShapeDtypeStruct (with
         .sharding set for sharded restore) — the Trainer's
-        `abstract_state()` output. Returns None when no checkpoint exists.
+        `abstract_state()` output. Returns None when no (valid)
+        checkpoint exists.
+
+        Every candidate step is verified against its manifest first
+        (unless verify=False): a torn write, a flipped byte, a garbled
+        manifest, or a step directory evicted mid-restore all fall back
+        to the next-newest — corruption costs the steps since the last
+        good save, never a crash or a silent load of damaged state. The
+        directory's WRITER additionally quarantines each invalid step
+        (renamed out of the numeric namespace, so its own later save at
+        that number can't collide); `read_only` consumers skip
+        non-destructively (see __init__).
         """
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        state = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
-        )
-        log.info("restored checkpoint step=%d from %s", step, self.directory)
-        return state, step
+        self.wait()  # manifests for in-flight saves must be on disk
+        # One descending walk over a snapshot of the step list: each
+        # candidate is visited at most once, so an unremovable invalid
+        # step can never spin this into a loop.
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            step_dir = self.directory / str(step)
+            if self.verify:
+                manifest = verify_manifest(step_dir)
+                if manifest is None:
+                    log.warning(
+                        "checkpoint step %d failed verification "
+                        "(corrupt, torn, or written without a manifest "
+                        "— e.g. by a pre-manifest or verify=False "
+                        "writer); falling back to the previous "
+                        "checkpoint", step,
+                    )
+                    self._invalidate(step)
+                    continue
+            else:
+                # No digest checks, but the manifest (when present)
+                # still carries the data-iterator state resume needs.
+                try:
+                    with open(step_dir / MANIFEST_NAME) as f:
+                        manifest = json.load(f)
+                    if not isinstance(manifest, dict):
+                        manifest = {}
+                except (OSError, ValueError):
+                    manifest = {}
+            try:
+                state = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract_state)
+                )
+            except Exception:
+                # Orbax failed after verification passed. If the step
+                # is still on disk and still certifies, the bytes are
+                # fine — the failure is the CALLER'S (e.g. an
+                # abstract_state whose tree no longer matches what was
+                # saved, a changed TrainState shape): surface it loudly
+                # rather than silently discarding the entire checkpoint
+                # history and restarting from scratch.
+                if (
+                    self.verify
+                    and step_dir.is_dir()
+                    and verify_manifest(step_dir) is not None
+                ):
+                    raise
+                # Otherwise the step vanished mid-restore (another
+                # writer's retention eviction) or verify=False let a
+                # corrupt step through: fall back.
+                log.exception(
+                    "restore of checkpoint step %d failed; falling back",
+                    step,
+                )
+                self._invalidate(step)
+                continue
+            log.info(
+                "restored checkpoint step=%d from %s", step, self.directory
+            )
+            return Restored(state, step, manifest.get("data_state"))
+        return None
+
+    def _invalidate(self, step: int) -> None:
+        """Handle an invalid step per role: the writer quarantines it
+        (it must be able to re-save that step number); a read-only
+        consumer just leaves it for the writer and keeps walking."""
+        if self.read_only:
+            log.warning(
+                "read-only restore skipping invalid checkpoint step %d "
+                "(the writing process owns quarantine)", step,
+            )
+        else:
+            self._quarantine(step)
+
+    # -- lifecycle ---------------------------------------------------------
 
     def wait(self) -> None:
-        """Block until in-flight async saves are durable (call before
-        process exit so a preemption can't lose the final save)."""
+        """Block until in-flight async saves are durable AND their
+        manifests are written (call before process exit so a preemption
+        can't lose the final save or leave it unverifiable)."""
         self._mgr.wait_until_finished()
+        self._manifest_q.join()
+        if self._manifest_errors:
+            errors, self._manifest_errors = self._manifest_errors, []
+            raise RuntimeError(
+                f"checkpoint manifest writes failed: {errors!r}"
+            ) from errors[0]
 
     def close(self) -> None:
-        self._mgr.close()
+        try:
+            self.wait()
+        finally:
+            if self._manifest_thread is not None:
+                self._manifest_q.put(None)
+            self._mgr.close()

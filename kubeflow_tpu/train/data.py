@@ -7,6 +7,20 @@ throughput from input IO. Batches are created *already sharded* (jit with
 out_shardings) so no single device ever holds the global batch, and
 iteration costs nothing on the host — measured steps/sec is pure device
 time.
+
+Resumable-data protocol (docs/resilience.md): training iterables may
+expose ``state_dict()`` / ``load_state_dict(sd)`` and the loop persists
+that state inside every checkpoint, so a preempted run resumes the batch
+sequence exactly — no repeated and no skipped examples. The optional
+``perturb(salt)`` hook changes the FUTURE batch sequence without moving
+the position; the loop calls it on divergence rollback so the retried
+trajectory sees different data (the seed-perturbation escape hatch).
+Both synthetic streams implement the protocol; positions count batches
+yielded, which the loop keeps 1:1 with optimizer steps. ``perturb`` is
+only offered with ``vary_per_step=True`` — a fixed single-batch stream
+cannot change its future, so it exposes ``perturb = None`` and the
+loop's rollback precondition refuses rather than replaying an
+identical diverging trajectory.
 """
 
 from __future__ import annotations
@@ -20,8 +34,76 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kubeflow_tpu.parallel.sharding import batch_axes, batch_sharding
 
 
-class SyntheticImages:
-    """An infinite stream of one device-resident image batch."""
+class _SyntheticStream:
+    """Shared machinery for the synthetic streams: position/salt
+    bookkeeping plus the per-step-vs-cached batch dispatch. Subclasses
+    define the batch recipe and call `_init_stream` with it.
+
+    State is on the ITERABLE (single live iterator per stream — the
+    training loop's usage): `state_dict` snapshots the number of batches
+    yielded, `load_state_dict` repositions, and iteration continues from
+    there. With `vary_per_step=False` every batch is identical (the
+    device-throughput-benchmark mode), so the position only matters for
+    bookkeeping; with `vary_per_step=True` the batch at position p is a
+    pure function of (seed, salt, p) — resume and rollback reproduce the
+    exact sequence."""
+
+    def _init_stream(self, make, sharding, vary_per_step: bool) -> None:
+        """`make(pos, salt)` builds one batch from traced int32 scalars
+        (one compile, any position)."""
+        self.vary_per_step = vary_per_step
+        self._position = 0
+        self._salt = 0
+        if vary_per_step:
+            self._make = jax.jit(make, out_shardings=sharding)
+        else:
+            # A fixed stream cannot honor perturb(): every position
+            # yields the identical cached batch, so a new salt changes
+            # nothing. Shadow the method so capability probes (fit()'s
+            # rollback precondition) see no perturb and refuse up front
+            # instead of burning the rollback budget on byte-identical
+            # retries of a trajectory that already diverged.
+            self.perturb = None
+            self.batch = jax.jit(make, out_shardings=sharding)(
+                jnp.int32(0), jnp.int32(0)
+            )
+
+    # -- resumable-data protocol -------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"position": self._position, "salt": self._salt}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._position = int(state["position"])
+        self._salt = int(state.get("salt", 0))
+
+    def perturb(self, salt: int) -> None:
+        """Reseed the FUTURE sequence without moving the position —
+        divergence rollback's escape hatch. Only offered on
+        `vary_per_step=True` streams (on a fixed stream the hook is
+        shadowed to None, so `fit()` refuses rollback rather than
+        retrying an identical trajectory)."""
+        self._salt = int(salt)
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            if self.vary_per_step:
+                batch = self._make(
+                    jnp.int32(self._position), jnp.int32(self._salt)
+                )
+            else:
+                batch = self.batch
+            self._position += 1
+            yield batch
+
+
+class SyntheticImages(_SyntheticStream):
+    """An infinite stream of device-resident image batches.
+
+    Default: ONE batch, yielded forever (pure device-throughput
+    benchmarking). `vary_per_step=True` derives each batch from the
+    yield position instead — per-position-unique, deterministic, and
+    resumable, which is what the preemption soak trains on."""
 
     def __init__(
         self,
@@ -32,29 +114,30 @@ class SyntheticImages:
         channels: int = 3,
         seed: int = 0,
         dtype=jnp.float32,
+        vary_per_step: bool = False,
     ):
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
-        k_img, k_lbl = jax.random.split(jax.random.PRNGKey(seed))
-        sharding = batch_sharding(mesh, ndim=1)
+        key = jax.random.PRNGKey(seed)
+        self.batch_size = batch_size
 
-        def make():
+        def make(pos, salt):
+            k = jax.random.fold_in(jax.random.fold_in(key, salt), pos)
+            k_img, k_lbl = jax.random.split(k)
             img = jax.random.normal(
                 k_img, (batch_size, image_size, image_size, channels), dtype
             )
             lbl = jax.random.randint(k_lbl, (batch_size,), 0, num_classes)
             return {"image": img, "label": lbl}
 
-        self.batch = jax.jit(make, out_shardings=sharding)()
-        self.batch_size = batch_size
-
-    def __iter__(self) -> Iterator[dict]:
-        while True:
-            yield self.batch
+        self._init_stream(make, batch_sharding(mesh, ndim=1), vary_per_step)
 
 
-class SyntheticTokens:
-    """Synthetic LM batches: random token ids, next-token labels."""
+class SyntheticTokens(_SyntheticStream):
+    """Synthetic LM batches: random token ids, next-token labels.
+
+    Same single-batch default / `vary_per_step` split as
+    `SyntheticImages`, same resumable-state protocol."""
 
     def __init__(
         self,
@@ -63,22 +146,20 @@ class SyntheticTokens:
         seq_len: int,
         vocab_size: int,
         seed: int = 0,
+        vary_per_step: bool = False,
     ):
         key = jax.random.PRNGKey(seed)
         # Sequence dim rides sp when present so ring attention gets
         # pre-sharded inputs.
         seq_axis = "sp" if "sp" in mesh.axis_names else None
         sharding = NamedSharding(mesh, P(batch_axes(mesh), seq_axis))
+        self.batch_size = batch_size
 
-        def make():
+        def make(pos, salt):
+            k = jax.random.fold_in(jax.random.fold_in(key, salt), pos)
             tokens = jax.random.randint(
-                key, (batch_size, seq_len + 1), 0, vocab_size
+                k, (batch_size, seq_len + 1), 0, vocab_size
             )
             return {"tokens": tokens[:, :-1], "labels": tokens[:, 1:]}
 
-        self.batch = jax.jit(make, out_shardings=sharding)()
-        self.batch_size = batch_size
-
-    def __iter__(self) -> Iterator[dict]:
-        while True:
-            yield self.batch
+        self._init_stream(make, sharding, vary_per_step)

@@ -1,17 +1,33 @@
 """The training loop: resume, step, guard, checkpoint, report.
 
 Failure semantics the reference lacked (SURVEY.md §5 "no elastic training,
-no preemption handling"): the loop auto-resumes from the newest checkpoint,
-detects divergence (NaN/inf loss) and raises instead of burning chips, and
-forces a final durable save on exit — so the TpuJob operator's
-restart-the-gang-on-failure policy composes with it to give
-checkpoint-restart elasticity.
+no preemption handling") — the full matrix lives in docs/resilience.md:
+
+- **Auto-resume.** The loop restores the newest VALID checkpoint
+  (`train/checkpoint.py` verifies manifests and falls back past
+  corruption) and, when the data iterable implements the resumable-data
+  protocol, repositions it from the state saved in that checkpoint — so
+  a restarted run neither repeats nor skips batches.
+- **Anomaly guard.** A trainer built with an `AnomalyGuard`
+  (`train/guard.py`) screens EVERY step on device: non-finite or
+  spiking steps are skipped, not applied, so a NaN at step 51 can never
+  reach the step-100 checkpoint. On sustained divergence (bounded
+  consecutive skips) the loop rolls back to the last checkpoint and
+  perturbs the data seed — a different trajectory instead of a dead run.
+- **Preemption.** SIGTERM/SIGINT is caught and honored at the next step
+  boundary: one forced save (with data state), then a clean exit with a
+  distinct `Preempted` result — the TpuJob operator's gang-restart
+  policy composes with it to give checkpoint-restart elasticity with
+  zero lost work.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import logging
+import os
+import signal as signal_module
+import sys
 import time
 from typing import Any, Callable, Iterable
 
@@ -26,7 +42,8 @@ log = logging.getLogger(__name__)
 
 
 class TrainingDiverged(RuntimeError):
-    """Loss became non-finite; restart from the last checkpoint with a
+    """Loss became non-finite (guardless runs) or the anomaly guard hit
+    its rollback budget; restart from the last checkpoint with a
     different seed/schedule rather than continuing."""
 
 
@@ -36,6 +53,29 @@ class FitResult:
     history: list[dict]
     steps_done: int
     resumed_from: int | None
+    # Divergence rollbacks taken (guarded runs; 0 otherwise).
+    rollbacks: int = 0
+
+
+@dataclasses.dataclass
+class Preempted(FitResult):
+    """fit() observed SIGTERM/SIGINT: it stopped at a step boundary
+    after an emergency forced save — resume from the checkpoint to
+    continue with zero lost work. `isinstance(result, Preempted)`
+    distinguishes a preemption from completion."""
+
+    signum: int | None = None
+
+
+def _data_state(data: Any) -> dict | None:
+    sd = getattr(data, "state_dict", None)
+    return sd() if callable(sd) else None
+
+
+def _load_data_state(data: Any, state: dict | None) -> None:
+    ld = getattr(data, "load_state_dict", None)
+    if state is not None and callable(ld):
+        ld(state)
 
 
 def fit(
@@ -48,16 +88,27 @@ def fit(
     log_every: int = 50,
     on_metrics: Callable[[int, dict], None] | None = None,
     profiler: "Profiler | None" = None,
+    handle_signals: bool = True,
+    max_rollbacks: int = 3,
 ) -> FitResult:
-    """Train for `total_steps` global steps, resuming if possible."""
+    """Train for `total_steps` global steps, resuming if possible.
+
+    `handle_signals=False` opts out of the SIGTERM/SIGINT preemption
+    handler (e.g. when the caller owns signal disposition); handlers are
+    only ever installed on the main thread and are restored on exit.
+    `max_rollbacks` bounds divergence rollbacks before the loop gives up
+    and raises `TrainingDiverged`.
+    """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    guard = trainer.guard
 
     resumed_from = None
     state = None
     if checkpointer is not None:
         restored = checkpointer.restore_latest(trainer.abstract_state())
         if restored is not None:
-            state, resumed_from = restored[0], int(restored[1])
+            state, resumed_from = restored.state, int(restored.step)
+            _load_data_state(data, restored.data_state)
     if state is None:
         state = trainer.init_state(rng)
 
@@ -76,17 +127,111 @@ def fit(
     history: list[dict] = []
     t_last = time.perf_counter()
     examples = 0
+    rollbacks = 0
+    preempt: dict = {"signum": None}
+    installed: dict = {}
+    if handle_signals:
+        def _restore_handlers() -> None:
+            for sig, prev in installed.items():
+                # prev is None when the pre-fit handler was installed
+                # outside Python (sigaction in a launcher/C extension);
+                # signal.signal(sig, None) raises TypeError, so fall
+                # back to SIG_DFL — imperfect, but it neither crashes
+                # nor leaves our flag-setter swallowing signals.
+                signal_module.signal(
+                    sig,
+                    prev if prev is not None else signal_module.SIG_DFL,
+                )
+
+        def _on_signal(signum, frame):
+            if preempt["signum"] is not None:
+                # Second delivery (e.g. Ctrl-C during a multi-minute
+                # XLA compile that never reaches a step boundary):
+                # escalate — restore the pre-fit disposition and
+                # re-deliver so the default behavior (KeyboardInterrupt
+                # / termination) applies instead of a dead flag.
+                _restore_handlers()
+                os.kill(os.getpid(), signum)
+                return
+            # Flag only: the loop honors it at the next step boundary
+            # (an async save mid-step would tear the state).
+            preempt["signum"] = signum
+
+        try:
+            for sig in (signal_module.SIGTERM, signal_module.SIGINT):
+                installed[sig] = signal_module.signal(sig, _on_signal)
+        except ValueError:  # not the main thread: caller owns signals
+            installed = {}
 
     def check_finite(metrics, step: int) -> float:
         loss = float(metrics["loss"])
         if not np.isfinite(loss):
-            # Never persisted: the check runs before any save at this step,
-            # so resume always lands on the last finite state.
+            # Never persisted: the check runs before any save at this
+            # step, so resume always lands on the last finite state.
             raise TrainingDiverged(f"non-finite loss {loss} at step {step}")
         return loss
 
+    def rollback(step: int) -> tuple[TrainState, int]:
+        """Divergence: restore the last good checkpoint and perturb the
+        data seed so the retried trajectory differs."""
+        nonlocal it
+        restored = (
+            checkpointer.restore_latest(trainer.abstract_state())
+            if checkpointer is not None
+            else None
+        )
+        if restored is None:
+            raise TrainingDiverged(
+                f"sustained divergence at step {step} and no checkpoint "
+                "to roll back to"
+            )
+        perturb = getattr(data, "perturb", None)
+        if (
+            restored.data_state is None
+            or not callable(getattr(data, "load_state_dict", None))
+            or not callable(perturb)
+        ):
+            # Without resumable data the replayed steps would silently
+            # consume batch positions that don't match their step
+            # numbers (a fresh iter() restarts a list, a generator just
+            # keeps going); without perturb() the replay is a
+            # deterministic re-run that diverges identically — either
+            # way, refuse up front rather than burn the rollback budget
+            # on wrong or provably futile retries.
+            raise TrainingDiverged(
+                f"sustained divergence at step {step}: rollback needs "
+                "resumable, perturbable data (state_dict/"
+                "load_state_dict/perturb — see docs/resilience.md); "
+                "restart manually from the last checkpoint with a "
+                "different data order instead"
+            )
+        _load_data_state(data, restored.data_state)
+        # Monotonic salt: past the checkpoint's own salt (which a prior
+        # incarnation's rollback may already have burned) AND past this
+        # process's earlier attempts — every retry gets a genuinely new
+        # trajectory, never a replay of one that already diverged.
+        salt = int(restored.data_state.get("salt", 0)) + rollbacks
+        perturb(salt)
+        # Make the perturbed salt durable NOW by rewriting the restored
+        # step's manifest data_state (checksums untouched): the next
+        # periodic save may be a full interval away, and a crash in that
+        # window would otherwise resume onto the already-diverged salt
+        # and re-burn the whole divergence segment every incarnation.
+        checkpointer.update_data_state(
+            int(restored.step), _data_state(data)
+        )
+        it = iter(data)
+        log.warning(
+            "anomaly guard: sustained divergence at step %d; rolled back "
+            "to checkpoint step %d (rollback %d/%d, data salt -> %d)",
+            step, restored.step, rollbacks, max_rollbacks, salt,
+        )
+        return restored.state, int(restored.step)
+
+    result: FitResult | None = None
+    step = start_step
     try:
-        for step in range(start_step, total_steps):
+        while step < total_steps:
             try:
                 batch = next(it)
             except StopIteration:
@@ -99,45 +244,136 @@ def fit(
             state, metrics = step_fn(state, batch)
             if profiler is not None:
                 profiler.after_step(step)
+            step += 1
             examples += trainer.config.batch_size
-            is_last = step + 1 == total_steps
-            if checkpointer is not None and (
-                checkpointer.should_save(step + 1) or is_last
-            ):
-                check_finite(metrics, step + 1)
-                checkpointer.save(step + 1, state, force=is_last)
-            if (step + 1) % log_every == 0 or is_last:
-                loss = check_finite(metrics, step + 1)
+            is_last = step == total_steps
+            preempted = preempt["signum"] is not None
+            want_save = checkpointer is not None and (
+                checkpointer.should_save(step) or is_last
+            )
+            # A preempted boundary always logs: the exit step must reach
+            # history/on_metrics before the loop returns.
+            want_log = step % log_every == 0 or is_last or preempted
+
+            # Guard verdicts are device scalars; read them only where
+            # the host syncs anyway (boundaries), never per step.
+            if guard is not None and (want_save or want_log or preempted):
+                if guard.diverged(state.guard):
+                    if preempted or rollbacks >= max_rollbacks:
+                        # Dying or out of budget: the last good
+                        # checkpoint stays the recovery point — never
+                        # save (or roll back under) a diverged state.
+                        raise TrainingDiverged(
+                            f"sustained divergence at step {step} after "
+                            f"{rollbacks} rollback(s)"
+                        )
+                    rollbacks += 1
+                    state, step = rollback(step)
+                    continue
+
+            saved = False
+            if want_save:
+                if guard is None:
+                    check_finite(metrics, step)
+                checkpointer.save(
+                    step, state,
+                    force=is_last or preempted,
+                    data_state=_data_state(data),
+                )
+                saved = True
+            if want_log:
+                if guard is None:
+                    loss = check_finite(metrics, step)
+                else:
+                    # A skipped step may legitimately log a non-finite
+                    # loss — the update was rejected on device, so the
+                    # STATE stayed finite; nothing here can persist it.
+                    loss = float(metrics["loss"])
                 now = time.perf_counter()
                 rec = {
-                    "step": step + 1,
+                    "step": step,
                     "loss": loss,
                     # Absent in train_metrics="loss" mode (LM trainers
                     # skip the per-step full-vocab argmax).
                     "accuracy": float(metrics.get("accuracy", float("nan"))),
                     "examples_per_sec": examples / (now - t_last),
                 }
+                if guard is not None:
+                    rec["grad_norm"] = float(metrics["grad_norm"])
+                    rec["guard_skipped_total"] = int(
+                        metrics["guard_skipped_total"]
+                    )
+                    rec["rollbacks"] = rollbacks
                 history.append(rec)
                 if on_metrics is not None:
-                    on_metrics(step + 1, rec)
+                    on_metrics(step, rec)
                 log.info(
                     "step %d loss %.4f acc %.3f %.1f ex/s",
                     rec["step"], rec["loss"], rec["accuracy"],
                     rec["examples_per_sec"],
                 )
                 t_last, examples = now, 0
+            if preempted:
+                if checkpointer is not None and not saved:
+                    # Emergency save at the boundary: the preemption
+                    # costs zero steps.
+                    checkpointer.save(
+                        step, state, force=True,
+                        data_state=_data_state(data),
+                    )
+                log.warning(
+                    "preemption signal %s honored at step %d: %s, "
+                    "exiting cleanly",
+                    preempt["signum"], step,
+                    "emergency save done" if checkpointer is not None
+                    else "NO checkpointer — progress not saved",
+                )
+                result = Preempted(
+                    state=state,
+                    history=history,
+                    steps_done=step - start_step,
+                    resumed_from=resumed_from,
+                    rollbacks=rollbacks,
+                    signum=preempt["signum"],
+                )
+                break
     finally:
-        # Even on the exception path: make enqueued saves durable (the
-        # last good checkpoint is the recovery point) and close a live
-        # trace (a diverging run should still leave a readable profile).
+        # Even on the exception path: restore signal disposition, make
+        # enqueued saves durable (the last good checkpoint is the
+        # recovery point) and close a live trace (a diverging run should
+        # still leave a readable profile).
+        if installed:
+            _restore_handlers()
         if profiler is not None:
             profiler.close()
         if checkpointer is not None:
-            checkpointer.wait()
+            if sys.exc_info()[0] is None:
+                # Clean exit (completion or Preempted): a durability
+                # failure here means the "saved" work is NOT safe —
+                # surface it instead of returning a result that claims
+                # zero lost steps.
+                checkpointer.wait()
+            else:
+                # An exception is already unwinding (TrainingDiverged,
+                # a KeyboardInterrupt escalation): that is the story —
+                # still try to make enqueued saves durable, but demote
+                # a wait() failure to a log line so it cannot replace
+                # the in-flight exception and break callers' typed
+                # handling.
+                try:
+                    checkpointer.wait()
+                except Exception:
+                    log.exception(
+                        "checkpoint wait failed while another "
+                        "exception was unwinding"
+                    )
 
+    if result is not None:
+        return result
     return FitResult(
         state=state,
         history=history,
         steps_done=total_steps - start_step,
         resumed_from=resumed_from,
+        rollbacks=rollbacks,
     )

@@ -82,7 +82,17 @@ def write_records(
 
 
 class RecordDataset:
-    """Decoded, optionally device-resident batches from record files."""
+    """Decoded, optionally device-resident batches from record files.
+
+    Implements the resumable-data protocol (docs/resilience.md): the
+    native loader counts batches delivered, `state_dict()` snapshots
+    that position, and `load_state_dict` reopens the loader (same
+    paths, seed and shuffle args — the native shuffle is a pure
+    function of them) and fast-forwards to the snapshot, so a resumed
+    run continues the exact batch sequence. The fast-forward drains and
+    discards `position` batches — O(position) IO, paid once per resume,
+    which is the honest cost of random access into a shuffled stream.
+    """
 
     def __init__(
         self,
@@ -107,9 +117,7 @@ class RecordDataset:
         self.spec = spec
         self.global_batch_size = batch_size
         self.local_batch_size = batch_size // env.num_processes
-        self._loader = RecordLoader(
-            paths,
-            self.local_batch_size,
+        self._loader_kwargs = dict(
             shard_id=env.process_id,
             shards=env.num_processes,
             shuffle_buffer=shuffle_buffer,
@@ -119,30 +127,89 @@ class RecordDataset:
             drop_remainder=drop_remainder,
             epochs=epochs,
         )
+        self._paths = paths
+        self._loader = self._open()
         if self._loader.record_bytes != spec.record_bytes:
             raise ValueError(
                 f"file records are {self._loader.record_bytes} bytes but the "
                 f"spec decodes {spec.record_bytes}"
             )
 
+    def _open(self) -> RecordLoader:
+        return RecordLoader(
+            self._paths, self.local_batch_size, **self._loader_kwargs
+        )
+
     @property
     def shard_records(self) -> int:
         return self._loader.shard_records
+
+    # -- resumable-data protocol -------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"batches_delivered": self._loader.batches_delivered}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Reposition to `state`: reopen the (deterministically seeded)
+        loader and fast-forward past the already-consumed batches."""
+        target = int(state["batches_delivered"])
+        if self._loader.batches_delivered > target:
+            self._loader = self._open()
+        while self._loader.batches_delivered < target:
+            if self._loader.next() is None:
+                raise ValueError(
+                    f"cannot fast-forward to batch {target}: the stream "
+                    f"ended at {self._loader.batches_delivered} (fewer "
+                    "epochs than the checkpoint was trained with?)"
+                )
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         for raw, n in self._loader:
             batch = self.spec.decode_batch(raw[:n])
             yield batch
 
-    def device_iter(self, mesh) -> Iterator[dict]:
+    def device_iter(self, mesh) -> "_DeviceIter":
         """Batches placed on the mesh, sharded over the batch axes (the
-        data-parallel layout the trainer expects)."""
+        data-parallel layout the trainer expects). The returned iterator
+        forwards the resumable-data protocol to this dataset, so it can
+        be handed straight to `fit(..., checkpointer=...)`."""
+        return _DeviceIter(self, mesh)
+
+
+class _DeviceIter:
+    """Device-placing view over a RecordDataset that keeps the dataset's
+    resumable state reachable from the object the training loop holds.
+    Iterable AND an iterator: `iter()` starts a fresh device-placing
+    pass (the underlying loader's position carries over, as before),
+    while `next()` on the view itself keeps working for direct callers
+    of the old generator-returning API."""
+
+    def __init__(self, dataset: RecordDataset, mesh):
+        self._dataset = dataset
+        self._mesh = mesh
+        self._gen: Iterator[dict] | None = None
+
+    def state_dict(self) -> dict:
+        return self._dataset.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self._dataset.load_state_dict(state)
+        # Repositioning may have reopened the loader; a cached direct-
+        # next() generator would keep draining the stale one.
+        self._gen = None
+
+    def __next__(self) -> dict:
+        if self._gen is None:
+            self._gen = iter(self)
+        return next(self._gen)
+
+    def __iter__(self) -> Iterator[dict]:
         import jax
 
         from kubeflow_tpu.parallel.sharding import batch_sharding
 
-        sharding = batch_sharding(mesh, ndim=1)
-        for batch in self:
+        sharding = batch_sharding(self._mesh, ndim=1)
+        for batch in self._dataset:
             yield {
                 k: jax.device_put(v, sharding) for k, v in batch.items()
             }

@@ -45,12 +45,18 @@ def _ensure_partitionable_rng() -> None:
 
 
 class TrainState(struct.PyTreeNode):
-    """Step counter + params + optimizer + BN state, one donate-able pytree."""
+    """Step counter + params + optimizer + BN state, one donate-able pytree.
+
+    `guard` is the anomaly guard's scalar pytree (`train/guard.py`) when
+    the trainer was built with one, else an empty dict (no leaves). It
+    lives inside TrainState so checkpoints carry it: a resumed or
+    rolled-back run restores its skip counters with its params."""
 
     step: jax.Array
     params: core.FrozenDict | dict
     opt_state: optax.OptState
     batch_stats: core.FrozenDict | dict = struct.field(default_factory=dict)
+    guard: dict = struct.field(default_factory=dict)
     apply_fn: Callable = struct.field(pytree_node=False, default=None)
     tx: optax.GradientTransformation = struct.field(pytree_node=False, default=None)
 
@@ -231,11 +237,16 @@ class Trainer:
         input_key: str = "image",
         label_key: str = "label",
         example_input_dtype: Any = jnp.float32,
+        guard: "Any | None" = None,
     ):
         _ensure_partitionable_rng()
         self.model = model
         self.config = config
         self.mesh = mesh
+        # Optional AnomalyGuard (train/guard.py): when set, every train
+        # step screens loss/grad-norm on device and skips anomalous
+        # updates instead of applying them (see make_train_step).
+        self.guard = guard
         self.rules = dict(
             rules
             if rules is not None
@@ -269,6 +280,7 @@ class Trainer:
             params=params,
             opt_state=self.tx.init(params),
             batch_stats=variables.get("batch_stats", {}),
+            guard=self.guard.init_state() if self.guard is not None else {},
             apply_fn=self.model.apply,
             tx=self.tx,
         )
@@ -314,6 +326,7 @@ class Trainer:
 
     def make_train_step(self):
         cfg = self.config
+        guard = self.guard
         input_key = self.input_key
         label_key = self.label_key
         mesh = self.mesh
@@ -447,10 +460,46 @@ class Trainer:
                     accum_loss, has_aux=True
                 )(state.params)
 
-            state = state.apply_gradients(grads=grads, batch_stats=bstats)
             metrics = {"loss": loss}
             if has_acc:
                 metrics["accuracy"] = acc
+            if guard is None:
+                state = state.apply_gradients(grads=grads, batch_stats=bstats)
+                return state, metrics
+
+            # Anomaly guard: screen this step's loss/grad-norm AND the
+            # finiteness of the updated params ON DEVICE (a finite
+            # gradient can still overflow a param to inf — an accepted
+            # overflow would poison every later checkpoint), then
+            # select between the applied and the skipped state
+            # leaf-wise. A rejected step keeps params, optimizer state
+            # and BN stats untouched (the bad batch must not leak into
+            # anything), but still advances the step counter so
+            # checkpoint/data bookkeeping stays step-aligned. The
+            # verdict never syncs to the host — the select + isfinite
+            # cost extra HBM passes over the state, not a device fence.
+            grad_norm = optax.global_norm(grads)
+            applied = state.apply_gradients(grads=grads, batch_stats=bstats)
+            # batch_stats are screened too: a huge-but-finite poison
+            # batch can keep loss/grads/params finite (BN normalizes it
+            # away) while its batch variance overflows the f32 running
+            # stats to inf — accepted, that inf rides into every later
+            # checkpoint and breaks eval/serving (train=False).
+            update_finite = jnp.bool_(True)
+            for leaf in jax.tree_util.tree_leaves(
+                (applied.params, applied.batch_stats)
+            ):
+                if jnp.issubdtype(leaf.dtype, jnp.floating):
+                    update_finite &= jnp.all(jnp.isfinite(leaf))
+            gstate, ok = guard.apply(
+                state.guard, loss, grad_norm, update_finite=update_finite
+            )
+            applied = applied.replace(guard=gstate)
+            skipped = state.replace(step=state.step + 1, guard=gstate)
+            state = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), applied, skipped
+            )
+            metrics.update(guard.metrics(gstate, ok, grad_norm))
             return state, metrics
 
         return jax.jit(

@@ -1,0 +1,177 @@
+"""One incarnation of the kill-and-resume soak: a REAL guarded `fit()`.
+
+The driver (`test_train_resilience_e2e.py` / `bench.py --workload
+resilience`) runs this worker repeatedly against one checkpoint
+directory, injecting the seeded `TrainFaultSchedule`: the worker
+self-delivers its scheduled crash signal from inside the data iterator
+(a genuine SIGKILL between steps / SIGTERM mid-step — not a simulated
+exit), trains through deterministic per-position batches with scheduled
+loss spikes, and appends a JSONL trace (boot, every step with its data
+position, final state summary) that the driver reconstructs the run
+from: final-loss parity, zero repeated/skipped batches, goodput.
+
+Exit codes: 0 = completed; 75 = preempted (fit returned `Preempted`);
+killed-by-signal otherwise.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ""
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+import jax.numpy as jnp  # noqa: E402
+
+from kubeflow_tpu.parallel import MeshSpec, build_mesh  # noqa: E402
+from kubeflow_tpu.testing.chaos import (  # noqa: E402
+    ResumableWrapper,
+    SpikedData,
+)
+from kubeflow_tpu.testing.tinymodels import TinyMLP  # noqa: E402
+from kubeflow_tpu.train import (  # noqa: E402
+    Checkpointer,
+    Preempted,
+    SyntheticImages,
+    TrainConfig,
+    Trainer,
+    fit,
+)
+from kubeflow_tpu.train.guard import AnomalyGuard, GuardConfig  # noqa: E402
+
+
+class CrashInjector(ResumableWrapper):
+    """Self-delivers `signum` when the batch at `at_step` comes up.
+    SIGKILL lands between steps (preemption without warning); SIGTERM
+    is flagged by fit's handler and honored at the boundary AFTER the
+    in-flight step (the graceful-preemption case)."""
+
+    def __init__(self, data, at_step: int, signum: int):
+        super().__init__(data)
+        self.at_step = at_step
+        self.signum = signum
+        self._fired = False
+
+    def transform(self, pos: int, batch):
+        if not self._fired and pos >= self.at_step:
+            self._fired = True
+            os.kill(os.getpid(), self.signum)
+        return batch
+
+
+def main() -> int:
+    total_steps = int(os.environ["KFTPU_TOTAL_STEPS"])
+    save_interval = int(os.environ["KFTPU_SAVE_INTERVAL"])
+    seed = int(os.environ["KFTPU_DATA_SEED"])
+    spikes = [
+        int(s) for s in os.environ.get("KFTPU_SPIKE_STEPS", "").split(",") if s
+    ]
+    crash_step = os.environ.get("KFTPU_CRASH_STEP")
+    crash_signal = os.environ.get("KFTPU_CRASH_SIGNAL")
+    incarnation = int(os.environ.get("KFTPU_INCARNATION", "0"))
+    trace_path = os.environ["KFTPU_TRACE_FILE"]
+
+    trace = open(trace_path, "a")
+
+    def emit(event: str, **fields) -> None:
+        trace.write(
+            json.dumps(
+                {"event": event, "incarnation": incarnation,
+                 "t": time.time(), **fields}
+            ) + "\n"
+        )
+        trace.flush()
+        os.fsync(trace.fileno())
+
+    emit("boot")
+
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    config = TrainConfig(
+        batch_size=8,
+        learning_rate=0.05,
+        warmup_steps=2,
+        total_steps=total_steps,
+        fsdp_params=False,
+        weight_decay=0.0,
+    )
+    guard = AnomalyGuard(GuardConfig(
+        ewma_alpha=0.2,
+        warmup_steps=2,
+        loss_spike_factor=3.0,
+        grad_spike_factor=6.0,
+        max_consecutive_skips=3,
+    ))
+    trainer = Trainer(
+        TinyMLP(),
+        config,
+        mesh,
+        example_input_shape=(2, 8, 8, 3),
+        guard=guard,
+    )
+    data = SyntheticImages(
+        mesh, config.batch_size, image_size=8, num_classes=10,
+        seed=seed, vary_per_step=True,
+    )
+    data = SpikedData(data, spikes, scale=1e3)
+    if crash_step is not None:
+        import signal as signal_module
+
+        signum = (
+            signal_module.SIGKILL
+            if crash_signal == "kill"
+            else signal_module.SIGTERM
+        )
+        data = CrashInjector(data, int(crash_step), signum)
+
+    ckpt = Checkpointer(
+        os.environ["KFTPU_CKPT_DIR"],
+        save_interval_steps=save_interval,
+        max_to_keep=3,
+    )
+
+    def on_metrics(step: int, rec: dict) -> None:
+        emit(
+            "step",
+            step=step,
+            position=data.state_dict()["position"],
+            loss=rec["loss"],
+            skips=rec["guard_skipped_total"],
+        )
+
+    result = fit(
+        trainer, data, total_steps=total_steps,
+        checkpointer=ckpt, log_every=1, on_metrics=on_metrics,
+    )
+    ckpt.close()
+
+    if isinstance(result, Preempted):
+        emit("preempted", step=int(result.state.step), signum=result.signum)
+        print(f"PREEMPTED step={int(result.state.step)}", flush=True)
+        return 75
+
+    params_l1 = float(
+        sum(jnp.sum(jnp.abs(p)) for p in jax.tree_util.tree_leaves(
+            result.state.params
+        ))
+    )
+    emit(
+        "done",
+        step=int(result.state.step),
+        position=data.state_dict()["position"],
+        final_loss=result.history[-1]["loss"],
+        params_l1=params_l1,
+        skips=guard.skipped_total(result.state.guard),
+        resumed_from=result.resumed_from,
+    )
+    print(f"DONE step={int(result.state.step)} l1={params_l1:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

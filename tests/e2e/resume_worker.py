@@ -1,30 +1,86 @@
 """Checkpoint-resume gang worker for the apiserver-restart e2e.
 
-Incarnation 1: does a few seconds of "work", writes a per-rank
-checkpoint, and exits nonzero (a simulated preemption). The TpuJob
-operator's whole-gang restart then re-creates the gang; incarnation 2
-finds the checkpoint and completes — proving a training job rides
-through a control-plane outage and resumes from its checkpoint with no
-operator intervention.
+Incarnation 1: runs a REAL (tiny) `fit()` with a `Checkpointer` — the
+production resume path, not a file-touch toy — then exits nonzero (a
+simulated preemption). The TpuJob operator's whole-gang restart then
+re-creates the gang; incarnation 2 finds the checkpoint via
+`restore_latest` (manifest-verified), resumes the step sequence and
+completes — proving a training job rides through a control-plane outage
+and resumes from its checkpoint with no operator intervention.
 """
 
 import os
 import sys
-import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ""
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+from kubeflow_tpu.models.resnet import tiny_resnet  # noqa: E402
+from kubeflow_tpu.parallel import MeshSpec, build_mesh  # noqa: E402
+from kubeflow_tpu.train import (  # noqa: E402
+    Checkpointer,
+    SyntheticImages,
+    TrainConfig,
+    Trainer,
+    fit,
+)
+
+PREEMPT_STEP = 2
+TOTAL_STEPS = 4
 
 
 def main() -> int:
+    # Each rank trains its own tiny model into its own checkpoint dir
+    # (the gang contract under test is restart/resume, not collectives —
+    # test_gang_e2e covers the real multi-process mesh).
     rank = os.environ.get("TPUJOB_PROCESS_ID", "0")
-    path = os.path.join(os.environ["CKPT_DIR"], f"ckpt-{rank}")
-    time.sleep(float(os.environ.get("WORK_SECONDS", "2")))
-    if os.path.exists(path):
-        with open(path) as f:
-            print(f"resumed from checkpoint step={f.read()}", flush=True)
-        return 0
-    with open(path, "w") as f:
-        f.write("100")
-    print("checkpoint written; simulating preemption", flush=True)
-    return 1
+    ckpt_dir = os.path.join(os.environ["CKPT_DIR"], f"rank-{rank}")
+
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    config = TrainConfig(
+        batch_size=4, learning_rate=0.05, warmup_steps=1,
+        total_steps=TOTAL_STEPS, fsdp_params=False,
+    )
+    trainer = Trainer(
+        tiny_resnet(), config, mesh, example_input_shape=(2, 16, 16, 3)
+    )
+    data = SyntheticImages(
+        mesh, config.batch_size, image_size=16, num_classes=10,
+        vary_per_step=True,
+    )
+
+    ckpt = Checkpointer(ckpt_dir, save_interval_steps=PREEMPT_STEP)
+    if ckpt.latest_step() is None:
+        # Incarnation 1: train to the preemption point (the final-step
+        # force-save makes the checkpoint durable), then die nonzero.
+        result = fit(
+            trainer, data, total_steps=PREEMPT_STEP,
+            checkpointer=ckpt, log_every=1,
+        )
+        ckpt.close()
+        assert result.steps_done == PREEMPT_STEP
+        print("checkpoint written; simulating preemption", flush=True)
+        return 1
+
+    # Incarnation 2: the production resume path — restore_latest inside
+    # fit() verifies the manifest, repositions the data stream, and the
+    # run completes only the remaining steps.
+    result = fit(
+        trainer, data, total_steps=TOTAL_STEPS,
+        checkpointer=ckpt, log_every=1,
+    )
+    ckpt.close()
+    assert result.resumed_from == PREEMPT_STEP, result
+    assert result.steps_done == TOTAL_STEPS - PREEMPT_STEP
+    assert int(result.state.step) == TOTAL_STEPS
+    assert data.state_dict()["position"] == TOTAL_STEPS
+    print(f"resumed from checkpoint step={result.resumed_from}", flush=True)
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,279 @@
+"""Kill-and-resume training soak: real `fit()` runs through a seeded
+training fault schedule, asserted against an uninterrupted baseline.
+
+Topology: this driver runs `resilience_worker.py` incarnations against
+ONE checkpoint directory while consuming a `TrainFaultSchedule`
+(`kubeflow_tpu/testing/chaos.py`):
+
+- process faults: the worker self-delivers SIGKILL between steps /
+  SIGTERM mid-step at the scheduled position (fit must exit `Preempted`
+  after an emergency save for the latter);
+- storage faults: between incarnations the driver truncates or
+  byte-flips the newest checkpoint, or garbles its manifest —
+  `restore_latest` must quarantine and fall back, never crash or load
+  torn state;
+- data faults: scheduled loss-spike batches (identical in the baseline
+  run) the AnomalyGuard must skip on device.
+
+Asserts, from the workers' JSONL traces:
+
+1. PARITY — the chaos run's final params (L1) and final loss equal the
+   uninterrupted baseline's: kills, corruption and preemption cost
+   recomputed steps, never a different model.
+2. ZERO REPEATED/SKIPPED BATCHES — the authoritative (step -> data
+   position) mapping is the identity over every step, reconstructed
+   across incarnations from the resumable-data state.
+3. COVERAGE — every training fault class actually fired.
+4. The guard skipped exactly the scheduled spikes (counted device-side,
+   survived checkpoint/restore).
+
+Reproducibility: the schedule is a pure function of the printed seed
+(KFTPU_RESILIENCE_SEED overrides), matching the chaos-soak convention.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.testing.chaos import (
+    TRAIN_FAULT_CLASSES,
+    TrainFaultSchedule,
+    apply_checkpoint_fault,
+)
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+WORKER = os.path.join(REPO, "tests", "e2e", "resilience_worker.py")
+
+DEFAULT_SEED = 20260804
+
+
+def _seed() -> int:
+    return int(os.environ.get("KFTPU_RESILIENCE_SEED") or DEFAULT_SEED)
+
+
+def _run_worker(
+    *, ckpt_dir, trace_file, incarnation, total_steps, save_interval,
+    seed, spikes, crash=None,
+) -> subprocess.CompletedProcess:
+    env = {
+        **os.environ,
+        "KFTPU_REPO": REPO,
+        "KFTPU_CKPT_DIR": str(ckpt_dir),
+        "KFTPU_TRACE_FILE": str(trace_file),
+        "KFTPU_INCARNATION": str(incarnation),
+        "KFTPU_TOTAL_STEPS": str(total_steps),
+        "KFTPU_SAVE_INTERVAL": str(save_interval),
+        "KFTPU_DATA_SEED": str(seed),
+        "KFTPU_SPIKE_STEPS": ",".join(str(s) for s in spikes),
+    }
+    env.pop("KFTPU_CRASH_STEP", None)
+    env.pop("KFTPU_CRASH_SIGNAL", None)
+    if crash is not None:
+        env["KFTPU_CRASH_STEP"] = str(crash.at_step)
+        env["KFTPU_CRASH_SIGNAL"] = crash.cls
+    return subprocess.run(
+        [sys.executable, WORKER], env=env, capture_output=True, text=True,
+        timeout=240,
+    )
+
+
+def _read_trace(trace_file) -> list[dict]:
+    with open(trace_file) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _final_summary(events: list[dict]) -> dict:
+    done = [e for e in events if e["event"] == "done"]
+    assert len(done) == 1, done
+    return done[0]
+
+
+def _run_soak(
+    tmp_path, seed: int, *, total_steps, save_interval, faults_per_class,
+    deadline,
+) -> dict:
+    repro = (
+        f"[resilience seed={seed}; reproduce with "
+        f"KFTPU_RESILIENCE_SEED={seed}]"
+    )
+    print(f"resilience soak starting {repro}")
+    schedule = TrainFaultSchedule(
+        seed, total_steps, save_interval=save_interval,
+        faults_per_class=faults_per_class,
+    )
+    # The repro contract itself: same seed -> identical plan.
+    assert TrainFaultSchedule(
+        seed, total_steps, save_interval=save_interval,
+        faults_per_class=faults_per_class,
+    ).plan == schedule.plan, repro
+    spikes = schedule.spike_steps
+    common = dict(
+        total_steps=total_steps, save_interval=save_interval,
+        seed=seed, spikes=spikes,
+    )
+
+    # -- uninterrupted baseline (same data, same spikes, no faults) -----
+    base_trace = tmp_path / "baseline.jsonl"
+    proc = _run_worker(
+        ckpt_dir=tmp_path / "ckpt-base", trace_file=base_trace,
+        incarnation=0, **common,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, repro)
+    baseline = _final_summary(_read_trace(base_trace))
+    assert baseline["skips"] == len(spikes), (baseline, repro)
+
+    # -- chaos run: one incarnation per crash fault, then completion ----
+    ckpt_dir = tmp_path / "ckpt"
+    trace_file = tmp_path / "chaos.jsonl"
+    t0 = time.monotonic()
+    incarnation = 0
+    crashes = list(schedule.crash_faults)
+    while True:
+        assert time.monotonic() - t0 < deadline, (
+            f"soak missed its deadline at incarnation {incarnation} "
+            f"{schedule} {repro}"
+        )
+        fault = crashes[incarnation] if incarnation < len(crashes) else None
+        proc = _run_worker(
+            ckpt_dir=ckpt_dir, trace_file=trace_file,
+            incarnation=incarnation, crash=fault, **common,
+        )
+        if fault is None:
+            assert proc.returncode == 0, (proc.stdout, proc.stderr, repro)
+            break
+        if fault.cls == "kill":
+            assert proc.returncode == -9, (
+                f"expected SIGKILL death at step {fault.at_step}, got rc="
+                f"{proc.returncode}", proc.stdout, proc.stderr, repro,
+            )
+        else:  # sigterm: fit must exit with the distinct Preempted result
+            assert proc.returncode == 75, (
+                f"expected Preempted exit (75) at step {fault.at_step}, "
+                f"got rc={proc.returncode}", proc.stdout, proc.stderr,
+                repro,
+            )
+        schedule.mark_injected(fault)
+        for storage in schedule.storage_after(incarnation):
+            desc = apply_checkpoint_fault(
+                ckpt_dir, storage.cls, offset=storage.offset
+            )
+            assert desc is not None, (
+                f"storage fault found nothing to damage: {storage} {repro}"
+            )
+            print(f"applied {desc} {repro}")
+            schedule.mark_injected(storage)
+        incarnation += 1
+    elapsed = time.monotonic() - t0
+
+    events = _read_trace(trace_file)
+    final = _final_summary(events)
+
+    # -- the guard skipped exactly the scheduled spikes -----------------
+    assert final["skips"] == len(spikes), (final, repro)
+    for fault in schedule.spike_faults:
+        schedule.mark_injected(fault)
+
+    # -- coverage gate: every training fault class actually fired -------
+    coverage = schedule.coverage()
+    assert all(coverage[c] >= 1 for c in TRAIN_FAULT_CLASSES), (
+        f"incomplete fault coverage: {coverage} {repro}"
+    )
+
+    # -- parity with the uninterrupted baseline -------------------------
+    np.testing.assert_allclose(
+        final["params_l1"], baseline["params_l1"], rtol=1e-6,
+        err_msg=f"final params diverged from the uninterrupted run {repro}",
+    )
+    np.testing.assert_allclose(
+        final["final_loss"], baseline["final_loss"], rtol=1e-5,
+        err_msg=f"final loss diverged from the uninterrupted run {repro}",
+    )
+
+    # -- zero repeated/skipped batches ----------------------------------
+    # Authoritative (step -> position): later incarnations overwrite the
+    # steps they legitimately redo after a rollback-to-checkpoint; the
+    # final mapping must be the identity (position p consumed by step p,
+    # each exactly once along the applied trajectory).
+    steps = [e for e in events if e["event"] == "step"]
+    mapping: dict[int, int] = {}
+    for e in steps:
+        mapping[e["step"]] = e["position"]
+    assert mapping == {s: s for s in range(1, total_steps + 1)}, (
+        f"batch sequence diverged (repeated or skipped data) {repro}: "
+        f"{sorted(set(range(1, total_steps + 1)) ^ set(mapping))[:10]}"
+    )
+    # Each resumed incarnation starts exactly one past its restore point
+    # (no silent fast-forward, no replay of applied steps).
+    boots: dict[int, float] = {}
+    first_step: dict[int, dict] = {}
+    last_step: dict[int, int] = {}
+    for e in events:
+        inc = e["incarnation"]
+        if e["event"] == "boot":
+            boots[inc] = e["t"]
+        elif e["event"] == "step":
+            first_step.setdefault(inc, e)
+            last_step[inc] = e["step"]
+    for inc in range(1, incarnation + 1):
+        assert first_step[inc]["step"] <= last_step[inc - 1] + 1, (
+            f"incarnation {inc} skipped ahead: first step "
+            f"{first_step[inc]['step']} after {last_step[inc - 1]} {repro}"
+        )
+
+    # -- resilience metrics ---------------------------------------------
+    executed = len(steps)
+    lost = executed - total_steps
+    kills = len(crashes)
+    recovery = [
+        first_step[inc]["t"] - boots[inc]
+        for inc in range(1, incarnation + 1)
+    ]
+    metrics = {
+        "seed": seed,
+        "goodput": total_steps / executed,
+        "steps_lost_per_kill": lost / kills,
+        "recovery_seconds": sum(recovery) / len(recovery),
+        "kills": kills,
+        "incarnations": incarnation + 1,
+        "elapsed_seconds": elapsed,
+        "coverage": coverage,
+    }
+    print(f"resilience soak converged: {json.dumps(metrics)} {repro}")
+    out = os.environ.get("KFTPU_RESILIENCE_METRICS")
+    if out:
+        with open(out, "w") as f:
+            json.dump(metrics, f)
+    return metrics
+
+
+def test_resilience_soak_kill_and_resume(tmp_path):
+    """Tier-1 soak: the full fault matrix at its smallest size, fixed
+    seed for determinism."""
+    metrics = _run_soak(
+        tmp_path, _seed(),
+        total_steps=32, save_interval=4, faults_per_class=1,
+        deadline=300.0,
+    )
+    assert 0.0 < metrics["goodput"] <= 1.0
+
+
+@pytest.mark.slow
+def test_resilience_soak_nightly(tmp_path):
+    """The long soak (`bench.py --workload resilience` / nightly CI): a
+    denser schedule over a longer run. Prints its seed so any failure
+    reproduces with KFTPU_RESILIENCE_SEED=<seed>."""
+    seed = int(
+        os.environ.get("KFTPU_RESILIENCE_SEED") or (time.time_ns() % 2**31)
+    )
+    _run_soak(
+        tmp_path, seed,
+        total_steps=80, save_interval=5, faults_per_class=2,
+        deadline=900.0,
+    )

@@ -47,8 +47,9 @@ def test_save_restore_roundtrip(trainer, data, tmp_path):
     assert ckpt.save(1, state, force=True)
     ckpt.wait()
 
-    restored, at = ckpt.restore_latest(trainer.abstract_state())
+    restored, at, data_state = ckpt.restore_latest(trainer.abstract_state())
     assert at == 1
+    assert data_state is None  # none was passed to save()
     assert int(restored.step) == 1
     _params_close(restored.params, state.params)
     _params_close(restored.opt_state, state.opt_state)
@@ -112,3 +113,250 @@ def test_fit_short_data_raises(trainer, tmp_path):
 
     with _pytest.raises(ValueError, match="exhausted"):
         fit(trainer, batches, total_steps=2, log_every=1)
+
+
+# -- restore_latest edge cases (ISSUE 5 satellite) -------------------------
+
+
+def _save_steps(trainer, data, tmp_path, steps, interval=1):
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    step_fn = trainer.make_train_step()
+    it = iter(data)
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=interval)
+    for s in range(1, max(steps) + 1):
+        state, _ = step_fn(state, next(it))
+        if s in steps:
+            ckpt.save(s, state, force=True, data_state={"position": s})
+    ckpt.wait()
+    ckpt.close()
+    return state
+
+
+def test_restore_latest_empty_directory(trainer, tmp_path):
+    ckpt = Checkpointer(tmp_path / "empty", save_interval_steps=1)
+    assert ckpt.restore_latest(trainer.abstract_state()) is None
+    ckpt.close()
+
+
+def test_restore_falls_back_past_corruption_and_resaves(
+    trainer, data, tmp_path
+):
+    """A flipped byte in the newest checkpoint: restore must verify,
+    QUARANTINE the bad step and fall back to the previous one — and a
+    later save at the quarantined step number must not collide with the
+    corpse."""
+    from kubeflow_tpu.testing.chaos import apply_checkpoint_fault
+
+    state = _save_steps(trainer, data, tmp_path, steps={1, 2, 3})
+    assert apply_checkpoint_fault(tmp_path / "ck", "corrupt_checkpoint")
+
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1)
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 2
+    assert restored.data_state == {"position": 2}
+    # The corpse is out of the numeric namespace, forensics preserved.
+    quarantined = [
+        p.name for p in (tmp_path / "ck").iterdir()
+        if p.name.startswith("corrupt-")
+    ]
+    assert quarantined == ["corrupt-3"]
+    # Re-saving step 3 after the fallback works (no StepAlreadyExists).
+    assert ckpt.save(3, state, force=True)
+    ckpt.wait()
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 3
+    ckpt.close()
+
+
+def test_restore_falls_back_on_garbled_manifest(trainer, data, tmp_path):
+    from kubeflow_tpu.testing.chaos import apply_checkpoint_fault
+
+    _save_steps(trainer, data, tmp_path, steps={1, 2})
+    assert apply_checkpoint_fault(tmp_path / "ck", "corrupt_manifest")
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1)
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 1
+    ckpt.close()
+
+
+def test_restore_missing_manifest_treated_as_torn_write(
+    trainer, data, tmp_path
+):
+    """A SIGKILL between orbax's commit and the manifest write leaves a
+    complete-looking step with no manifest: restore must treat it as
+    unverifiable and fall back — never load what it cannot certify."""
+    from kubeflow_tpu.train.checkpoint import MANIFEST_NAME
+
+    _save_steps(trainer, data, tmp_path, steps={1, 2})
+    ((tmp_path / "ck") / "2" / MANIFEST_NAME).unlink()
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1)
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 1
+    ckpt.close()
+
+
+def test_restore_survives_eviction_racing_it(trainer, data, tmp_path):
+    """max_to_keep retention in another process can delete the step a
+    restore just listed: a vanished step directory must fall back, not
+    crash (FileNotFoundError) — the same path corruption takes."""
+    import shutil
+
+    _save_steps(trainer, data, tmp_path, steps={1, 2, 3})
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1)
+    # The manager has listed the steps; now "another process" evicts
+    # the newest before the restore reads it.
+    assert ckpt.latest_step() == 3
+    shutil.rmtree(tmp_path / "ck" / "3")
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 2
+    ckpt.close()
+
+
+def test_read_only_restore_skips_without_quarantine(trainer, data, tmp_path):
+    """A restore-only consumer (serving) walking a live training dir
+    must never rename the writer's steps: invalid steps are skipped in
+    place — a committed save whose manifest is still in flight would
+    otherwise be destroyed by a racing reader."""
+    from kubeflow_tpu.train.checkpoint import MANIFEST_NAME
+
+    _save_steps(trainer, data, tmp_path, steps={1, 2})
+    # Simulate the writer's manifest still being in flight for step 2.
+    ((tmp_path / "ck") / "2" / MANIFEST_NAME).unlink()
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1,
+                        read_only=True)
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 1
+    # Step 2 is untouched on disk — nothing was renamed away.
+    assert (tmp_path / "ck" / "2").is_dir()
+    assert not [
+        p for p in (tmp_path / "ck").iterdir()
+        if p.name.startswith("corrupt-")
+    ]
+    ckpt.close()
+
+
+def test_vacuous_manifest_is_invalid_not_a_crash(trainer, data, tmp_path):
+    """A manifest certifying ZERO files (a manifest write that raced
+    eviction, or tampering) must fail verification and take the normal
+    quarantine-and-fall-back path — pre-fix it verified trivially and
+    the doomed orbax restore then crashed the whole resume."""
+    import json as json_mod
+
+    from kubeflow_tpu.train.checkpoint import MANIFEST_NAME
+
+    _save_steps(trainer, data, tmp_path, steps={1, 2})
+    (tmp_path / "ck" / "2" / MANIFEST_NAME).write_text(
+        json_mod.dumps({"version": 1, "files": {}, "data_state": None})
+    )
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1)
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 1
+    ckpt.close()
+
+
+def test_update_data_state_rewrites_manifest_in_place(
+    trainer, data, tmp_path
+):
+    """`update_data_state` swaps only the manifest's data_state (the
+    rollback-salt durability path): the step must still verify and
+    restore with the new state; a step without a manifest returns
+    False instead of inventing one."""
+    _save_steps(trainer, data, tmp_path, steps={1})
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1)
+    assert ckpt.update_data_state(1, {"position": 1, "salt": 7})
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 1
+    assert restored.data_state == {"position": 1, "salt": 7}
+    assert not ckpt.update_data_state(99, {"position": 0})
+    ckpt.close()
+
+
+def test_eviction_race_mid_checksum_is_not_a_durability_error(
+    trainer, data, tmp_path, monkeypatch
+):
+    """Retention eviction deletes files before the directory, so the
+    manifest worker can see FileNotFoundError on both attempts while
+    the step dir is still mid-rmtree. A vanished-file failure must be
+    treated as eviction in progress — never recorded as a manifest
+    error that makes a successful run's clean-exit wait() raise."""
+    import kubeflow_tpu.train.checkpoint as ckpt_mod
+
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1)
+    assert ckpt.save(1, state, force=True)
+    ckpt.wait()
+
+    def vanished(path):
+        raise FileNotFoundError(path)
+
+    monkeypatch.setattr(ckpt_mod, "_file_digest", vanished)
+    ckpt._enqueue_manifest(1, None)
+    ckpt.wait()  # must not raise
+    ckpt.close()
+
+
+def test_manifest_error_does_not_mask_inflight_exception(
+    trainer, data, tmp_path
+):
+    """fit()'s finally-block `checkpointer.wait()` can itself raise
+    (manifest write failures surface as RuntimeError): during an
+    exception unwind that must be demoted to a log line — a caller's
+    `except TrainingDiverged` (or resilience_worker's exit-code
+    mapping) must see the original exception, not a masking
+    RuntimeError. On a CLEAN exit the durability failure still raises:
+    a result claiming zero lost steps must not paper over an unsafe
+    save."""
+    from kubeflow_tpu.train import TrainingDiverged
+
+    class FailingManifests(Checkpointer):
+        # Inject the background error AFTER fit()'s initial
+        # restore_latest (whose own wait() would surface it too early).
+        def restore_latest(self, abstract_state):
+            restored = super().restore_latest(abstract_state)
+            self._manifest_errors.append(RuntimeError("boom"))
+            return restored
+
+    bad = next(iter(data))
+    bad = dict(bad, image=bad["image"] * jnp.nan)
+    ckpt = FailingManifests(tmp_path / "ck", save_interval_steps=100)
+    with pytest.raises(TrainingDiverged):
+        fit(trainer, [bad], total_steps=1, checkpointer=ckpt, log_every=1)
+    ckpt.close()
+
+    ckpt2 = FailingManifests(tmp_path / "ck2", save_interval_steps=100)
+    with pytest.raises(RuntimeError, match="manifest"):
+        fit(trainer, data, total_steps=1, checkpointer=ckpt2, log_every=1)
+    ckpt2.close()  # errors were surfaced and cleared by the wait above
+
+
+def test_read_only_is_actually_read_only(trainer, data, tmp_path):
+    """read_only must enforce what it documents: a mistyped directory
+    raises cleanly instead of mkdir-ing junk on the restore path, and
+    save() is refused — the flag covers writes, not just quarantine."""
+    with pytest.raises(FileNotFoundError, match="read_only"):
+        Checkpointer(tmp_path / "nope", read_only=True)
+    assert not (tmp_path / "nope").exists()
+
+    state = _save_steps(trainer, data, tmp_path, steps={1})
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=1,
+                        read_only=True)
+    assert not ckpt.should_save(2)
+    with pytest.raises(RuntimeError, match="read_only"):
+        ckpt.save(2, state, force=True)
+    # Restore still works — the flag only removes the write paths.
+    assert ckpt.restore_latest(trainer.abstract_state()).step == 1
+    ckpt.close()
+
+
+def test_restore_under_different_save_interval(trainer, data, tmp_path):
+    """Checkpoints saved at save_interval_steps=3 must restore under a
+    Checkpointer configured with a different interval (the interval is
+    a write-side policy, not part of the on-disk format)."""
+    _save_steps(trainer, data, tmp_path, steps={3, 6}, interval=3)
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=5)
+    restored = ckpt.restore_latest(trainer.abstract_state())
+    assert restored.step == 6
+    assert int(restored.state.step) == 6
+    # And the new interval governs subsequent writes.
+    assert not ckpt.should_save(7)
+    ckpt.close()

@@ -95,7 +95,7 @@ def test_cross_topology_checkpoint_restore(tmp_path):
     mesh_b = build_mesh(MeshSpec(dp=2), jax.devices()[:2])
     trainer_b = _trainer(mesh_b, fsdp_params=False, total_steps=10)
     ckpt_b = Checkpointer(tmp_path / "ckpt", save_interval_steps=100)
-    restored, at = ckpt_b.restore_latest(trainer_b.abstract_state())
+    restored, at, _ = ckpt_b.restore_latest(trainer_b.abstract_state())
     assert at == 6
     # Restored arrays live on mesh_b with the pure-DP (replicated) layout.
     stem = restored.params["conv_stem"]["kernel"]

@@ -1,0 +1,398 @@
+"""AnomalyGuard: device-side per-step screening, skip-not-crash, the
+never-persist-a-NaN regression, divergence rollback with seed
+perturbation, and preemption-safe exit (ISSUE 5)."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_tpu.testing import chaos
+from kubeflow_tpu.testing.tinymodels import TinyMLP
+from kubeflow_tpu.train import (
+    Checkpointer,
+    Preempted,
+    SyntheticImages,
+    TrainConfig,
+    Trainer,
+    TrainingDiverged,
+    fit,
+)
+from kubeflow_tpu.train.guard import AnomalyGuard, GuardConfig
+
+
+class PoisonedData(chaos.ResumableWrapper):
+    """Resumable wrapper over SyntheticImages that poisons scheduled
+    positions: `nan_at` positions yield NaN images, and (under salt 0)
+    every position >= `spike_from` yields hugely scaled images — a
+    sustained divergence that a seed perturbation (salt != 0) cures, so
+    rollback-with-perturbation is observable end to end."""
+
+    def __init__(self, inner, nan_at=(), spike_from=None, scale=1e3):
+        super().__init__(inner)
+        self.nan_at = frozenset(nan_at)
+        self.spike_from = spike_from
+        self.scale = scale
+
+    def transform(self, pos, batch):
+        salt = self.state_dict()["salt"]
+        if pos in self.nan_at:
+            return dict(batch, image=batch["image"] * jnp.nan)
+        if (
+            self.spike_from is not None
+            and pos >= self.spike_from
+            and salt == 0
+        ):
+            return dict(batch, image=batch["image"] * self.scale)
+        return batch
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+
+
+def _trainer(mesh, total_steps=16, **guard_kwargs):
+    guard = AnomalyGuard(GuardConfig(
+        ewma_alpha=0.2, warmup_steps=2, loss_spike_factor=3.0,
+        grad_spike_factor=6.0, max_consecutive_skips=3, **guard_kwargs,
+    ))
+    config = TrainConfig(
+        batch_size=4, learning_rate=0.05, warmup_steps=2,
+        total_steps=total_steps, fsdp_params=False, weight_decay=0.0,
+    )
+    return Trainer(
+        TinyMLP(), config, mesh, example_input_shape=(2, 8, 8, 3),
+        guard=guard,
+    )
+
+
+def _data(mesh, seed=0):
+    return SyntheticImages(
+        mesh, 4, image_size=8, num_classes=10, seed=seed, vary_per_step=True
+    )
+
+
+def _all_finite(tree) -> bool:
+    return all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+# -- guard unit behavior ----------------------------------------------------
+
+
+def test_guard_config_validation():
+    with pytest.raises(ValueError, match="spike factors"):
+        GuardConfig(loss_spike_factor=0.5)
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        GuardConfig(ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="max_consecutive_skips"):
+        GuardConfig(max_consecutive_skips=0)
+
+
+def test_guard_skips_nonfinite_and_spikes_updates_ewma_on_accept_only():
+    guard = AnomalyGuard(GuardConfig(
+        ewma_alpha=0.5, warmup_steps=1, loss_spike_factor=2.0,
+        max_consecutive_skips=2,
+    ))
+    g = guard.init_state()
+    # First observation seeds the EWMA and is accepted.
+    g, ok = guard.apply(g, jnp.float32(1.0), jnp.float32(1.0))
+    assert bool(ok) and float(g["ewma_loss"]) == 1.0
+    # Non-finite: skipped, EWMA untouched.
+    g, ok = guard.apply(g, jnp.float32(np.nan), jnp.float32(1.0))
+    assert not bool(ok)
+    assert float(g["ewma_loss"]) == 1.0 and int(g["skipped_total"]) == 1
+    # A spike (> 2x EWMA after warmup): skipped, EWMA untouched — the
+    # rejected value must not drag the baseline toward the anomaly.
+    g, ok = guard.apply(g, jnp.float32(10.0), jnp.float32(1.0))
+    assert not bool(ok) and float(g["ewma_loss"]) == 1.0
+    # Two consecutive skips = max_consecutive_skips: sticky divergence.
+    assert guard.diverged(g)
+    # An accepted step resets the consecutive counter but NOT the
+    # sticky flag (only a rollback, restoring pre-divergence guard
+    # state, clears it).
+    g, ok = guard.apply(g, jnp.float32(1.1), jnp.float32(1.0))
+    assert bool(ok) and int(g["consecutive_skips"]) == 0
+    assert guard.diverged(g)
+    # A non-finite UPDATE is rejected even when loss and grad-norm are
+    # finite (the overflow-to-inf-params hole): the trainer feeds the
+    # post-update params' finiteness through update_finite.
+    g, ok = guard.apply(
+        g, jnp.float32(1.0), jnp.float32(1.0),
+        update_finite=jnp.bool_(False),
+    )
+    assert not bool(ok)
+
+
+def test_negative_loss_objective_not_flagged_as_spike():
+    """The multiplicative spike test assumes a positive baseline: with
+    a negative accepted-loss EWMA (reward-style signed objectives) it
+    must disarm rather than flag every ordinary step — pre-fix the
+    threshold 2*(-1.0) sat below ANY loss, so a healthy run burned its
+    rollback budget and raised TrainingDiverged."""
+    guard = AnomalyGuard(GuardConfig(
+        ewma_alpha=0.5, warmup_steps=1, loss_spike_factor=2.0,
+        max_consecutive_skips=2,
+    ))
+    g = guard.init_state()
+    for loss in (-1.0, -0.9, -0.8):  # ordinary signed-objective descent
+        g, ok = guard.apply(g, jnp.float32(loss), jnp.float32(1.0))
+        assert bool(ok), loss
+    assert not guard.diverged(g)
+    # Finiteness screening still covers the disarmed regime.
+    g, ok = guard.apply(g, jnp.float32(np.nan), jnp.float32(1.0))
+    assert not bool(ok)
+
+
+def test_guarded_step_skips_poison_batch_without_touching_state(mesh1):
+    trainer = _trainer(mesh1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    step = trainer.make_train_step()
+    data = iter(_data(mesh1))
+    for _ in range(3):
+        state, metrics = step(state, next(data))
+    before = jax.tree_util.tree_map(np.asarray, state.params)
+    opt_before = jax.tree_util.tree_map(np.asarray, state.opt_state)
+    bad = next(data)
+    bad = dict(bad, image=bad["image"] * jnp.nan)
+    state, metrics = step(state, bad)
+    assert int(metrics["guard_ok"]) == 0
+    assert int(metrics["guard_skipped_total"]) == 1
+    # Step counter advanced (bookkeeping stays aligned)...
+    assert int(state.step) == 4
+    # ...but params AND optimizer state are bit-identical: the poison
+    # batch reached nothing.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(before),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(opt_before),
+        jax.tree_util.tree_leaves(state.opt_state),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    assert _all_finite(state.params)
+
+
+def test_nonfinite_bn_stats_update_is_rejected(mesh1):
+    """A zero-mean, huge-but-finite poison batch keeps loss, grads AND
+    post-update params finite (BatchNorm normalizes it away: rsqrt(inf)
+    = 0) while the f32 running-variance update overflows to inf — the
+    verdict must screen batch_stats too, or the inf rides into every
+    later checkpoint and breaks eval/serving (train=False)."""
+    import flax.linen as nn
+
+    class BNFirst(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(10)(x)
+
+    guard = AnomalyGuard(GuardConfig(
+        ewma_alpha=0.2, warmup_steps=2, loss_spike_factor=3.0,
+        grad_spike_factor=6.0, max_consecutive_skips=3,
+    ))
+    config = TrainConfig(
+        batch_size=8, learning_rate=0.05, warmup_steps=2,
+        total_steps=10, fsdp_params=False, weight_decay=0.0,
+    )
+    trainer = Trainer(
+        BNFirst(), config, mesh1, example_input_shape=(2, 8, 8, 3),
+        guard=guard,
+    )
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    step = trainer.make_train_step()
+    data = iter(SyntheticImages(
+        mesh1, 8, image_size=8, num_classes=10, vary_per_step=True
+    ))
+    for _ in range(3):
+        state, metrics = step(state, next(data))
+    before = jax.tree_util.tree_map(np.asarray, state.batch_stats)
+    # +c / -c across the batch: per-feature mean is exactly 0 (finite),
+    # mean-of-squares c^2 overflows f32 -> batch var = inf, normalized
+    # activations = (x - 0) * rsqrt(inf) = 0 -> finite loss and grads.
+    bad = next(data)
+    sign = jnp.where(jnp.arange(8) % 2 == 0, 1.0, -1.0)[:, None, None, None]
+    bad = dict(bad, image=jnp.broadcast_to(
+        sign * jnp.float32(2e19), bad["image"].shape
+    ))
+    state, metrics = step(state, bad)
+    # The trap this test pins: every scalar the OLD screen looked at is
+    # finite, so only the batch_stats check can reject the step.
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["grad_norm"]))
+    assert int(metrics["guard_ok"]) == 0
+    for a, b in zip(
+        jax.tree_util.tree_leaves(before),
+        jax.tree_util.tree_leaves(state.batch_stats),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    assert _all_finite(state.batch_stats)
+
+
+# -- the fit() gap regression (satellite 1) ---------------------------------
+
+
+def test_nan_at_non_log_step_never_persisted(mesh1, tmp_path):
+    """The seed loop checked finiteness only at log/save steps — a NaN
+    at step 3 with log_every=50 would poison every later checkpoint.
+    With the guard, EVERY step is screened device-side: the poison
+    update is skipped, and every checkpoint ever written restores to
+    fully finite state."""
+    trainer = _trainer(mesh1)
+    data = PoisonedData(_data(mesh1), nan_at=(2,))  # step 3's batch
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=5)
+    result = fit(
+        trainer, data, total_steps=10, checkpointer=ckpt, log_every=50
+    )
+    assert result.history[-1]["guard_skipped_total"] == 1
+    # EVERY persisted checkpoint — not just the newest — restores to
+    # fully finite state (each restored directly by step, bypassing
+    # restore_latest's newest-first shortcut).
+    import orbax.checkpoint as ocp
+
+    trainer_b = _trainer(mesh1)
+    steps = ckpt.all_steps()
+    assert steps, "expected checkpoints at the save interval"
+    with ocp.StandardCheckpointer() as sc:
+        for step in steps:
+            restored = sc.restore(
+                tmp_path / "ck" / str(step) / "default",
+                trainer_b.abstract_state(),
+            )
+            assert _all_finite(restored.params), step
+            assert _all_finite(restored.opt_state), step
+    ckpt.close()
+
+
+# -- divergence rollback (the tentpole's escape hatch) ----------------------
+
+
+def test_sustained_divergence_rolls_back_with_seed_perturbation(
+    mesh1, tmp_path
+):
+    """Under salt 0 every batch from position 6 on is poison: the guard
+    skips 3 in a row, flags divergence, and fit rolls back to the step-5
+    checkpoint AND perturbs the data seed — under salt 1 the same
+    positions are clean, so the run completes. The rollback is visible
+    in the result and the final state is finite."""
+    trainer = _trainer(mesh1)
+    data = PoisonedData(_data(mesh1), spike_from=6)
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=5)
+    result = fit(
+        trainer, data, total_steps=12, checkpointer=ckpt, log_every=1
+    )
+    ckpt.close()
+    assert result.rollbacks == 1
+    assert int(result.state.step) == 12
+    assert _all_finite(result.state.params)
+    # The perturbation moved the salt: the data sequence actually changed.
+    assert data.state_dict()["salt"] == 1
+    # And the salt is DURABLE: rollback rewrote the restored step's
+    # manifest data_state in place (still verifying), so a crash right
+    # after the rollback resumes onto the cured trajectory instead of
+    # replaying the diverged one.
+    from kubeflow_tpu.train.checkpoint import verify_manifest
+
+    manifest = verify_manifest(tmp_path / "ck" / "5")
+    assert manifest is not None
+    assert manifest["data_state"]["salt"] == 1
+    assert manifest["data_state"]["position"] == 5
+
+
+def test_rollback_refuses_fixed_stream_without_perturb(mesh1, tmp_path):
+    """A vary_per_step=False stream yields one cached batch forever, so
+    perturb() could change nothing: the stream does not offer it
+    (shadowed to None) and the rollback precondition refuses up front —
+    every retry would replay a byte-identical diverging trajectory."""
+    trainer = _trainer(mesh1)
+    fixed = SyntheticImages(
+        mesh1, 4, image_size=8, num_classes=10, vary_per_step=False
+    )
+    assert fixed.perturb is None
+    data = PoisonedData(fixed, spike_from=6)
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=5)
+    with pytest.raises(TrainingDiverged, match="perturbable"):
+        fit(trainer, data, total_steps=12, checkpointer=ckpt, log_every=1)
+    ckpt.close()
+
+
+def test_sustained_divergence_without_checkpoint_raises(mesh1):
+    trainer = _trainer(mesh1)
+    data = PoisonedData(_data(mesh1), spike_from=6)
+    with pytest.raises(TrainingDiverged, match="divergence"):
+        fit(trainer, data, total_steps=12, log_every=1)
+
+
+# -- preemption-safe exit ---------------------------------------------------
+
+
+def test_sigterm_returns_preempted_after_emergency_save(mesh1, tmp_path):
+    trainer = _trainer(mesh1)
+    data = _data(mesh1)
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=100)
+
+    def on_metrics(step, rec):
+        if step == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    result = fit(
+        trainer, data, total_steps=12, checkpointer=ckpt,
+        log_every=1, on_metrics=on_metrics,
+    )
+    assert isinstance(result, Preempted)
+    assert result.signum == signal.SIGTERM
+    # The emergency save landed at the boundary AFTER the in-flight
+    # step: zero lost work, data state included.
+    assert ckpt.latest_step() == 5
+    ckpt.close()
+
+    # Resume completes the run and continues the batch sequence exactly.
+    trainer_b = _trainer(mesh1)
+    data_b = _data(mesh1)
+    ckpt_b = Checkpointer(tmp_path / "ck", save_interval_steps=100)
+    result_b = fit(
+        trainer_b, data_b, total_steps=12, checkpointer=ckpt_b, log_every=1
+    )
+    ckpt_b.close()
+    assert not isinstance(result_b, Preempted)
+    assert result_b.resumed_from == 5
+    assert data_b.state_dict()["position"] == 12
+    assert int(result_b.state.step) == 12
+
+
+def test_resume_with_data_state_matches_uninterrupted(mesh1, tmp_path):
+    """Preempt-and-resume equals the uninterrupted run EXACTLY when the
+    data is per-position (the batch sequence neither repeats nor
+    skips): the strongest form of the parity the soak asserts."""
+    straight = fit(
+        _trainer(mesh1), _data(mesh1), total_steps=8, log_every=1
+    ).state
+
+    ckpt = Checkpointer(tmp_path / "ck", save_interval_steps=3)
+    fit(
+        _trainer(mesh1), _data(mesh1), total_steps=4,
+        checkpointer=ckpt, log_every=1,
+    )
+    ckpt.close()
+    ckpt2 = Checkpointer(tmp_path / "ck", save_interval_steps=3)
+    resumed = fit(
+        _trainer(mesh1), _data(mesh1), total_steps=8,
+        checkpointer=ckpt2, log_every=1,
+    )
+    ckpt2.close()
+    assert resumed.resumed_from == 4
+    for a, b in zip(
+        jax.tree_util.tree_leaves(straight.params),
+        jax.tree_util.tree_leaves(resumed.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
