@@ -1,0 +1,203 @@
+"""Per-step training anomaly guard: device-side, never-persist-a-NaN.
+
+The seed loop's divergence story was a host-side `check_finite` that ran
+only at log/save steps — a NaN at step 51 burned chips until step 100
+and the only remedy was an exception. This module is the production
+posture instead (PaLM-style loss-spike handling; `optax.apply_if_finite`
+generalized to spike detection):
+
+- EVERY step is screened on device: loss/grad-norm finiteness plus an
+  EWMA spike test. No per-step host sync — the verdict is a device
+  scalar that selects between the applied and skipped state inside the
+  jitted train step; the host reads the counters only when it already
+  reads metrics (log/save boundaries).
+- A bad step is SKIPPED, not fatal: params, optimizer state and BN
+  stats keep their pre-step values (the step counter still advances so
+  checkpoint/data bookkeeping stays aligned). One poison batch costs
+  one update, never the run.
+- Skips are bounded: `max_consecutive_skips` rejected steps in a row
+  flip a sticky `diverged` flag. The loop reacts by rolling back to the
+  last checkpoint with a seed perturbation (`train/loop.py`), because a
+  run that rejects everything is not training — it is diverged and
+  needs a different trajectory, not more skips.
+
+Guard state is a pytree of device scalars that rides INSIDE TrainState,
+so it is checkpointed and restored with the params: a resumed run
+remembers its skip counters, and a rollback resets them to the last
+good state's values for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Thresholds for the anomaly guard.
+
+    The spike tests compare each step's loss/grad-norm against an EWMA
+    of the ACCEPTED steps only (a skipped step must not drag the
+    baseline toward the anomaly it was rejected for).
+    """
+
+    # EWMA smoothing for the accepted-loss / accepted-grad-norm
+    # baselines. 0.05 ≈ a ~20-step memory: long enough to be stable,
+    # short enough to track warmup-phase loss drops.
+    ewma_alpha: float = 0.05
+    # Spike detection stays off until this many steps were ACCEPTED —
+    # the EWMA means nothing before it has data. Finiteness screening
+    # is always on, from step 0.
+    warmup_steps: int = 10
+    # Skip the update when loss > loss_spike_factor * ewma_loss +
+    # spike_slack. The multiplicative form is scale-free (works at CE≈7
+    # and CE≈0.7 alike) but assumes a POSITIVE baseline — with a
+    # non-positive EWMA (signed reward-style objectives) the spike test
+    # disarms rather than misfires. The additive slack keeps
+    # near-converged runs from flagging noise on a tiny positive
+    # baseline; it defaults to 0 (off) — set it when losses approach 0.
+    loss_spike_factor: float = 2.0
+    spike_slack: float = 0.0
+    # Same test for the global gradient norm — the earlier signal: a
+    # poison batch often shows a 100x grad-norm before the loss moves.
+    grad_spike_factor: float = 4.0
+    # Sticky divergence after this many consecutive skips: the loop
+    # rolls back to the last checkpoint (with a seed perturbation)
+    # instead of skipping forever.
+    max_consecutive_skips: int = 5
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got {self.ewma_alpha}")
+        if self.loss_spike_factor <= 1.0 or self.grad_spike_factor <= 1.0:
+            raise ValueError(
+                "spike factors must be > 1 (a factor <= 1 would flag "
+                f"ordinary steps): got loss={self.loss_spike_factor}, "
+                f"grad={self.grad_spike_factor}"
+            )
+        if self.max_consecutive_skips < 1:
+            raise ValueError(
+                f"max_consecutive_skips must be >= 1, got "
+                f"{self.max_consecutive_skips}"
+            )
+
+
+class AnomalyGuard:
+    """Device-side per-step screen: finiteness + EWMA spike detection.
+
+    Pure-functional: `init_state()` makes the scalar pytree,
+    `apply(gstate, loss, grad_norm)` returns `(new_gstate, ok)` and is
+    traced into the train step. The host-side helpers (`diverged`,
+    `skipped_total`) read device scalars — call them only where the
+    host already syncs (log/save boundaries), never per step.
+    """
+
+    def __init__(self, config: GuardConfig | None = None):
+        self.config = config or GuardConfig()
+
+    # -- device side (traced into the train step) --------------------------
+
+    def init_state(self) -> dict[str, jax.Array]:
+        return {
+            "ewma_loss": jnp.zeros((), jnp.float32),
+            "ewma_grad_norm": jnp.zeros((), jnp.float32),
+            "accepted": jnp.zeros((), jnp.int32),
+            "consecutive_skips": jnp.zeros((), jnp.int32),
+            "skipped_total": jnp.zeros((), jnp.int32),
+            "diverged": jnp.zeros((), jnp.int32),
+        }
+
+    def apply(
+        self,
+        gstate: dict,
+        loss: jax.Array,
+        grad_norm: jax.Array,
+        update_finite: jax.Array | None = None,
+    ) -> tuple[dict, jax.Array]:
+        """One step's verdict. Returns (new_gstate, ok) where `ok` is a
+        device bool scalar: True = apply the update, False = skip it.
+
+        `update_finite` is the finiteness of the UPDATED state itself
+        (the trainer passes an isfinite reduction over the post-update
+        params): a finite loss and grad-norm do not guarantee the
+        applied step stays finite — e.g. a huge-but-finite warmup
+        gradient can overflow a parameter to inf — and an accepted
+        overflow would poison every later checkpoint. Screening the
+        update closes that hole at the verdict."""
+        cfg = self.config
+        loss = loss.astype(jnp.float32)
+        grad_norm = grad_norm.astype(jnp.float32)
+
+        finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+        if update_finite is not None:
+            finite = finite & update_finite
+        warm = gstate["accepted"] >= cfg.warmup_steps
+        # The multiplicative test only means anything against a POSITIVE
+        # baseline: with ewma <= 0 (a reward-style signed objective, or
+        # a degenerate all-zero grad norm) the threshold factor*ewma
+        # would sit below every ordinary step and flag all of them — so
+        # the spike test disarms there instead of misfiring (finiteness
+        # screening still covers those runs; set spike_slack > 0 for an
+        # additive threshold that works near zero).
+        loss_spike = warm & (gstate["ewma_loss"] > 0) & (
+            loss > cfg.loss_spike_factor * gstate["ewma_loss"] + cfg.spike_slack
+        )
+        grad_spike = warm & (gstate["ewma_grad_norm"] > 0) & (
+            grad_norm
+            > cfg.grad_spike_factor * gstate["ewma_grad_norm"] + cfg.spike_slack
+        )
+        ok = finite & ~loss_spike & ~grad_spike
+
+        # The EWMA advances on accepted steps only, seeded by the first
+        # accepted observation (an average that starts at 0 would flag
+        # step warmup_steps+1 as a spike against a near-zero baseline).
+        a = jnp.float32(cfg.ewma_alpha)
+        first = gstate["accepted"] == 0
+        upd_loss = jnp.where(
+            first, loss, (1.0 - a) * gstate["ewma_loss"] + a * loss
+        )
+        upd_gnorm = jnp.where(
+            first, grad_norm, (1.0 - a) * gstate["ewma_grad_norm"] + a * grad_norm
+        )
+        oki = ok.astype(jnp.int32)
+        consecutive = jnp.where(ok, 0, gstate["consecutive_skips"] + 1)
+        new_state = {
+            "ewma_loss": jnp.where(ok, upd_loss, gstate["ewma_loss"]),
+            "ewma_grad_norm": jnp.where(ok, upd_gnorm, gstate["ewma_grad_norm"]),
+            "accepted": gstate["accepted"] + oki,
+            "consecutive_skips": consecutive,
+            "skipped_total": gstate["skipped_total"] + (1 - oki),
+            # Sticky: once diverged, stays diverged until the loop rolls
+            # back (restoring the pre-divergence guard state with it).
+            "diverged": jnp.maximum(
+                gstate["diverged"],
+                (consecutive >= cfg.max_consecutive_skips).astype(jnp.int32),
+            ),
+        }
+        return new_state, ok
+
+    def metrics(self, gstate: dict, ok: jax.Array, grad_norm: jax.Array) -> dict:
+        """Device-scalar metric entries for the step's metrics dict —
+        fetched by the host only at its existing log/save boundaries."""
+        return {
+            "grad_norm": grad_norm,
+            "guard_ok": ok.astype(jnp.int32),
+            "guard_skipped_total": gstate["skipped_total"],
+            "guard_consecutive_skips": gstate["consecutive_skips"],
+            "guard_diverged": gstate["diverged"],
+        }
+
+    # -- host side (boundary-only reads) -----------------------------------
+
+    @staticmethod
+    def diverged(gstate: Any) -> bool:
+        """Host-sync read of the sticky divergence flag. Boundary-only."""
+        return bool(int(gstate["diverged"]))
+
+    @staticmethod
+    def skipped_total(gstate: Any) -> int:
+        return int(gstate["skipped_total"])
