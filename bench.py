@@ -1131,6 +1131,109 @@ def bench_controlplane(args) -> None:
             file=sys.stderr,
         )
 
+    _bench_controlplane_failover(args)
+
+
+def _bench_controlplane_failover(args) -> None:
+    """The failover row: run the seeded apiserver-kill soak (`tests/e2e/
+    test_apiserver_failover_e2e.py::test_failover_soak_nightly` — an HA
+    facade pair over one durable state dir, SIGKILLed on an
+    `apiserver_kill` fault plan under continuous writer load) and
+    publish worst-case takeover seconds vs the BASELINE ceiling, plus a
+    hard zero-acked-writes-lost gate. Same repro contract as the other
+    soaks: the seed is chosen here, printed up front AND on failure, and
+    KFTPU_FAILOVER_SEED=<seed> replays the identical kill schedule."""
+    import os
+    import random
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if args.chaos_seed is not None:
+        seed = args.chaos_seed
+    elif os.environ.get("KFTPU_FAILOVER_SEED"):
+        seed = int(os.environ["KFTPU_FAILOVER_SEED"])
+    else:
+        seed = random.randrange(2**31)
+    print(f"# failover soak seed={seed}", file=sys.stderr)
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        metrics_path = f.name
+    try:
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "pytest",
+                "tests/e2e/test_apiserver_failover_e2e.py::"
+                "test_failover_soak_nightly",
+                "-q", "-rs", "-p", "no:cacheprovider", "-p", "no:randomly",
+            ],
+            cwd=repo,
+            env={
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "KFTPU_FAILOVER_SEED": str(seed),
+                "KFTPU_FAILOVER_METRICS": metrics_path,
+            },
+            capture_output=True,
+            text=True,
+        )
+        elapsed = time.perf_counter() - t0
+        sys.stderr.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            # The zero-loss gate lives in the soak's own asserts; its
+            # failure arrives here as the exit code. The soak writes the
+            # metrics file BEFORE gating, so a red run can still say
+            # what it measured.
+            lost = ""
+            try:
+                with open(metrics_path) as f:
+                    lost = (
+                        f" ({json.load(f)['acked_lost']} acked writes "
+                        "lost)"
+                    )
+            except (OSError, ValueError, KeyError):
+                pass
+            print(
+                f"# failover soak FAILED{lost} (seed {seed}) — reproduce "
+                f"the exact kill schedule with:\n"
+                f"#   KFTPU_FAILOVER_SEED={seed} python bench.py "
+                f"--workload controlplane --chaos-seed {seed}",
+                file=sys.stderr,
+            )
+            raise SystemExit(proc.returncode)
+        with open(metrics_path) as f:
+            m = json.load(f)
+    finally:
+        try:
+            os.unlink(metrics_path)
+        except OSError:
+            pass
+    base = _published_baseline("controlplane_failover_seconds")
+    value = round(m["failover_seconds_max"], 2)
+    print(
+        json.dumps(
+            {
+                "metric": "controlplane_failover_seconds",
+                "value": value,
+                "unit": (
+                    f"seconds, worst of {m['kills']} SIGKILLs of the "
+                    f"active facade (lease TTL "
+                    f"{m['lease_ttl_seconds']}s; lower is better; "
+                    f"{m['acked_writes']} acked writes, 0 lost)"
+                ),
+                "vs_baseline": round(value / base, 4) if base else None,
+            }
+        )
+    )
+    print(
+        f"# failover: worst takeover {value}s, mean "
+        f"{m['failover_seconds_mean']:.2f}s over {m['kills']} kills in "
+        f"{elapsed:.1f}s (seed {seed}, 0/{m['acked_writes']} acked "
+        "writes lost)",
+        file=sys.stderr,
+    )
+
 
 def bench_study(args) -> None:
     """HP-sweep throughput (BASELINE.md row "Katib StudyJob"): trials/hour

@@ -26,7 +26,10 @@ import sys
 import yaml
 
 from kubeflow_tpu.api.objects import Resource, container_limits_total
-from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+from kubeflow_tpu.testing.apiserver_http import (
+    HttpApiClient,
+    endpoints_from_env,
+)
 from kubeflow_tpu.testing.fake_apiserver import (
     AlreadyExists,
     ApiError,
@@ -526,7 +529,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--server",
         default=os.environ.get("KFTPU_SERVER", DEFAULT_SERVER),
-        help="apiserver facade URL (env KFTPU_SERVER)",
+        help="apiserver facade URL, or a comma-separated endpoint "
+        "list for an active-passive HA pair (env KFTPU_SERVER)",
     )
     parser.add_argument(
         "--token",
@@ -598,7 +602,9 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
     try:
-        client = HttpApiClient(args.server, token=args.token, ca=args.ca)
+        client = HttpApiClient(
+            endpoints_from_env(args.server), token=args.token, ca=args.ca
+        )
     except ValueError as e:  # e.g. token-over-plaintext refusal
         print(f"error: {e}", file=sys.stderr)
         return 1

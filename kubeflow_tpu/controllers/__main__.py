@@ -50,13 +50,18 @@ CONTROLLERS = {
 
 def main(argv: list[str] | None = None) -> int:
     from kubeflow_tpu.controllers.leader import LeaderElector
-    from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+    from kubeflow_tpu.testing.apiserver_http import (
+        HttpApiClient,
+        endpoints_from_env,
+    )
     from kubeflow_tpu.utils import signals as sigutil
 
     parser = argparse.ArgumentParser(prog="kubeflow-tpu-controllers")
     parser.add_argument(
         "--apiserver", required=True,
-        help="facade URL (token via KFTPU_TOKEN, CA via KFTPU_CA)",
+        help="facade URL, or a comma-separated endpoint list for an "
+        "active-passive HA pair (token via KFTPU_TOKEN, CA via "
+        "KFTPU_CA)",
     )
     parser.add_argument(
         "--controllers", default=",".join(CONTROLLERS),
@@ -106,7 +111,7 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     client = HttpApiClient(
-        args.apiserver,
+        endpoints_from_env(args.apiserver),
         watch_poll_timeout=2.0,
         watch_retry=0.1,
         write_retries=args.write_retries,

@@ -88,10 +88,17 @@ class TpuJobController:
         metrics: MetricsRegistry | None = None,
         scheduler=None,
         quota_retry_seconds: float = 10.0,
+        preempt_stall=None,
     ):
         self.api = api
         self._scheduler_factory = scheduler
         self._quota_retry_seconds = quota_retry_seconds
+        # Chaos seam (tests/e2e/test_ha_preemption_e2e.py): called after
+        # the victims are evicted, before the preemptor's requeue-and-
+        # place — the widest-impact window for a leader to die in. The
+        # HA × preemption e2e stalls here and kills/SIGSTOPs the leader;
+        # production never sets it.
+        self._preempt_stall = preempt_stall
         metrics = metrics or MetricsRegistry()
         self.jobs_running = metrics.gauge(
             "tpujob_running", "TpuJobs currently running"
@@ -475,6 +482,10 @@ class TpuJobController:
             f"evicted {len(victims)} gang(s) "
             f"({sum(held_by_gang.get(g, 0) for g in excluded)} chips)",
         )
+        if self._preempt_stall is not None:
+            # Victims evicted, preemptor not yet placed: the e2e's
+            # leader-death window.
+            self._preempt_stall()
         return True
 
     # -- reconcile --------------------------------------------------------

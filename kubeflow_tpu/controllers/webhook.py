@@ -111,15 +111,19 @@ def make_webhook_config(
 
 def main(argv: list[str] | None = None) -> int:
     """The PodDefault webhook binary (`main.go:597` analog)."""
-    from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+    from kubeflow_tpu.testing.apiserver_http import (
+        HttpApiClient,
+        endpoints_from_env,
+    )
     from kubeflow_tpu.web import tls as tlsmod
     from kubeflow_tpu.web.wsgi import serve
 
     parser = argparse.ArgumentParser(prog="kubeflow-tpu-webhook")
     parser.add_argument(
         "--apiserver", required=True,
-        help="facade URL for reading PodDefault CRs (token via "
-        "KFTPU_TOKEN, CA via KFTPU_CA — the launcher env contract)",
+        help="facade URL — or comma-separated HA endpoint list — for "
+        "reading PodDefault CRs (token via KFTPU_TOKEN, CA via "
+        "KFTPU_CA — the launcher env contract)",
     )
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
@@ -151,7 +155,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    client = HttpApiClient(args.apiserver)
+    client = HttpApiClient(endpoints_from_env(args.apiserver))
 
     def mutate(obj: Resource, operation: str) -> Resource:
         # Same semantics as the in-process hook, but the PodDefault

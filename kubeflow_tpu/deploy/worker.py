@@ -29,7 +29,10 @@ import time
 from kubeflow_tpu.deploy.apply import apply_platform, retry_rmw
 from kubeflow_tpu.deploy.kfdef import PlatformSpec
 from kubeflow_tpu.deploy.provisioner import FakeCloud
-from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+from kubeflow_tpu.testing.apiserver_http import (
+    HttpApiClient,
+    endpoints_from_env,
+)
 from kubeflow_tpu.testing.fake_apiserver import NotFound
 
 log = logging.getLogger(__name__)
@@ -86,7 +89,10 @@ def reconcile_once(client: HttpApiClient, name: str, args) -> bool:
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="kubeflow-tpu-deploy-worker")
-    parser.add_argument("--apiserver", required=True)
+    parser.add_argument(
+        "--apiserver", required=True,
+        help="facade URL, or comma-separated HA endpoint list",
+    )
     parser.add_argument("--name", required=True)
     parser.add_argument("--poll", type=float, default=0.2,
                         help="seconds between CR checks")
@@ -97,7 +103,7 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
-    client = HttpApiClient(args.apiserver)
+    client = HttpApiClient(endpoints_from_env(args.apiserver))
     print("worker ready", flush=True)
     while True:
         try:

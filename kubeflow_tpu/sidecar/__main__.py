@@ -31,8 +31,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--apiserver",
         default=None,
-        help="API server base URL to watch the TpuJob phase "
-        "(e.g. http://apiserver:8001)",
+        help="API server base URL — or comma-separated HA endpoint "
+        "list — to watch the TpuJob phase (e.g. http://apiserver:8001)",
     )
     parser.add_argument("--results", default=None)
     parser.add_argument("--artifacts", default=None)
@@ -48,9 +48,12 @@ def main(argv: list[str] | None = None) -> int:
 
     api = None
     if args.apiserver:
-        from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+        from kubeflow_tpu.testing.apiserver_http import (
+            HttpApiClient,
+            endpoints_from_env,
+        )
 
-        api = HttpApiClient(args.apiserver)
+        api = HttpApiClient(endpoints_from_env(args.apiserver))
 
     controller = SidecarController(
         workdir=args.workdir,

@@ -658,6 +658,49 @@ class CircuitBreaker:
             )
 
 
+def endpoints_from_env(value: str) -> list[str]:
+    """Parse the launcher env contract's apiserver address: a single URL
+    or a comma-separated endpoint list (active-passive HA pairs). Every
+    e2e worker builds its client from this, so a worker spawned against
+    one facade today transparently gains failover the day its env grows
+    a second endpoint."""
+    urls = [u.strip() for u in value.split(",") if u.strip()]
+    if not urls:
+        raise ValueError(f"no apiserver endpoints in {value!r}")
+    return urls
+
+
+class _Endpoint:
+    """One apiserver address an `HttpApiClient` may talk to: parsed
+    location plus this endpoint's own keep-alive connection pool and
+    handshake counter. Circuit breakers are also per-endpoint (keyed by
+    `_breaker_for`), so one dead facade's open circuits never gate its
+    standby."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        parts = urllib.parse.urlsplit(self.url)
+        self.host = parts.hostname or "127.0.0.1"
+        self.https = parts.scheme == "https"
+        self.port = parts.port or (443 if self.https else 80)
+        self.pool: list = []
+        self.handshakes = 0
+
+    def __repr__(self) -> str:
+        return f"_Endpoint({self.url!r})"
+
+
+class _ConnectFailed(Exception):
+    """Dialing an endpoint failed before ANY request byte was sent — the
+    one transport failure that is unambiguous for every method (the
+    server cannot have committed anything), so the client may rotate to
+    the next endpoint and replay even a write."""
+
+    def __init__(self, cause: OSError):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
 class HttpApiClient:
     """Remote twin of FakeApiServer's CRUD + watch surface.
 
@@ -666,11 +709,27 @@ class HttpApiClient:
     last seen resourceVersion across reconnects and recovering from 410
     Gone via list-then-rewatch (synthetic MODIFIED events). A
     `controllers/runtime.Controller` built over this client is therefore
-    event-driven across the process boundary — zero list polling."""
+    event-driven across the process boundary — zero list polling.
+
+    `base_url` may be an endpoint LIST (active-passive HA: the kube
+    client's multi-master server list). The client talks to one
+    endpoint at a time and fails over — sticky, so one takeover costs
+    one rotation, not a probe per request — when that endpoint refuses
+    connections, when its circuit is open (repeated failures shed to
+    the next endpoint instead of failing fast into the caller), or when
+    a watch stream dies with it. Only a CONNECT failure may transparently
+    re-send a write to the next endpoint (nothing was sent, so nothing
+    can double-apply); once bytes are on the wire the usual ambiguous-
+    failure rules apply unchanged. Watchers resuming on the standby ride
+    the normal bookmark path: a bookmark the standby's journal can't
+    serve gets 410 Gone and the informer relists — duplicate-free for
+    level-triggered consumers by construction. A single-element list (or
+    a plain string) behaves exactly like the historical single
+    `base_url`."""
 
     def __init__(
         self,
-        base_url: str,
+        base_url,
         timeout: float = 10.0,
         watch_poll_timeout: float = 5.0,
         watch_retry: float = 0.5,
@@ -686,7 +745,16 @@ class HttpApiClient:
         stream_degraded_seconds: float = 5.0,
         stream_reprobe_seconds: float = 60.0,
     ):
-        self.base_url = base_url.rstrip("/")
+        urls = [base_url] if isinstance(base_url, str) else list(base_url)
+        if not urls:
+            raise ValueError("HttpApiClient needs at least one endpoint")
+        self._endpoints = [_Endpoint(u) for u in urls]
+        # Which endpoint serves requests right now. Failover is sticky:
+        # a rotation moves every subsequent request (and the watch
+        # stream) to the new endpoint until IT fails in turn.
+        self._active = 0
+        self._endpoint_lock = threading.Lock()
+        self.failovers = 0  # observability: endpoint rotations performed
         # The identity credential (serviceaccount-token analog). Falls
         # back to KFTPU_TOKEN so gang workers spawned with the launcher
         # env contract inherit their pod's credential without plumbing.
@@ -695,10 +763,12 @@ class HttpApiClient:
         )
         # TLS: pin the platform CA (env fallback KFTPU_CA rides the same
         # launcher env contract as the token). Verification is against
-        # the pinned CA only — never the system trust store.
+        # the pinned CA only — never the system trust store. One context
+        # serves every https endpoint: an HA pair shares the platform CA
+        # (the standby boots over the same state dir's TLS material).
         ca = ca if ca is not None else os.environ.get("KFTPU_CA")
         self._ssl = None
-        if self.base_url.startswith("https:"):
+        if any(ep.https for ep in self._endpoints):
             from kubeflow_tpu.web import tls as tlsmod
 
             if ca:
@@ -714,16 +784,19 @@ class HttpApiClient:
                 # request would die later with an opaque
                 # CERTIFICATE_VERIFY_FAILED. Fail actionably, now.
                 raise ValueError(
-                    f"https server {self.base_url!r} needs the platform "
-                    "CA pinned (ca=/--ca/KFTPU_CA; the launcher prints "
-                    "the path at boot), or KFTPU_SYSTEM_TRUST=1 for a "
-                    "publicly-signed endpoint"
+                    f"https server {self._endpoints[0].url!r} needs the "
+                    "platform CA pinned (ca=/--ca/KFTPU_CA; the launcher "
+                    "prints the path at boot), or KFTPU_SYSTEM_TRUST=1 "
+                    "for a publicly-signed endpoint"
                 )
-        elif self.token:
+        plaintext = [ep.url for ep in self._endpoints if not ep.https]
+        if plaintext and self.token:
             # A bearer token over cleartext is a leaked credential, not a
             # working config: refuse unless the caller explicitly opts
             # in (loopback-only test rigs; KFTPU_ALLOW_PLAINTEXT=1 for
-            # spawned workers). Secure-by-default, like the serving side.
+            # spawned workers). Secure-by-default, like the serving
+            # side — and EVERY endpoint must qualify, or a failover
+            # would leak the token the primary protected.
             if allow_plaintext_token is None:
                 allow_plaintext_token = os.environ.get(
                     "KFTPU_ALLOW_PLAINTEXT"
@@ -731,7 +804,7 @@ class HttpApiClient:
             if not allow_plaintext_token:
                 raise ValueError(
                     f"refusing to send a bearer token over plaintext "
-                    f"{self.base_url!r} — use https:// (pin the CA via "
+                    f"{plaintext[0]!r} — use https:// (pin the CA via "
                     f"ca=/KFTPU_CA) or pass allow_plaintext_token=True / "
                     f"KFTPU_ALLOW_PLAINTEXT=1 for a trusted loopback"
                 )
@@ -742,20 +815,14 @@ class HttpApiClient:
         self._watch_lock = threading.Lock()
         self._watch_thread: threading.Thread | None = None
         self._closed = threading.Event()
-        # Persistent-connection pool (the client-go shared-transport
+        # Persistent-connection pools (the client-go shared-transport
         # analog): requests ride keep-alive connections, so a client
         # pays O(1) TCP+TLS handshakes for its whole request train
         # instead of one per request. `handshakes` counts connections
-        # dialed — the load test pins it flat while requests grow.
-        parts = urllib.parse.urlsplit(self.base_url)
-        self._conn_host = parts.hostname or "127.0.0.1"
-        self._conn_port = parts.port or (
-            443 if parts.scheme == "https" else 80
-        )
-        self._conn_https = parts.scheme == "https"
-        self._pool: list = []
+        # dialed — the load test pins it flat while requests grow. The
+        # pool is per-endpoint (each keep-alive connection belongs to
+        # the facade that accepted it).
         self._pool_lock = threading.Lock()
-        self.handshakes = 0
         # Leader-election write fencing: when armed (set_lease_guard),
         # every write carries the guard and the server rejects it with
         # Conflict unless the lease still shows this holder+generation.
@@ -804,23 +871,67 @@ class HttpApiClient:
     # runs one watch stream + a few concurrent reconcile threads).
     POOL_SIZE = 4
 
-    def _new_conn(self):
+    # -- endpoint selection (active-passive failover) ----------------------
+
+    def _endpoint(self) -> _Endpoint:
+        with self._endpoint_lock:
+            return self._endpoints[self._active]
+
+    @property
+    def base_url(self) -> str:
+        """The endpoint currently serving this client (back-compat: the
+        historical single-URL attribute, now the ACTIVE endpoint)."""
+        return self._endpoint().url
+
+    @property
+    def endpoints(self) -> tuple[str, ...]:
+        return tuple(ep.url for ep in self._endpoints)
+
+    @property
+    def handshakes(self) -> int:
+        """Connections dialed, summed over endpoints (the load test pins
+        this flat while requests grow)."""
+        return sum(ep.handshakes for ep in self._endpoints)
+
+    # Back-compat introspection (tests dial raw sockets at the client's
+    # target): the ACTIVE endpoint's location.
+    @property
+    def _conn_host(self) -> str:
+        return self._endpoint().host
+
+    @property
+    def _conn_port(self) -> int:
+        return self._endpoint().port
+
+    def _set_active(self, ep: _Endpoint) -> None:
+        """Make `ep` the endpoint subsequent requests go to first.
+        Counted as a failover only when it actually changes — rotation
+        is sticky, so a takeover costs one rotation, not one per call."""
+        with self._endpoint_lock:
+            idx = self._endpoints.index(ep)
+            if idx != self._active:
+                self._active = idx
+                self.failovers += 1
+                log.info("apiserver failover: now talking to %s", ep.url)
+
+    def _new_conn(self, ep: _Endpoint):
         import http.client as _hc
 
-        if self._conn_https:
+        if ep.https:
             conn = _hc.HTTPSConnection(
-                self._conn_host,
-                self._conn_port,
+                ep.host,
+                ep.port,
                 timeout=self.timeout,
                 context=self._ssl,
             )
         else:
             conn = _hc.HTTPConnection(
-                self._conn_host, self._conn_port, timeout=self.timeout
+                ep.host, ep.port, timeout=self.timeout
             )
         conn._kftpu_reused = False
+        conn._kftpu_ep = ep
         with self._pool_lock:
-            self.handshakes += 1
+            ep.handshakes += 1
         return conn
 
     # Discard pooled connections idle longer than this (below the
@@ -829,32 +940,65 @@ class HttpApiClient:
     # otherwise force ambiguous write retries).
     POOL_IDLE_MAX = 60.0
 
-    def _get_conn(self):
+    def _get_conn(self, ep: _Endpoint | None = None):
         import time as _time
 
+        ep = ep if ep is not None else self._endpoint()
         now = _time.monotonic()
         with self._pool_lock:
-            while self._pool:
-                conn = self._pool.pop()
+            while ep.pool:
+                conn = ep.pool.pop()
                 if now - getattr(conn, "_kftpu_idle_since", now) \
                         <= self.POOL_IDLE_MAX:
                     return conn
                 conn.close()  # probably server-reaped already
-        return self._new_conn()
+        return self._new_conn(ep)
 
     def _put_conn(self, conn) -> None:
         import time as _time
 
+        ep = getattr(conn, "_kftpu_ep", None) or self._endpoint()
         conn._kftpu_reused = True
         conn._kftpu_idle_since = _time.monotonic()
         # Restore the default op timeout (a stream may have raised it).
         if conn.sock is not None:
             conn.sock.settimeout(self.timeout)
         with self._pool_lock:
-            if len(self._pool) < self.POOL_SIZE:
-                self._pool.append(conn)
+            if len(ep.pool) < self.POOL_SIZE:
+                ep.pool.append(conn)
                 return
         conn.close()
+
+    def _attempt(self, ep: _Endpoint, method, path, data, headers):
+        """One round trip against ONE endpoint. A dial failure (nothing
+        sent yet) raises `_ConnectFailed` so the caller may rotate; any
+        failure after bytes hit the wire keeps the historical ambiguity
+        rules (reused-GET retries once on a fresh connection, everything
+        else propagates)."""
+        import http.client as _hc
+
+        while True:
+            conn = self._get_conn(ep)
+            if conn.sock is None:
+                # Dial explicitly, so a refused/unreachable endpoint is
+                # distinguishable from a request that died mid-flight —
+                # the distinction that makes endpoint rotation safe for
+                # writes.
+                try:
+                    conn.connect()
+                except OSError as e:
+                    conn.close()
+                    raise _ConnectFailed(e) from e
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+            except (_hc.HTTPException, OSError):
+                reused = getattr(conn, "_kftpu_reused", False)
+                conn.close()
+                if reused and method == "GET":
+                    continue  # stale keep-alive victim: one fresh retry
+                raise
+            return conn, resp
 
     def _request_raw(
         self, method: str, path: str, body: dict | None = None
@@ -862,17 +1006,23 @@ class HttpApiClient:
         """One round trip on a pooled connection; returns (conn, resp)
         with the response UNREAD (callers stream or slurp).
 
+        Endpoint walk: starting at the active endpoint, skip endpoints
+        whose circuit is open (shed to the standby instead of failing
+        fast into the caller) and rotate past endpoints that refuse the
+        dial; the endpoint that answers becomes the active one. With a
+        single endpoint this degenerates to exactly the historical
+        behavior (breaker-open → Unavailable, dial failure → OSError).
+
         Retry policy (the urllib3 rule): only IDEMPOTENT-safe requests
         (GET) auto-retry when a REUSED connection dies — for a write,
         the failure is ambiguous (the server may have committed before
         the connection broke) and a blind replay could double-apply, so
         writes propagate the error and the caller's level-triggered
-        retry re-reads state first. The stale-connection window writes
-        would otherwise hit is mostly closed by POOL_IDLE_MAX reaping
-        pooled connections before the server's keep-alive timeout can.
-        A fresh-connection failure is real and always propagates."""
-        import http.client as _hc
-
+        retry re-reads state first. A CONNECT failure is the exception:
+        nothing was sent, so trying the next endpoint is safe for every
+        method. The stale-connection window writes would otherwise hit
+        is mostly closed by POOL_IDLE_MAX reaping pooled connections
+        before the server's keep-alive timeout can."""
         data = json.dumps(body).encode() if body is not None else None
         headers = {
             "Content-Type": "application/json",
@@ -884,18 +1034,35 @@ class HttpApiClient:
         guard = self.lease_guard
         if guard is not None and method in ("POST", "PUT", "DELETE", "PATCH"):
             headers["X-Kftpu-Lease-Guard"] = json.dumps(list(guard))
-        while True:
-            conn = self._get_conn()
+        eps = self._endpoints
+        with self._endpoint_lock:
+            start = self._active
+        last_exc: Exception | None = None
+        for k in range(len(eps)):
+            ep = eps[(start + k) % len(eps)]
+            breaker = self._breaker_for(ep, method, path)
+            if not breaker.allow():
+                # Open circuit: shed to the next endpoint; with nothing
+                # left to try this surfaces below as Unavailable.
+                last_exc = Unavailable(
+                    f"circuit open for {method} "
+                    f"{path.partition('?')[0]} at {ep.url} (failing "
+                    "fast after repeated endpoint failures)"
+                )
+                continue
             try:
-                conn.request(method, path, body=data, headers=headers)
-                resp = conn.getresponse()
-            except (_hc.HTTPException, OSError):
-                reused = getattr(conn, "_kftpu_reused", False)
-                conn.close()
-                if reused and method == "GET":
-                    continue  # stale keep-alive victim: one fresh retry
-                raise
+                conn, resp = self._attempt(ep, method, path, data, headers)
+            except _ConnectFailed as e:
+                breaker.failure()
+                last_exc = e.cause
+                continue  # rotate: the dial failed, nothing was sent
+            except Exception:
+                breaker.failure()
+                raise  # ambiguous once bytes were sent: never rotate
+            self._set_active(ep)
             return conn, resp
+        assert last_exc is not None
+        raise last_exc
 
     def _finish(self, conn, resp) -> bytes:
         """Slurp the body and recycle (or retire) the connection."""
@@ -930,13 +1097,17 @@ class HttpApiClient:
             raise Unavailable(detail)
         raise ApiError(f"HTTP {status}: {detail}")
 
-    def _breaker_for(self, method: str, path: str) -> CircuitBreaker:
-        """One breaker per endpoint class: method + the first two path
-        segments ("/apis/<kind>"), query stripped — fine enough that a
-        sick watch endpoint doesn't open the circuit for writes, coarse
-        enough that per-object paths share state."""
+    def _breaker_for(
+        self, ep: _Endpoint, method: str, path: str
+    ) -> CircuitBreaker:
+        """One breaker per ENDPOINT per endpoint class: method + the
+        first two path segments ("/apis/<kind>"), query stripped — fine
+        enough that a sick watch endpoint doesn't open the circuit for
+        writes, coarse enough that per-object paths share state. Keyed
+        by endpoint so a dead active's open circuits shed load to the
+        standby instead of gating the whole client."""
         bare = path.partition("?")[0]
-        key = f"{method} /" + "/".join(bare.split("/")[1:3])
+        key = f"{ep.url} {method} /" + "/".join(bare.split("/")[1:3])
         with self._breakers_lock:
             breaker = self._breakers.get(key)
             if breaker is None:
@@ -954,23 +1125,19 @@ class HttpApiClient:
             }
 
     def _call(self, method: str, path: str, body: dict | None = None) -> dict:
-        import http.client as _hc
-
-        breaker = self._breaker_for(method, path)
-        if not breaker.allow():
-            raise Unavailable(
-                f"circuit open for {method} {path.partition('?')[0]} "
-                "(failing fast after repeated endpoint failures)"
-            )
+        # Transport-level failures (dial refusals, mid-flight deaths,
+        # all-circuits-open) are accounted and raised inside
+        # _request_raw's endpoint walk.
+        conn, resp = self._request_raw(method, path, body)
+        status = resp.status
         try:
-            conn, resp = self._request_raw(method, path, body)
-            status = resp.status
             data = self._finish(conn, resp)
-        except (_hc.HTTPException, OSError):
-            breaker.failure()
+        except Exception:
+            self._breaker_for(conn._kftpu_ep, method, path).failure()
             raise
         # 5xx counts against the endpoint; everything else — including
         # functional errors like 404/409/422 — proves it is answering.
+        breaker = self._breaker_for(conn._kftpu_ep, method, path)
         if status >= 500:
             breaker.failure()
         else:
@@ -1221,8 +1388,11 @@ class HttpApiClient:
     def close(self) -> None:
         self._closed.set()
         with self._pool_lock:
-            pool, self._pool = self._pool, []
-        for conn in pool:
+            conns = []
+            for ep in self._endpoints:
+                conns.extend(ep.pool)
+                ep.pool = []
+        for conn in conns:
             conn.close()
 
     def _dispatch(self, event: str, obj: Resource) -> None:

@@ -30,6 +30,16 @@ itself and a real apiserver):
                         the connection dies before the ack — the
                         classic duplicate-side-effect trap.
 
+Opt-in (``HA_FAULT_CLASSES``; needs a process to kill, so the driver
+supplies the executor — ChaosProxy's ``kill_active`` callback, or the
+failover soak consuming the schedule directly):
+
+- ``apiserver_kill``    SIGKILL the ACTIVE apiserver facade mid-load;
+                        the standby takes over (testing/failover.py)
+                        and every client fails over on its endpoint
+                        list — whole-control-plane death, the canonical
+                        TPU-pod-scale failure (arXiv:2011.03641).
+
 The schedule is a *plan*, not a rate: a `FaultSchedule(seed)` yields an
 identical fault sequence every run (the soak asserts this), each entry
 is consumed by the first eligible request that arrives, and `coverage()`
@@ -60,6 +70,16 @@ FAULT_CLASSES = (
     "crash_before_ack",
 )
 
+# Whole-control-plane death (arXiv:2011.03641's canonical failure mode):
+# SIGKILL the ACTIVE apiserver facade mid-load and let the standby take
+# over (testing/failover.py). Not in FAULT_CLASSES — it needs a process
+# to kill, so only drivers that can supply one (ChaosProxy's
+# `kill_active` callback, or the failover soak consuming the schedule
+# directly) opt in via FaultSchedule(classes=HA_FAULT_CLASSES); the
+# plain wire-proxy soak keeps its historical 7-class plan.
+APISERVER_KILL = "apiserver_kill"
+HA_FAULT_CLASSES = FAULT_CLASSES + (APISERVER_KILL,)
+
 _WRITE_METHODS = ("POST", "PUT", "DELETE", "PATCH")
 
 
@@ -89,7 +109,7 @@ def _eligible(cls: str, method: str, path: str, query: str) -> bool:
     if cls == "reset_mid_response":
         # Mid-body resets of a *stream* are truncate_stream's job.
         return not stream
-    return True  # error_5xx: anything
+    return True  # error_5xx / apiserver_kill: anything
 
 
 class FaultSchedule:
@@ -107,8 +127,10 @@ class FaultSchedule:
         *,
         faults_per_class: int = 2,
         max_gap: int = 3,
+        classes: tuple[str, ...] = FAULT_CLASSES,
     ):
         self.seed = seed
+        self.classes = tuple(classes)
         rng = random.Random(seed)
 
         def mk(cls: str) -> Fault:
@@ -122,25 +144,25 @@ class FaultSchedule:
                 param = float(rng.randint(80, 400))  # bytes before cut
             elif cls == "delay_write":
                 param = rng.uniform(0.05, 0.25)  # hold time (s)
-            else:  # stale_gone, crash_before_ack
+            else:  # stale_gone, crash_before_ack, apiserver_kill
                 param = 0.0
             return Fault(cls, param, rng.randint(1, max_gap))
 
         first = (
-            [mk(c) for c in FAULT_CLASSES] if faults_per_class >= 1 else []
+            [mk(c) for c in self.classes] if faults_per_class >= 1 else []
         )
         rng.shuffle(first)
         rest = [
             mk(c)
             for _ in range(max(0, faults_per_class - 1))
-            for c in FAULT_CLASSES
+            for c in self.classes
         ]
         rng.shuffle(rest)
         self.plan: tuple[Fault, ...] = tuple(first + rest)
         self._pending: list[Fault] = list(self.plan)
         self._cooldown = 0
         self._inflight = 0
-        self._injected: dict[str, int] = {c: 0 for c in FAULT_CLASSES}
+        self._injected: dict[str, int] = {c: 0 for c in self.classes}
         self._lock = threading.Lock()
 
     @classmethod
@@ -186,7 +208,9 @@ class FaultSchedule:
     def mark_injected(self, fault: Fault) -> None:
         """The fault's effect happened on the wire."""
         with self._lock:
-            self._injected[fault.cls] += 1
+            # .get: from_plan() may stage classes outside this
+            # schedule's seeded set (targeted tests).
+            self._injected[fault.cls] = self._injected.get(fault.cls, 0) + 1
             self._inflight -= 1
 
     def requeue(self, fault: Fault) -> None:
@@ -546,9 +570,22 @@ class ChaosProxy:
         schedule: FaultSchedule,
         host: str = "127.0.0.1",
         port: int = 0,
+        kill_active=None,
     ):
         self.upstream = (upstream_host, upstream_port)
         self.schedule = schedule
+        # apiserver_kill executor: a driver-supplied callable that
+        # SIGKILLs the active facade (and typically restarts the deposed
+        # one as a fresh standby). Return a falsy value when no kill
+        # happened (the entry requeues), True when the NEW active serves
+        # on the same upstream address, or the new active's
+        # (host, port) — the proxy retargets, so an active-passive pair
+        # on per-replica ports stays reachable through one proxied
+        # address across takeovers. Without a callback, apiserver_kill
+        # entries requeue forever — so only schedules built with
+        # HA_FAULT_CLASSES should meet a proxy without one, and only in
+        # tests asserting that.
+        self.kill_active = kill_active
         self.host = host
         self._want_port = port
         self._listener: socket.socket | None = None
@@ -832,6 +869,28 @@ class ChaosProxy:
                     )
                     self.schedule.mark_injected(fault)
                     continue
+                if fault is not None and fault.cls == APISERVER_KILL:
+                    # Whole-facade death: the driver's callback SIGKILLs
+                    # the active. The in-flight request dies with it (an
+                    # aborted connection, exactly what a real kill does
+                    # to this client), and every other client discovers
+                    # the death through its own transport errors.
+                    killed = (
+                        self.kill_active()
+                        if self.kill_active is not None
+                        else None
+                    )
+                    if killed:
+                        if isinstance(killed, tuple):
+                            # The new active serves elsewhere (per-
+                            # replica ports): retarget, so the NEXT
+                            # connection through this proxy reaches it.
+                            self.upstream = killed
+                        self.schedule.mark_injected(fault)
+                        _abort(client)
+                        return
+                    self.schedule.requeue(fault)
+                    fault = None
                 if fault is not None and fault.cls == "delay_write":
                     # The hold itself is the effect; the write then
                     # proceeds normally.

@@ -1,0 +1,302 @@
+"""Active-passive apiserver failover over one durable state directory.
+
+The reference never built this: GKE's managed control plane plus etcd
+quorum gave it an HA apiserver for free (`kf_is_ready_test.py:101-115`
+simply assumes one is there to ask). A platform that REPLACES the
+apiserver must replace that property. The shape here is the classic
+active-passive pair over shared durable storage:
+
+- N facade processes boot over the same `persist_dir`, but only the one
+  holding the **apiserver lease** opens the store and serves; the rest
+  park in the standby acquire loop (`controllers/leader.py` — the exact
+  elector the controllers use, pointed at a different lease store).
+- The lease cannot live INSIDE the store it gates (the store is closed
+  until the lease is won), so `FileLeaseStore` keeps it as a file
+  BESIDE the store directory, with the same CAS surface the elector
+  expects: get/create/update with resourceVersion preconditions,
+  serialized under an OS file lock.
+- On takeover the new active replays the WAL (`FakeApiServer._restore`:
+  snapshot + journal tail, torn-tail repair, watch journal re-seeded at
+  the durable resourceVersion so pre-failover bookmarks get an honest
+  410 → relist), then `checkpoint()`s — which, via `PyWal.snapshot`'s
+  truncate-by-replacement, moves `wal.log` onto a **new inode**. A
+  deposed active still holding the old fd appends into an orphaned file
+  no restart will ever replay: late writes are *physically* fenced.
+- Belt to that suspender: the active's WAL is wrapped in `FencedWal`,
+  which re-reads the lease before every append/snapshot. The instant
+  the term moves, the next durable write raises `WalFenced`, the store
+  fail-stops (`fake_apiserver._fail_stop` — in-memory divergence becomes
+  unobservable, every op 503s), clients rotate to the new active via
+  their endpoint list, and the deposed process exits. An acked write is
+  therefore either in the WAL the successor replayed, or was never
+  acked at all — the zero-acked-writes-lost contract the failover e2e
+  pins with a WAL diff.
+
+Timing inherits the elector's contract: `renew_deadline <
+lease_duration` means a partitioned active stops acking (fail-stop on
+its next durable write, or steps down) before the standby's takeover
+clock can have expired, so the fencing races the chaos suite throws at
+it (SIGSTOP, SIGKILL mid-load) resolve to Conflict/503, never to two
+actives acking into one log.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from kubeflow_tpu.api.objects import Resource, new_resource
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+)
+
+log = logging.getLogger(__name__)
+
+LEASE_KIND = "Lease"
+
+
+class WalFenced(Exception):
+    """A durable write was attempted after this process's term ended.
+    Deliberately NOT an ApiError: `FakeApiServer._persist` maps unknown
+    exceptions to fail-stop (every subsequent op raises Unavailable),
+    which is exactly the posture a deposed active must take."""
+
+
+class FileLeaseStore:
+    """Lease CRUD over files in a shared directory — the minimal CAS
+    surface `controllers/leader.LeaderElector` needs (get/create/update
+    with resourceVersion preconditions), for the one lease that cannot
+    live inside the store it gates. One JSON file per lease name; every
+    mutation happens under an `flock` on a sibling lock file and lands
+    via write-tmp/fsync/rename, so concurrent candidates on the same
+    host (the active-passive deployment unit) serialize exactly like
+    store writers under the commit lock."""
+
+    def __init__(self, directory: str):
+        self._dir = str(directory)
+        os.makedirs(self._dir, mode=0o700, exist_ok=True)
+        self._lock_path = os.path.join(self._dir, ".lock")
+        self._local = threading.Lock()  # flock is per-fd: serialize threads
+
+    def _path(self, name: str) -> str:
+        if "/" in name or name.startswith("."):
+            raise ValueError(f"invalid lease name {name!r}")
+        return os.path.join(self._dir, f"{name}.json")
+
+    class _Flock:
+        def __init__(self, path: str):
+            self._path = path
+            self._fd: int | None = None
+
+        def __enter__(self):
+            import fcntl
+
+            self._fd = os.open(self._path, os.O_RDWR | os.O_CREAT, 0o600)
+            fcntl.flock(self._fd, fcntl.LOCK_EX)
+            return self
+
+        def __exit__(self, *exc):
+            import fcntl
+
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+
+    def _locked(self):
+        return self._Flock(self._lock_path)
+
+    def _read(self, name: str) -> dict | None:
+        try:
+            with open(self._path(name), encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except ValueError:
+            # A torn lease write is unreachable (tmp+rename), but a
+            # garbage file must read as "no holder", not crash the
+            # election loop.
+            return None
+
+    def _write(self, name: str, record: dict) -> None:
+        path = self._path(name)
+        tmp = path + ".tmp"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            data = json.dumps(record, separators=(",", ":")).encode()
+            while data:
+                data = data[os.write(fd, data):]
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.rename(tmp, path)
+        dir_fd = os.open(self._dir, os.O_RDONLY | os.O_DIRECTORY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+
+    def _to_resource(self, name: str, record: dict) -> Resource:
+        lease = new_resource(
+            LEASE_KIND, name, "", spec=dict(record.get("spec", {}))
+        )
+        lease.metadata.resource_version = int(record.get("rv", 0))
+        return lease
+
+    # -- the elector's CAS surface ----------------------------------------
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Resource:
+        assert kind == LEASE_KIND, kind
+        record = self._read(name)
+        if record is None:
+            raise NotFound(f"Lease {name!r} not found")
+        return self._to_resource(name, record)
+
+    def create(self, obj: Resource) -> Resource:
+        assert obj.kind == LEASE_KIND, obj.kind
+        name = obj.metadata.name
+        with self._local, self._locked():
+            if self._read(name) is not None:
+                raise AlreadyExists(f"Lease {name!r} already exists")
+            record = {"rv": 1, "spec": dict(obj.spec)}
+            self._write(name, record)
+        return self._to_resource(name, record)
+
+    def update(self, obj: Resource) -> Resource:
+        assert obj.kind == LEASE_KIND, obj.kind
+        name = obj.metadata.name
+        with self._local, self._locked():
+            record = self._read(name)
+            if record is None:
+                raise NotFound(f"Lease {name!r} not found")
+            if (
+                obj.metadata.resource_version
+                and obj.metadata.resource_version != int(record["rv"])
+            ):
+                raise Conflict(
+                    f"Lease {name!r}: stale resourceVersion "
+                    f"{obj.metadata.resource_version} != {record['rv']}"
+                )
+            record = {"rv": int(record["rv"]) + 1, "spec": dict(obj.spec)}
+            self._write(name, record)
+        return self._to_resource(name, record)
+
+    # -- the fence's read surface -----------------------------------------
+
+    def read_spec(self, name: str) -> dict | None:
+        """The lease's spec right now, or None — lock-free (the file is
+        replaced atomically), cheap enough to run on every WAL append."""
+        record = self._read(name)
+        return dict(record.get("spec", {})) if record else None
+
+
+class FencedWal:
+    """Term fencing at the durability boundary: every append/snapshot
+    verifies the lease still names this process's (holder, transitions)
+    — BEFORE the write (don't touch a successor's log if we already
+    know the term moved) and again AFTER it, before the caller can ack.
+    The post-write check is the one that carries the zero-acked-loss
+    contract: verify→write alone has a TOCTOU hole (verify passes, the
+    process is descheduled, the standby wins the lease AND replays the
+    log, then the old append lands — acked but never replayed). The
+    successor always CAS-moves the lease before it reads the log, so
+    re-reading the lease after our write is durable and raising
+    `WalFenced` turns that lost update into an UNACKED one: the client
+    sees the error and retries against the successor through the normal
+    duplicate-free paths. (A fenced-after-write record may still be
+    replayed if it beat the successor's read — harmless, that is
+    exactly the crash_before_ack ambiguity clients already absorb.)
+    The moment either check fires the store fail-stops and clients
+    rotate. Reads and close stay open: a deposed process may still
+    drain diagnostics. Residual: a stop-the-world pause between the
+    post-check and `snapshot`'s rename could still publish a stale
+    snapshot; that window is two instructions wide and covered by the
+    elector's timing contract (renew_deadline < lease_duration — a
+    process stalled that long has already stopped renewing)."""
+
+    def __init__(self, inner, verify):
+        self._inner = inner
+        self._verify = verify
+
+    def append(self, line: str) -> None:
+        self._verify()
+        self._inner.append(line)
+        self._verify()  # the ack barrier (see class docstring)
+
+    def snapshot(self, text: str) -> None:
+        self._verify()
+        self._inner.snapshot(text)
+        self._verify()
+
+    def read_snapshot(self) -> str:
+        return self._inner.read_snapshot()
+
+    def read_journal(self) -> str:
+        return self._inner.read_journal()
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+def term_fence(
+    leases: FileLeaseStore, name: str, holder: str, transitions: int
+):
+    """A `wal_wrap` for `FakeApiServer`: wraps the opened WAL in a
+    `FencedWal` bound to one term. Pass right after winning the lease:
+
+        api = FakeApiServer(
+            persist_dir=...,
+            wal_wrap=term_fence(leases, "apiserver", elector.identity,
+                                elector.transitions),
+        )
+    """
+
+    def verify() -> None:
+        spec = leases.read_spec(name)
+        current = (
+            (spec.get("holderIdentity"), int(spec.get("leaseTransitions", 0)))
+            if spec is not None
+            else None
+        )
+        if current != (holder, int(transitions)):
+            raise WalFenced(
+                f"lease {name!r} is {current} but this store serves term "
+                f"({holder!r}, {transitions}) — a deposed active must not "
+                "write into its successor's log"
+            )
+
+    return lambda wal: FencedWal(wal, verify)
+
+
+def open_active_store(
+    persist_dir: str,
+    leases: FileLeaseStore,
+    lease_name: str,
+    holder: str,
+    transitions: int,
+    **api_kwargs,
+):
+    """The takeover sequence, in order: open the durable store fenced to
+    this term (construction replays snapshot + WAL and re-seeds the
+    watch floor at the durable rv), then checkpoint — folding the
+    replayed tail into a fresh snapshot and, via truncate-by-replacement,
+    rotating `wal.log` onto a new inode so the deposed predecessor's fd
+    is orphaned. Returns the serving-ready store."""
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+
+    # The inode fence is a PyWal behavior: native/src/wal.cc still
+    # truncates wal.log IN PLACE (O_TRUNC on the same inode), which
+    # would leave a deposed predecessor's fd pointed at the LIVE log.
+    # Until the native WAL ports truncate-by-replacement (ROADMAP open
+    # item #1), HA stores pin the Python backend rather than silently
+    # weakening the fence on hosts where the native tier builds.
+    api_kwargs.setdefault("wal_backend", "python")
+    api = FakeApiServer(
+        persist_dir=persist_dir,
+        wal_wrap=term_fence(leases, lease_name, holder, transitions),
+        **api_kwargs,
+    )
+    api.checkpoint()
+    return api

@@ -322,6 +322,7 @@ class FakeApiServer:
         persist_dir: str | None = None,
         snapshot_every: int = 1_000,
         wal_backend: str = "auto",
+        wal_wrap=None,
     ):
         self._objects: dict[tuple[str, str, str], Resource] = {}
         # Per-(kind, namespace) index over the same frozen snapshots,
@@ -372,6 +373,13 @@ class FakeApiServer:
             from kubeflow_tpu.testing import persist
 
             self._wal = persist.open_wal(persist_dir, backend=wal_backend)
+            if wal_wrap is not None:
+                # Active-passive term fencing (`testing/failover.py`):
+                # the wrapper verifies this process still owns the
+                # apiserver lease before every durable write, so a
+                # deposed active fail-stops instead of acking writes its
+                # successor will never replay.
+                self._wal = wal_wrap(self._wal)
             self._restore()
 
     # -- storage (copy-on-write commit point) -----------------------------

@@ -79,12 +79,21 @@ class PyWal:
         os.rename(tmp, self._snap_path)
         os.fsync(self._dir_fd)
         # Snapshot durable — now the WAL may shrink (see module docstring
-        # for why this ordering is the crash-safe one).
+        # for why this ordering is the crash-safe one). Truncation is by
+        # REPLACEMENT, not O_TRUNC: the fresh log is a new inode renamed
+        # over wal.log, so another process still holding the old fd (an
+        # active-passive takeover's deposed predecessor,
+        # testing/failover.py) appends into an orphaned file that no
+        # restart will ever replay. Crash between the two renames leaves
+        # the old pre-snapshot records in place — replay skips them by
+        # rv, same contract as before.
+        wal_tmp = self._wal_path + ".tmp"
         fresh = os.open(
-            self._wal_path,
+            wal_tmp,
             os.O_WRONLY | os.O_APPEND | os.O_CREAT | os.O_TRUNC,
             0o600,
         )
+        os.rename(wal_tmp, self._wal_path)
         os.close(self._fd)
         self._fd = fresh
         os.fsync(self._dir_fd)

@@ -18,6 +18,23 @@ Env contract:
 Prints "apiserver ready <port>" once serving. First boot (empty store)
 seeds the RBAC roles + a system:admin binding; on restart they are
 restored from disk — the e2e asserts that, so don't reseed.
+
+HA mode (`testing/failover.py`) — set KFTPU_HA_IDENTITY and run N
+copies over the SAME state dir (each with its own KFTPU_PORT):
+
+    KFTPU_HA_IDENTITY     this replica's identity; presence enables HA
+    KFTPU_LEASE_DURATION  apiserver lease TTL seconds (default 3)
+    KFTPU_RENEW_DEADLINE  default 2
+
+The replica prints "standby <identity>", parks in the lease acquire
+loop serving NOTHING (it does not even bind its port), and on winning
+the lease performs the takeover — replay WAL, checkpoint (rotating the
+log inode out from under any deposed predecessor), serve fenced to this
+term — printing "apiserver ready <port>" once serving and then
+"leading <identity>" (wait for THAT marker: it is the last boot line).
+On leadership loss it exits 2 WITHOUT closing the store (a deposed
+active checkpointing would be exactly the late write fencing exists to
+stop); the supervisor restarts it as a fresh standby.
 """
 
 import faulthandler
@@ -42,10 +59,9 @@ from kubeflow_tpu.testing.fake_apiserver import FakeApiServer  # noqa: E402
 from kubeflow_tpu.web.wsgi import serve  # noqa: E402
 
 
-def main() -> None:
-    api = FakeApiServer(
-        persist_dir=os.path.join(os.environ["KFTPU_STATE_DIR"], "store")
-    )
+def _serve(api):
+    """Token registry + secure facade + TLS server on KFTPU_PORT;
+    returns the serving `_HttpServer`."""
     tokens = TokenRegistry.load(os.environ["KFTPU_TOKEN_FILE"])
     tokens.autosave(os.environ["KFTPU_TOKEN_FILE"])
     tokens.watch_profiles(api)
@@ -59,13 +75,18 @@ def main() -> None:
     app = ApiServerApp(
         api, tokens=tokens, log_root=os.environ.get("KFTPU_LOG_ROOT")
     )
-    # TLS rides the state dir: a restart reuses the SAME CA, so clients
-    # that pinned it reconnect without re-trusting anything.
-    from kubeflow_tpu.web import tls
+    # TLS rides the state dir: a restart (or the standby of an HA pair)
+    # reuses the SAME CA, so clients that pinned it reconnect without
+    # re-trusting anything. KFTPU_TLS=0 serves plaintext instead —
+    # loopback-only rigs (clients then need KFTPU_ALLOW_PLAINTEXT=1),
+    # and the only option where the TLS toolchain is absent.
+    paths = None
+    if os.environ.get("KFTPU_TLS", "1") != "0":
+        from kubeflow_tpu.web import tls
 
-    paths = tls.ensure_tls_dir(
-        os.path.join(os.environ["KFTPU_STATE_DIR"], "tls")
-    )
+        paths = tls.ensure_tls_dir(
+            os.path.join(os.environ["KFTPU_STATE_DIR"], "tls")
+        )
     server, _ = serve(
         app,
         host="127.0.0.1",
@@ -73,11 +94,10 @@ def main() -> None:
         tls=paths,
     )
     print(f"apiserver ready {server.server_port}", flush=True)
-    from kubeflow_tpu.utils import signals as sigutil
+    return server
 
-    # Poll-not-park graceful stop (utils/signals.py has the rationale —
-    # this worker's hang is the reproduction that motivated it).
-    sigutil.wait_for_shutdown(sigutil.install_shutdown_handlers())
+
+def _shutdown(server, api) -> None:
     # Stage markers: if shutdown wedges, the captured stdout shows how
     # far it got (paired with the SIGUSR1 stack dump above).
     print("shutting down: server", flush=True)
@@ -85,6 +105,78 @@ def main() -> None:
     print("shutting down: store", flush=True)
     api.close()  # graceful path folds the WAL into a snapshot
     print("shutdown complete", flush=True)
+
+
+def main() -> None:
+    from kubeflow_tpu.utils import signals as sigutil
+
+    store_dir = os.path.join(os.environ["KFTPU_STATE_DIR"], "store")
+    identity = os.environ.get("KFTPU_HA_IDENTITY")
+    # Poll-not-park graceful stop (utils/signals.py has the rationale —
+    # this worker's hang is the reproduction that motivated it).
+    stop = sigutil.install_shutdown_handlers()
+
+    if identity is None:
+        api = FakeApiServer(persist_dir=store_dir)
+        server = _serve(api)
+        sigutil.wait_for_shutdown(stop)
+        _shutdown(server, api)
+        return
+
+    # -- HA mode: standby until the apiserver lease is won ----------------
+    from kubeflow_tpu.controllers.leader import LeaderElector
+    from kubeflow_tpu.testing.failover import (
+        FileLeaseStore,
+        open_active_store,
+    )
+
+    leases = FileLeaseStore(
+        os.path.join(os.environ["KFTPU_STATE_DIR"], "lease")
+    )
+    elector = LeaderElector(
+        leases,
+        "apiserver",
+        identity,
+        lease_duration=float(os.environ.get("KFTPU_LEASE_DURATION", "3")),
+        renew_deadline=float(os.environ.get("KFTPU_RENEW_DEADLINE", "2")),
+        retry_period=0.25,
+    )
+    print(f"standby {identity}", flush=True)
+    if not elector.acquire(stop):
+        return  # stopped while parked; never served, nothing to clean
+    api = open_active_store(
+        store_dir, leases, "apiserver", identity, elector.transitions
+    )
+    server = _serve(api)
+    print(f"leading {identity} gen {elector.transitions}", flush=True)
+    elector.hold(stop)  # renew until stop or loss
+    if not stop.is_set():
+        # Deposed: the fenced store is (or is about to be) fail-stopped;
+        # closing it would checkpoint into the successor's term. Exit
+        # hard and let the supervisor restart a fresh standby —
+        # client-go's RunOrDie posture, same as the controller workers.
+        print(f"deposed {identity}", flush=True)
+        server.shutdown()
+        sys.exit(2)
+    from kubeflow_tpu.testing.failover import WalFenced
+    from kubeflow_tpu.testing.fake_apiserver import Unavailable
+
+    try:
+        _shutdown(server, api)
+    except (WalFenced, Unavailable):
+        # SIGTERM raced deposition: hold() returned for the stop flag,
+        # but the term had already moved, so the close-path checkpoint
+        # hit the WAL fence (WalFenced → fail-stop → Unavailable out of
+        # close()). That is the fence WORKING — take the deposed exit,
+        # not a traceback, and leave the lease to the successor. Only
+        # the fence's exceptions qualify: a disk-full OSError or a
+        # shutdown bug must still surface as the failure it is.
+        print(f"deposed {identity}", flush=True)
+        sys.exit(2)
+    # Checkpoint done (it needed the still-held term) — NOW hand the
+    # lease over so the standby acquires on its next poll instead of
+    # waiting out the TTL (client-go's ReleaseOnCancel).
+    elector.release()
 
 
 if __name__ == "__main__":

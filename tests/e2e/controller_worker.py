@@ -17,12 +17,15 @@ import threading
 sys.path.insert(0, os.environ["KFTPU_REPO"])
 
 from kubeflow_tpu.controllers.tpujob import TpuJobController  # noqa: E402
-from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import (  # noqa: E402
+    HttpApiClient,
+    endpoints_from_env,
+)
 
 
 def main() -> None:
     client = HttpApiClient(
-        os.environ["KFTPU_APISERVER"],
+        endpoints_from_env(os.environ["KFTPU_APISERVER"]),
         watch_poll_timeout=2.0,
         watch_retry=0.1,
     )

@@ -19,7 +19,10 @@ from kubeflow_tpu.launcher.launcher import (  # noqa: E402
     report_metrics,
     report_observation,
 )
-from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import (  # noqa: E402
+    HttpApiClient,
+    endpoints_from_env,
+)
 
 
 def main() -> None:
@@ -27,7 +30,7 @@ def main() -> None:
     parser.add_argument("--lr", type=float, required=True)
     args = parser.parse_args()
 
-    api = HttpApiClient(os.environ["KFTPU_APISERVER"])
+    api = HttpApiClient(endpoints_from_env(os.environ["KFTPU_APISERVER"]))
     job = os.environ["TPUJOB_NAME"]
     ns = os.environ["TPUJOB_NAMESPACE"]
     diverges = args.lr >= 1.0

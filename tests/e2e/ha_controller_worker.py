@@ -26,7 +26,10 @@ sys.path.insert(0, os.environ["KFTPU_REPO"])
 from kubeflow_tpu.api.objects import new_resource  # noqa: E402
 from kubeflow_tpu.controllers.leader import LeaderElector  # noqa: E402
 from kubeflow_tpu.controllers.runtime import Controller, Result  # noqa: E402
-from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import (  # noqa: E402
+    HttpApiClient,
+    endpoints_from_env,
+)
 from kubeflow_tpu.testing.fake_apiserver import (  # noqa: E402
     Conflict,
     NotFound,
@@ -63,7 +66,7 @@ def reconcile(capi, key):
 
 def main() -> None:
     client = HttpApiClient(
-        os.environ["KFTPU_APISERVER"],
+        endpoints_from_env(os.environ["KFTPU_APISERVER"]),
         watch_poll_timeout=2.0,
         watch_retry=0.1,
     )

@@ -1,0 +1,102 @@
+"""Shared process driver for the HA e2es: a worker subprocess plus a
+thread-draining stdout reader and marker waits.
+
+One implementation because the failover and HA × preemption e2es both
+supervise marker-printing replicas (a select+readline loop can strand
+lines in the text-IO buffer; a reader thread can't), and the teardown
+diagnostics (SIGUSR1 stack dump on a missed SIGTERM deadline) must not
+drift between them.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (bind-then-release; the winner must
+    re-bind promptly — see the soak's SO_REUSEADDR retry loop for the
+    restart-on-same-port case)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class MarkeredProc:
+    """One supervised replica: Popen + stdout drain + marker waits."""
+
+    def __init__(self, identity: str, argv: list[str], env: dict):
+        self.identity = identity
+        self.lines: list[str] = []
+        self._cv = threading.Condition()
+        self.proc = subprocess.Popen(
+            argv,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self) -> None:
+        for line in self.proc.stdout:
+            with self._cv:
+                self.lines.append(line.strip())
+                self._cv.notify_all()
+
+    def wait_marker(self, prefix: str, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not any(ln.startswith(prefix) for ln in self.lines):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise AssertionError(
+                        f"{self.identity}: no {prefix!r} line in "
+                        f"{timeout}s; got {self.lines}"
+                    )
+                self._cv.wait(remaining)
+
+    def kill(self) -> None:
+        """SIGKILL — the no-warning death the failover story is about."""
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful SIGTERM; a missed deadline dumps stacks (SIGUSR1)
+        before the hard kill so the hang is diagnosable from stdout."""
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.send_signal(signal.SIGUSR1)  # stack dump
+            time.sleep(2)
+            self.proc.kill()
+            self.proc.wait()
+            raise AssertionError(
+                f"{self.identity} missed the SIGTERM deadline; "
+                f"output: {self.lines}"
+            )
+
+    def cleanup(self) -> None:
+        """Best-effort teardown for finally blocks: un-SIGSTOP (a test
+        may have partitioned this replica), then SIGKILL whatever is
+        still alive — teardown must never hang the suite."""
+        try:
+            os.kill(self.proc.pid, signal.SIGCONT)
+        except (ProcessLookupError, PermissionError):
+            pass
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait(timeout=10)
+
+
+def python_worker(script: str, identity: str, env: dict) -> MarkeredProc:
+    """Spawn `script` with this interpreter and `{**os.environ, **env}`."""
+    return MarkeredProc(
+        identity, [sys.executable, script], {**os.environ, **env}
+    )

@@ -1,0 +1,93 @@
+"""Leader-elected TpuJob-operator replica — the HA × preemption rig.
+
+Run N copies against one facade and exactly one runs the REAL
+`TpuJobController` (gang placement through the compiled scheduler,
+priority preemption, the whole reconcile); the rest are hot standbys in
+the lease acquire loop. On acquiring, the worker arms the client's
+lease guard so every write this term makes is fenced at the storage
+boundary — the surface `tests/e2e/test_ha_preemption_e2e.py` attacks by
+killing/SIGSTOPping the leader in the widest-damage window preemption
+has: victims evicted, preemptor not yet placed.
+
+KFTPU_PREEMPT_STALL widens that window deterministically (the
+controller's `preempt_stall` seam fires after the evictions commit); the
+worker prints "evicted <identity>" on entering it so the e2e knows
+exactly when to strike.
+
+Env: KFTPU_REPO, KFTPU_APISERVER (endpoint list — comma separated),
+KFTPU_IDENTITY, KFTPU_LEASE_DURATION, KFTPU_RENEW_DEADLINE,
+KFTPU_PREEMPT_STALL (seconds, default 0).
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+from kubeflow_tpu.controllers.leader import LeaderElector  # noqa: E402
+from kubeflow_tpu.controllers.tpujob import TpuJobController  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import (  # noqa: E402
+    HttpApiClient,
+    endpoints_from_env,
+)
+from kubeflow_tpu.testing.fake_apiserver import Conflict  # noqa: E402
+
+IDENTITY = os.environ["KFTPU_IDENTITY"]
+STALL = float(os.environ.get("KFTPU_PREEMPT_STALL", "0"))
+
+
+def preempt_stall() -> None:
+    # Victims are evicted and durably committed; the preemptor is not
+    # yet placed. Announce the window, then hold it open.
+    print(f"evicted {IDENTITY}", flush=True)
+    if STALL:
+        time.sleep(STALL)
+
+
+def main() -> None:
+    client = HttpApiClient(
+        endpoints_from_env(os.environ["KFTPU_APISERVER"]),
+        watch_poll_timeout=2.0,
+        watch_retry=0.1,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    elector = LeaderElector(
+        client,
+        "tpujob-controller",
+        IDENTITY,
+        lease_duration=float(os.environ.get("KFTPU_LEASE_DURATION", "3")),
+        renew_deadline=float(os.environ.get("KFTPU_RENEW_DEADLINE", "2")),
+        retry_period=0.25,
+    )
+    print(f"standby {IDENTITY}", flush=True)
+
+    def start_leading(el):
+        # Fencing armed BEFORE the first reconcile: every write this
+        # term makes carries (lease, holder, generation).
+        client.set_lease_guard(el.guard)
+        print(f"leading {IDENTITY} gen {el.transitions}", flush=True)
+        ctl = TpuJobController(client, preempt_stall=preempt_stall)
+        threading.Thread(
+            target=ctl.controller.run, args=(stop,), daemon=True
+        ).start()
+
+    try:
+        lost = elector.run(stop, start_leading)
+    except Conflict:
+        lost = True
+    if lost:
+        # Deposed: a stale leader's in-flight preemption state belongs
+        # to a dead term — exit and let the supervisor restart fresh
+        # (client-go's RunOrDie posture).
+        print(f"deposed {IDENTITY}", flush=True)
+        sys.exit(2)
+    client.close()
+
+
+if __name__ == "__main__":
+    main()

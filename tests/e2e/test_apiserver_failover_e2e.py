@@ -1,0 +1,446 @@
+"""Apiserver failover e2e: SIGKILL the ACTIVE facade mid-load.
+
+The last SPOF (round-5 verdict): controllers and the webhook went HA in
+round 5, but the facade itself was one process with no standby and
+`HttpApiClient` hard-wired to one URL. Here the full active-passive
+story (`testing/failover.py`) is proven the only way that counts — a
+real SIGKILL under live load:
+
+- two `apiserver_worker.py` replicas over ONE durable state dir; the
+  active serves, the standby parks in the apiserver-lease acquire loop
+  serving nothing (it doesn't even bind its port);
+- CLI-writer threads, streaming watchers, and a level-triggered
+  controller all drive one endpoint-list client fleet;
+- the active is SIGKILLed mid-load; the standby replays the WAL, takes
+  over within the lease TTL, and every client resumes via endpoint
+  rotation + the normal 410-relist path;
+- ZERO acknowledged writes lost — proven against the durable state
+  itself (a fresh store booted over the dir after shutdown must hold
+  every acked object: the WAL diff), and zero duplicate side effects —
+  every reconciled object has exactly ONE generated-name child (two
+  concurrently-believing actives, or a double-applied retry, would
+  have created two).
+
+The seeded nightly soak (`slow`) repeats the kill through an
+`apiserver_kill` fault plan (`FaultSchedule(classes=(APISERVER_KILL,))`)
+— kill, takeover, restart the corpse as a fresh standby, kill again —
+and gates on plan coverage, reproducible from the one printed integer
+(KFTPU_FAILOVER_SEED), driven nightly by `bench.py --workload
+controlplane` which publishes the measured failover seconds.
+"""
+
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from tests.e2e.ha_driver import MarkeredProc, free_port as _free_port
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.tokens import TokenRegistry
+from kubeflow_tpu.controllers.runtime import Controller, Result, retry_on_conflict
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+from kubeflow_tpu.testing.fake_apiserver import (
+    AlreadyExists,
+    FakeApiServer,
+    NotFound,
+    Unavailable,
+)
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+APISERVER = os.path.join(REPO, "tests", "e2e", "apiserver_worker.py")
+
+LEASE_DURATION = 2.0
+DEFAULT_SEED = 20260804
+
+WRITERS = 3
+OBJECTS_PER_WRITER = 25
+WATCHERS = 2
+
+
+class _Replica(MarkeredProc):
+    """One HA facade replica (shared driver: `ha_driver.MarkeredProc`)."""
+
+    def __init__(self, identity: str, port: int, tmp_path):
+        self.port = port
+        self.url = f"http://127.0.0.1:{port}"
+        super().__init__(
+            identity,
+            [sys.executable, APISERVER],
+            {
+                **os.environ,
+                "KFTPU_REPO": REPO,
+                "KFTPU_STATE_DIR": str(tmp_path / "state"),
+                "KFTPU_TOKEN_FILE": str(tmp_path / "tokens"),
+                "KFTPU_PORT": str(port),
+                "KFTPU_TLS": "0",  # loopback rig; TLS is restart e2e's job
+                "KFTPU_HA_IDENTITY": identity,
+                "KFTPU_LEASE_DURATION": str(LEASE_DURATION),
+                "KFTPU_RENEW_DEADLINE": str(LEASE_DURATION * 0.6),
+            },
+        )
+
+
+def _boot_pair(tmp_path) -> tuple["_Replica", "_Replica", str]:
+    tokens = TokenRegistry()
+    admin_token = tokens.issue("system:admin")
+    tokens.save(str(tmp_path / "tokens"))
+    a = _Replica("facade-a", _free_port(), tmp_path)
+    a.wait_marker("standby facade-a")
+    a.wait_marker("leading facade-a")
+    b = _Replica("facade-b", _free_port(), tmp_path)
+    b.wait_marker("standby facade-b")
+    return a, b, admin_token
+
+
+def _client(endpoints, token, **kw) -> HttpApiClient:
+    kw.setdefault("timeout", 5.0)
+    kw.setdefault("watch_poll_timeout", 1.0)
+    kw.setdefault("watch_retry", 0.1)
+    kw.setdefault("retry_base", 0.02)
+    kw.setdefault("breaker_cooldown", 0.5)
+    return HttpApiClient(
+        endpoints, token=token, allow_plaintext_token=True, **kw
+    )
+
+
+def _create_acked(client: HttpApiClient, obj, deadline_s: float = 60.0):
+    """A CLI writer's posture across a control-plane outage: the client-
+    level bounded retry absorbs blips; anything longer (the failover
+    window itself) is ridden out at this level, the way a controller's
+    workqueue requeue would. AlreadyExists here can only be OUR earlier
+    attempt that committed before its ack was lost (names are writer-
+    unique), so it counts as acked."""
+    import http.client as _hc
+
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return client.create(obj)
+        except AlreadyExists:
+            return None  # earlier ambiguous attempt committed
+        except (Unavailable, _hc.HTTPException, OSError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+def _reconcile(capi, key):
+    """Level-triggered side-effect surface: one GENERATED-name child per
+    FailObj (list-empty-then-create — a double-active or double-applied
+    retry yields TWO children), then status Done."""
+    ns, name = key
+    try:
+        obj = capi.get("FailObj", name, ns)
+    except NotFound:
+        return Result()
+    if obj.status.get("phase") == "Done":
+        return Result()
+    children = capi.list(
+        "ChildObj", namespace=ns, label_selector={"child-of": name}
+    )
+    if not children:
+        child = new_resource(
+            "ChildObj", f"{name}-{os.urandom(4).hex()}", ns, spec={}
+        )
+        child.metadata.labels["child-of"] = name
+        capi.create(child)
+
+    def mark_done():
+        fresh = capi.get("FailObj", name, ns)
+        fresh.status["phase"] = "Done"
+        capi.update_status(fresh)
+
+    retry_on_conflict(mark_done)
+    return Result()
+
+
+def test_kill_active_mid_load_fails_over_without_losing_acked_writes(
+    tmp_path,
+):
+    a, b, token = _boot_pair(tmp_path)
+    endpoints = [a.url, b.url]
+    admin = _client(endpoints, token)
+    ctl_client = _client(endpoints, token)
+    watch_clients = [_client(endpoints, token) for _ in range(WATCHERS)]
+    acked: list[str] = []
+    acked_lock = threading.Lock()
+    kill_at = threading.Event()
+    writer_errors: list[Exception] = []
+    seen: list[dict[str, bool]] = [dict() for _ in range(WATCHERS)]
+
+    for i, wc in enumerate(watch_clients):
+        def handler(event, obj, i=i):
+            if obj.kind == "FailObj":
+                seen[i][obj.metadata.name] = True
+
+        wc.watch(handler, "FailObj")
+
+    ctl = Controller(ctl_client, "FailObj", _reconcile, name="failover-ctl")
+    ctl_stop = threading.Event()
+    ctl_thread = threading.Thread(
+        target=ctl.run, args=(ctl_stop,), daemon=True
+    )
+    ctl_thread.start()
+
+    def writer(w: int) -> None:
+        client = _client(endpoints, token)
+        try:
+            for i in range(OBJECTS_PER_WRITER):
+                name = f"obj-{w}-{i}"
+                _create_acked(
+                    client,
+                    new_resource("FailObj", name, "load", spec={"w": w}),
+                )
+                with acked_lock:
+                    acked.append(name)
+                    if len(acked) >= WRITERS * OBJECTS_PER_WRITER // 3:
+                        kill_at.set()
+                time.sleep(0.02)  # spread the load across the kill
+        except Exception as e:  # surfaced in the assert below
+            writer_errors.append(e)
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=writer, args=(w,)) for w in range(WRITERS)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        # -- the kill: mid-load, no warning, no release -------------------
+        assert kill_at.wait(30), "writers never reached the kill point"
+        t_kill = time.monotonic()
+        a.kill()
+        b.wait_marker("leading facade-b", timeout=LEASE_DURATION + 10)
+        failover = time.monotonic() - t_kill
+        assert failover < LEASE_DURATION + 5, (
+            f"takeover took {failover:.1f}s (lease TTL {LEASE_DURATION}s)"
+        )
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "writers hung"
+        assert not writer_errors, writer_errors
+
+        # Every acked write is serveable from the standby.
+        with acked_lock:
+            acked_names = set(acked)
+        assert acked_names == {
+            f"obj-{w}-{i}"
+            for w in range(WRITERS)
+            for i in range(OBJECTS_PER_WRITER)
+        }
+        names = {o.metadata.name for o in admin.list("FailObj", "load")}
+        missing = acked_names - names
+        assert not missing, f"acked writes lost across failover: {missing}"
+
+        # The controller converged THROUGH the failover: every object
+        # Done with exactly one child — zero duplicate side effects.
+        deadline = time.monotonic() + 90
+        def undone():
+            return [
+                o.metadata.name
+                for o in admin.list("FailObj", "load")
+                if o.status.get("phase") != "Done"
+            ]
+        while undone():
+            assert time.monotonic() < deadline, (
+                f"controller never converged: {undone()[:5]}..."
+            )
+            time.sleep(0.2)
+        children = admin.list("ChildObj", "load")
+        per_obj: dict[str, int] = {}
+        for c in children:
+            per_obj[c.metadata.labels["child-of"]] = (
+                per_obj.get(c.metadata.labels["child-of"], 0) + 1
+            )
+        dupes = {k: v for k, v in per_obj.items() if v != 1}
+        assert not dupes, f"duplicate side effects across failover: {dupes}"
+        assert set(per_obj) == acked_names
+
+        # Streaming watchers resumed on the standby and converged.
+        deadline = time.monotonic() + 60
+        while not all(
+            acked_names <= set(seen[i]) for i in range(WATCHERS)
+        ):
+            assert time.monotonic() < deadline, (
+                f"watchers never converged: {[len(s) for s in seen]}"
+                f"/{len(acked_names)}"
+            )
+            time.sleep(0.2)
+
+        assert admin.failovers >= 1, "client never rotated endpoints"
+        assert a.proc.returncode == -signal.SIGKILL
+        print(
+            f"# apiserver failover: takeover {failover:.2f}s (TTL "
+            f"{LEASE_DURATION}s), {len(acked_names)} acked writes kept, "
+            f"{len(children)} children, "
+            f"admin failovers={admin.failovers}"
+        )
+    finally:
+        ctl_stop.set()
+        ctl_thread.join(timeout=10)
+        for c in (admin, ctl_client, *watch_clients):
+            c.close()
+        a.stop() if a.proc.poll() is None else None
+        b.stop()
+
+    # -- the WAL diff: durable truth, read with no server alive ----------
+    # B's graceful stop checkpointed; a fresh store over the same dir
+    # must hold every acked object and every child. This is the
+    # zero-acked-writes-lost proof at the storage layer, independent of
+    # anything a live facade claimed.
+    restored = FakeApiServer(
+        persist_dir=str(tmp_path / "state" / "store")
+    )
+    try:
+        durable = {o.metadata.name for o in restored.list("FailObj", "load")}
+        assert acked_names <= durable, (
+            f"durable state lost acked writes: {acked_names - durable}"
+        )
+        assert len(restored.list("ChildObj", "load")) == len(acked_names)
+    finally:
+        restored.close()
+
+
+@pytest.mark.slow
+def test_failover_soak_nightly(tmp_path):
+    """Seeded kill-cycle soak: an `apiserver_kill` fault plan drives
+    repeated active-facade SIGKILLs under continuous writer load; after
+    each kill the standby takes over and the corpse restarts as a fresh
+    standby. Gates: plan coverage (every planned kill actually fired),
+    convergence (every acked write present at the end, durably), and
+    reproducibility (the plan is a pure function of the printed seed)."""
+    from kubeflow_tpu.testing.chaos import APISERVER_KILL, FaultSchedule
+
+    seed = int(os.environ.get("KFTPU_FAILOVER_SEED") or DEFAULT_SEED)
+    print(f"# failover soak seed={seed}")
+    kills = 3
+    schedule = FaultSchedule(
+        seed, faults_per_class=kills, classes=(APISERVER_KILL,)
+    )
+    assert schedule.plan == FaultSchedule(
+        seed, faults_per_class=kills, classes=(APISERVER_KILL,)
+    ).plan
+
+    a, b, token = _boot_pair(tmp_path)
+    replicas = {a.identity: a, b.identity: b}
+    active = a.identity
+    endpoints = [a.url, b.url]
+    admin = _client(endpoints, token)
+    acked: list[str] = []
+    stop_writing = threading.Event()
+    writer_errors: list[Exception] = []
+
+    def writer() -> None:
+        client = _client(endpoints, token)
+        i = 0
+        try:
+            while not stop_writing.is_set():
+                name = f"soak-{i}"
+                _create_acked(
+                    client, new_resource("FailObj", name, "soak", spec={})
+                )
+                acked.append(name)
+                i += 1
+                time.sleep(0.01)
+        except Exception as e:
+            writer_errors.append(e)
+        finally:
+            client.close()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    takeover_seconds: list[float] = []
+    try:
+        while not schedule.exhausted:
+            fault = schedule.next_fault("GET", "/apis/_", "")
+            if fault is None:
+                time.sleep(0.05)  # gap cooldown: let load make progress
+                continue
+            assert fault.cls == APISERVER_KILL
+            time.sleep(0.3)  # in-flight load at the kill moment
+            corpse = replicas[active]
+            t_kill = time.monotonic()
+            corpse.kill()
+            schedule.mark_injected(fault)
+            survivor = next(
+                r for r in replicas.values() if r.identity != active
+            )
+            survivor.wait_marker(
+                f"leading {survivor.identity}",
+                timeout=LEASE_DURATION + 15,
+            )
+            takeover_seconds.append(time.monotonic() - t_kill)
+            active = survivor.identity
+            # Restart the corpse as a fresh standby on its old port.
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    with socket.socket() as s:
+                        s.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+                        )
+                        s.bind(("127.0.0.1", corpse.port))
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            fresh = _Replica(corpse.identity, corpse.port, tmp_path)
+            fresh.wait_marker(f"standby {corpse.identity}")
+            replicas[corpse.identity] = fresh
+        stop_writing.set()
+        t.join(timeout=60)
+        # The gate below reads `acked`; a wedged writer still mutating
+        # it would turn the zero-loss check into a race (an ack landing
+        # after the list reads as "lost" and won't reproduce from the
+        # seed).
+        assert not t.is_alive(), "writer hung past its retry deadline"
+        names = {o.metadata.name for o in admin.list("FailObj", "soak")}
+        missing = set(acked) - names
+        # Metrics BEFORE the gates: the nightly driver (`bench.py
+        # --workload controlplane`, same contract as the resilience
+        # soak's KFTPU_RESILIENCE_METRICS) gets the measured economics —
+        # including a nonzero acked_lost — even from a run the asserts
+        # below fail, so a red nightly still reports what happened.
+        metrics_path = os.environ.get("KFTPU_FAILOVER_METRICS")
+        if metrics_path and takeover_seconds:
+            import json
+
+            with open(metrics_path, "w") as f:
+                json.dump(
+                    {
+                        "kills": kills,
+                        "lease_ttl_seconds": LEASE_DURATION,
+                        "failover_seconds_mean": sum(takeover_seconds)
+                        / len(takeover_seconds),
+                        "failover_seconds_max": max(takeover_seconds),
+                        "acked_writes": len(acked),
+                        "acked_lost": len(missing),
+                        "coverage": schedule.coverage(),
+                    },
+                    f,
+                )
+        assert not writer_errors, writer_errors
+        assert schedule.coverage()[APISERVER_KILL] == kills, (
+            f"coverage gate: {schedule.coverage()} (seed {seed})"
+        )
+        assert not missing, (
+            f"acked writes lost (seed {seed}): {sorted(missing)[:5]}"
+        )
+        print(
+            f"# failover soak: {kills} kills survived, "
+            f"{len(acked)} acked writes kept, takeover "
+            f"{max(takeover_seconds):.2f}s worst (seed {seed})"
+        )
+    finally:
+        stop_writing.set()
+        admin.close()
+        for r in replicas.values():
+            if r.proc.poll() is None:
+                r.stop()

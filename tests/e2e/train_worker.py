@@ -32,7 +32,10 @@ from kubeflow_tpu.parallel import (  # noqa: E402
     build_mesh,
     initialize_from_env,
 )
-from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import (  # noqa: E402
+    HttpApiClient,
+    endpoints_from_env,
+)
 from kubeflow_tpu.train import SyntheticImages, TrainConfig, Trainer  # noqa: E402
 
 
@@ -73,7 +76,7 @@ def main() -> int:
 
     if pe.process_id == 0 and os.environ.get("KFTPU_APISERVER"):
         report_observation(
-            HttpApiClient(os.environ["KFTPU_APISERVER"]),
+            HttpApiClient(endpoints_from_env(os.environ["KFTPU_APISERVER"])),
             os.environ["TPUJOB_NAME"],
             os.environ["TPUJOB_NAMESPACE"],
             {"loss": losses[-1], "first_loss": losses[0]},

@@ -12,7 +12,10 @@ import sys
 sys.path.insert(0, os.environ["KFTPU_REPO"])
 
 from kubeflow_tpu.launcher.launcher import report_observation  # noqa: E402
-from kubeflow_tpu.testing.apiserver_http import HttpApiClient  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import (  # noqa: E402
+    HttpApiClient,
+    endpoints_from_env,
+)
 
 
 def main() -> None:
@@ -22,7 +25,7 @@ def main() -> None:
 
     loss = (args.lr - 0.05) ** 2  # minimum at lr=0.05
 
-    api = HttpApiClient(os.environ["KFTPU_APISERVER"])
+    api = HttpApiClient(endpoints_from_env(os.environ["KFTPU_APISERVER"]))
     report_observation(
         api,
         os.environ["TPUJOB_NAME"],

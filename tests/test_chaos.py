@@ -18,7 +18,9 @@ from kubeflow_tpu.testing.apiserver_http import (
     _stream_rejected,
 )
 from kubeflow_tpu.testing.chaos import (
+    APISERVER_KILL,
     FAULT_CLASSES,
+    HA_FAULT_CLASSES,
     ChaosProxy,
     Fault,
     FaultSchedule,
@@ -163,6 +165,70 @@ def test_proxy_passthrough_keepalive(proxied):
     got.status["phase"] = "Ready"
     client.update_status(got)
     assert api.get("Widget", "w3").status["phase"] == "Ready"
+
+
+def test_ha_fault_classes_extend_the_default_plan():
+    """HA_FAULT_CLASSES is the 7-class wire plan plus apiserver_kill,
+    and a schedule built from it stays a pure function of its seed."""
+    assert HA_FAULT_CLASSES == FAULT_CLASSES + (APISERVER_KILL,)
+    a = FaultSchedule(7, faults_per_class=1, classes=HA_FAULT_CLASSES)
+    b = FaultSchedule(7, faults_per_class=1, classes=HA_FAULT_CLASSES)
+    assert a.plan == b.plan
+    assert sum(1 for f in a.plan if f.cls == APISERVER_KILL) == 1
+
+
+def test_proxy_apiserver_kill_runs_executor_aborts_and_retargets():
+    """The kill_active seam, end to end: an apiserver_kill entry makes
+    the proxy call the driver's executor and abort the in-flight
+    connection (what a real SIGKILL does to that client); the executor
+    returns the STANDBY's address and the proxy retargets, so the
+    hardened client's fresh-connection retry is served by the new
+    active — an active-passive pair on per-replica ports stays
+    reachable through the one proxied address across the takeover."""
+    active = FakeApiServer()
+    active.create(mk("pre-kill"))
+    standby = FakeApiServer()  # "took over": same world + one marker
+    standby.create(mk("pre-kill"))
+    standby.create(mk("served-by-standby"))
+    server_a, _ = serve(ApiServerApp(active), host="127.0.0.1", port=0)
+    server_b, _ = serve(ApiServerApp(standby), host="127.0.0.1", port=0)
+    schedule = FaultSchedule(0, faults_per_class=0)
+    kills = []
+
+    def executor():
+        kills.append(1)
+        return ("127.0.0.1", server_b.server_port)
+
+    proxy = ChaosProxy(
+        "127.0.0.1", server_a.server_port, schedule, kill_active=executor
+    ).start()
+    client = HttpApiClient(proxy.base_url, timeout=5.0, retry_base=0.02)
+    try:
+        client.create(mk("held"))  # warm the pool: the retry is GET-safe
+        schedule._pending.append(Fault(APISERVER_KILL, 0.0, 1))
+        names = {o.metadata.name for o in client.list("Widget")}
+        assert "served-by-standby" in names, names  # retargeted
+        assert kills == [1]
+        assert schedule.coverage().get(APISERVER_KILL) == 1
+        assert schedule.exhausted
+    finally:
+        client.close()
+        proxy.stop()
+        server_a.shutdown()
+        server_b.shutdown()
+
+
+def test_proxy_apiserver_kill_without_executor_requeues(proxied):
+    """A kill entry reaching a proxy with no executor is requeued, not
+    silently dropped: traffic proceeds, coverage stays honest at zero,
+    and the plan is NOT exhausted — the soak's coverage gate would fail
+    loudly instead of reporting a kill that never happened."""
+    api, client, stage, schedule = proxied
+    stage(Fault(APISERVER_KILL, 0.0, 0))
+    client.create(mk("through"))
+    assert api.get("Widget", "through") is not None
+    assert not schedule.coverage().get(APISERVER_KILL)
+    assert not schedule.exhausted
 
 
 def test_injected_503_burst_write_retries_once_landed(proxied):

@@ -274,6 +274,64 @@ def test_resilience_soak_is_slow_marked_with_seeded_nightly_entry():
     assert "resilience soak seed=" in bench
 
 
+def test_failover_soak_is_slow_marked_with_seeded_nightly_entry():
+    """The apiserver-failover soak follows the same convention as the
+    chaos and resilience soaks: the kill-cycle nightly is `slow`-marked
+    (tier-1 runs only the single-kill deterministic e2e) and `bench.py
+    --workload controlplane` drives it with a printed seed so any
+    failure reproduces from one integer."""
+    soak = (
+        REPO / "tests" / "e2e" / "test_apiserver_failover_e2e.py"
+    ).read_text()
+    assert "@pytest.mark.slow" in soak
+    assert "KFTPU_FAILOVER_SEED" in soak
+    bench = (REPO / "bench.py").read_text()
+    assert "test_failover_soak_nightly" in bench
+    assert "KFTPU_FAILOVER_SEED" in bench
+    # The seed is printed up front (the repro contract).
+    assert "failover soak seed=" in bench
+
+
+def test_clients_built_from_config_take_endpoint_lists():
+    """Everything that builds an `HttpApiClient` from operator-supplied
+    config — the production entry points' `--apiserver`/`--server`
+    flags AND the e2e workers' KFTPU_APISERVER env — parses it with
+    `endpoints_from_env`, never as a bare string: that value IS the
+    endpoint-list channel (comma-separated for active-passive HA
+    pairs), so a `HttpApiClient(args.apiserver)` wiring would treat
+    "url1,url2" as one malformed URL — or, handed only the active's
+    URL, stall forever when that facade dies — silently losing the
+    failover the HA deployment exists to provide."""
+    import re
+
+    offenders = []
+    sources = sorted((REPO / "tests" / "e2e").glob("*worker*.py")) + [
+        REPO / "kubeflow_tpu" / p
+        for p in (
+            "cli.py",
+            "controllers/__main__.py",
+            "controllers/webhook.py",
+            "deploy/worker.py",
+            "sidecar/__main__.py",
+        )
+    ]
+    bare = re.compile(
+        r"HttpApiClient\(\s*(?:os\.environ\[|args\.)"
+    )
+    for src in sources:
+        text = src.read_text()
+        if "HttpApiClient(" not in text:
+            continue
+        if bare.search(text):
+            offenders.append(f"{src.name}: bare config-string endpoint")
+        elif "endpoints_from_env" not in text:
+            offenders.append(f"{src.name}: no endpoints_from_env")
+    assert not offenders, (
+        "config-driven clients must parse their apiserver address via "
+        f"endpoints_from_env (failover rides the list): {offenders}"
+    )
+
+
 def test_gcb_template():
     result = subprocess.run(
         [sys.executable, "tools/gcb/template.py", "--commit", "abc123"],

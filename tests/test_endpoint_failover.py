@@ -1,0 +1,395 @@
+"""Endpoint-list client failover semantics, at unit scale.
+
+`HttpApiClient` accepts an endpoint LIST (the kube client's multi-master
+server list) and fails over between active-passive facades
+(`testing/failover.py`). These tests pin the client-side contract the
+failover e2e relies on, one rule per test:
+
+- a plain-string single endpoint behaves exactly like the historical
+  `base_url` (back-compat: no rotation, same error surface);
+- a refused dial rotates to the next endpoint — for WRITES too, because
+  nothing was sent (the one unambiguous transport failure);
+- rotation is sticky: one takeover costs one rotation, not a probe per
+  request;
+- an OPEN circuit sheds requests to the next endpoint instead of
+  failing fast into the caller (breakers are per-endpoint, so the dead
+  active's history never gates its standby);
+- a watch that dies mid-stream resumes on the next endpoint through the
+  normal 410 → relist path, duplicate-free for new events.
+
+The process-level version of the same story (real SIGKILL, WAL diff) is
+`tests/e2e/test_apiserver_failover_e2e.py`.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.testing.apiserver_http import (
+    ApiServerApp,
+    HttpApiClient,
+    endpoints_from_env,
+)
+from kubeflow_tpu.testing.fake_apiserver import (
+    ApiError,
+    FakeApiServer,
+    Unavailable,
+)
+from kubeflow_tpu.web.wsgi import Response, serve
+
+
+from tests.e2e.ha_driver import free_port as _free_port  # noqa: E402
+
+
+def _wait_for(pred, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _mk(name, ns="default"):
+    return new_resource("FailObj", name, ns, spec={"x": 1})
+
+
+@pytest.fixture()
+def live():
+    """One real facade: (api, url)."""
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    yield api, f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+    api.close()
+
+
+def _client(endpoints, **kw) -> HttpApiClient:
+    kw.setdefault("timeout", 5.0)
+    kw.setdefault("watch_poll_timeout", 0.5)
+    kw.setdefault("watch_retry", 0.05)
+    kw.setdefault("retry_base", 0.02)
+    return HttpApiClient(endpoints, **kw)
+
+
+# -- env contract ----------------------------------------------------------
+
+
+def test_endpoints_from_env_parses_single_and_list():
+    assert endpoints_from_env("http://a:1") == ["http://a:1"]
+    assert endpoints_from_env(" http://a:1 , http://b:2 ") == [
+        "http://a:1",
+        "http://b:2",
+    ]
+    with pytest.raises(ValueError):
+        endpoints_from_env(" , ")
+
+
+# -- back-compat: a single endpoint is exactly the old client --------------
+
+
+def test_single_endpoint_string_back_compat(live):
+    api, url = live
+    api.create(_mk("w0"))
+    client = _client(url)  # plain string, the historical signature
+    try:
+        assert client.base_url == url
+        assert client.endpoints == (url,)
+        assert [o.metadata.name for o in client.list("FailObj")] == ["w0"]
+        assert client.failovers == 0
+    finally:
+        client.close()
+
+
+def test_single_endpoint_connect_refused_propagates():
+    """With nowhere to rotate, a dial failure surfaces as the historical
+    OSError — no silent retry loop hiding a down control plane."""
+    client = _client(f"http://127.0.0.1:{_free_port()}", timeout=1.0)
+    try:
+        with pytest.raises(OSError):
+            client.list("FailObj")
+        assert client.failovers == 0
+    finally:
+        client.close()
+
+
+class _Sick500App:
+    """A facade that answers — with a 500 — so its breaker accumulates
+    failures the endpoint-answered way (not via refused dials)."""
+
+    def __init__(self):
+        self.name = "sick"
+
+    def handle(self, req) -> Response:
+        return Response(b'{"log": "injected 500"}', status=500)
+
+
+def test_single_endpoint_breaker_open_fails_fast():
+    server, _ = serve(_Sick500App(), host="127.0.0.1", port=0)
+    client = _client(
+        f"http://127.0.0.1:{server.server_port}",
+        breaker_threshold=1,
+        breaker_cooldown=30.0,
+    )
+    try:
+        with pytest.raises(ApiError):
+            client.list("FailObj")
+        served = server.requests_served
+        # Circuit open, no standby: fail fast, without another dial.
+        with pytest.raises(Unavailable):
+            client.list("FailObj")
+        assert server.requests_served == served
+    finally:
+        client.close()
+        server.shutdown()
+
+
+# -- rotation --------------------------------------------------------------
+
+
+def test_rotates_on_connect_refused_reads_and_writes(live):
+    """A refused dial is the one failure where NOTHING was sent, so both
+    a read and a write may transparently try the next endpoint."""
+    api, url = live
+    dead = f"http://127.0.0.1:{_free_port()}"
+    client = _client([dead, url])
+    try:
+        created = client.create(_mk("via-rotation"))
+        assert created.metadata.name == "via-rotation"
+        assert api.get("FailObj", "via-rotation") is not None
+        assert client.failovers == 1
+        assert client.base_url == url  # the answerer became active
+    finally:
+        client.close()
+
+
+def test_rotation_is_sticky(live):
+    """One takeover costs ONE rotation: after failing over, every
+    subsequent request starts at the new active — the dead endpoint is
+    not re-probed per call (no per-request dial tax on a dead peer)."""
+    api, url = live
+    dead_ep = f"http://127.0.0.1:{_free_port()}"
+    client = _client([dead_ep, url])
+    try:
+        client.list("FailObj")
+        assert client.failovers == 1
+        dials_to_dead = client._endpoints[0].handshakes
+        for _ in range(10):
+            client.list("FailObj")
+        assert client.failovers == 1
+        assert client._endpoints[0].handshakes == dials_to_dead
+    finally:
+        client.close()
+
+
+def test_breaker_open_sheds_to_next_endpoint():
+    """An answering-but-sick active (5xx) is NOT walked away from per
+    request — a 5xx is the server's answer, and masking it would hide
+    real errors. Once its circuit OPENS, requests shed to the standby
+    instead of failing fast into the caller; while open, the sick
+    endpoint is not dialed at all (breakers are per-endpoint)."""
+    sick, _ = serve(_Sick500App(), host="127.0.0.1", port=0)
+    api = FakeApiServer()
+    api.create(_mk("held"))
+    good, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    client = _client(
+        [
+            f"http://127.0.0.1:{sick.server_port}",
+            f"http://127.0.0.1:{good.server_port}",
+        ],
+        breaker_threshold=2,
+        breaker_cooldown=30.0,
+    )
+    try:
+        for _ in range(2):  # accumulate failures to the threshold
+            with pytest.raises(ApiError):
+                client.list("FailObj")
+        assert client.failovers == 0
+        served_by_sick = sick.requests_served
+        # Circuit open: the walk skips the sick active entirely.
+        assert [o.metadata.name for o in client.list("FailObj")] == ["held"]
+        assert client.failovers == 1
+        assert sick.requests_served == served_by_sick
+        client.list("FailObj")
+        assert sick.requests_served == served_by_sick
+    finally:
+        client.close()
+        sick.shutdown()
+        good.shutdown()
+        api.close()
+
+
+# -- mid-watch death → 410 relist ------------------------------------------
+
+
+class _Forwarder:
+    """A TCP forwarder whose `kill()` severs EVERY connection at once.
+
+    A graceful in-proc `server.shutdown()` only stops the accept loop —
+    established keep-alive connections (the watch stream!) live on in
+    their handler threads, which is precisely what a SIGKILL does NOT
+    do. Fronting the facade with this forwarder gives the unit test the
+    e2e's kill semantics: held-open streams die mid-flight, pooled
+    connections RST, and new dials are refused."""
+
+    def __init__(self, upstream_port: int):
+        self._upstream = upstream_port
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(16)
+        self.port = self._listener.getsockname()[1]
+        self._socks: list[socket.socket] = []
+        self._lock = threading.Lock()
+        self._dead = False
+        self._accept_thread = threading.Thread(
+            target=self._accept, daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(
+                    ("127.0.0.1", self._upstream), timeout=5
+                )
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                if self._dead:
+                    client.close()
+                    up.close()
+                    return
+                self._socks += [client, up]
+            for a, b in ((client, up), (up, client)):
+                threading.Thread(
+                    target=self._pump, args=(a, b), daemon=True
+                ).start()
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        with self._lock:
+            self._dead = True
+            socks, self._socks = self._socks, []
+        # shutdown() FIRST: a bare close() while the accept thread is
+        # blocked in accept() leaves the fd open (CPython holds it for
+        # the in-progress call), so the kernel keeps completing
+        # handshakes nobody will ever serve. Waking the thread and
+        # joining it makes the port genuinely refuse — the SIGKILL
+        # semantics this forwarder exists to provide.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5)
+        self._listener.close()
+        for s in socks:
+            # Same deferred-close trap as the listener: a pump thread
+            # blocked in recv holds the fd open, so close() alone would
+            # leave the proxied stream ALIVE. shutdown() terminates the
+            # flow now — the client sees its watch die immediately.
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+
+
+def test_mid_watch_death_resumes_via_410_relist_duplicate_free(tmp_path):
+    """The watcher's failover path, end to end at unit scale: the active
+    dies mid-stream, the store advances while the watcher is dark (the
+    WAL writes it can no longer see), and the standby — restored over
+    the same durable dir — re-seeds its watch floor at the durable rv.
+    The watcher's stale bookmark gets an honest 410, relists, and
+    resumes: pre-death and dark-window objects arrive as synthetic
+    MODIFIED (the relist, by construction duplicate-free for
+    level-triggered consumers), and a genuinely new object arrives as
+    ADDED exactly once."""
+    store_dir = str(tmp_path / "store")
+    api_a = FakeApiServer(persist_dir=store_dir)
+    server_a, _ = serve(ApiServerApp(api_a), host="127.0.0.1", port=0)
+    fwd = _Forwarder(server_a.server_port)
+    port_b = _free_port()
+    client = _client(
+        [f"http://127.0.0.1:{fwd.port}", f"http://127.0.0.1:{port_b}"]
+    )
+    events: list[tuple[str, str]] = []
+    ev_lock = threading.Lock()
+
+    def handler(event, obj):
+        with ev_lock:
+            events.append((event, obj.metadata.name))
+
+    def seen(name):
+        with ev_lock:
+            return {n for _, n in events} >= {name}
+
+    server_b = None
+    try:
+        client.watch(handler, "FailObj")
+        for i in range(3):
+            client.create(_mk(f"pre-{i}"))
+        assert _wait_for(lambda: seen("pre-2")), "watch never caught up"
+
+        fwd.kill()  # the active is gone: stream RST, dials refused
+        # The dark window: acked writes the dead watcher never saw.
+        for i in range(2):
+            api_a.create(_mk(f"tail-{i}"))
+
+        # The standby takes over the durable dir: replay sets the watch
+        # floor to the durable rv, past the watcher's bookmark.
+        api_b = FakeApiServer(persist_dir=store_dir)
+        assert len(api_b.list("FailObj")) == 5  # WAL replay complete
+        server_b, _ = serve(
+            ApiServerApp(api_b), host="127.0.0.1", port=port_b
+        )
+
+        assert _wait_for(lambda: seen("tail-1")), (
+            f"watch never resumed on the standby: {events}"
+        )
+        fresh = client.create(_mk("fresh"))  # rides the rotated client
+        assert fresh.metadata.resource_version > 0
+        assert _wait_for(lambda: seen("fresh")), "post-failover event lost"
+        client.create(_mk("fresh-2"))  # sentinel: stream moved past fresh
+        assert _wait_for(lambda: seen("fresh-2"))
+
+        with ev_lock:
+            snapshot = list(events)
+        # Dark-window objects came through the RELIST (synthetic
+        # MODIFIED) — their ADDED happened while no watcher could see
+        # it, and replaying it would be an invented event.
+        tail_events = [e for e, n in snapshot if n.startswith("tail-")]
+        assert tail_events and set(tail_events) == {"MODIFIED"}, snapshot
+        # A post-failover create is delivered exactly once: the relist
+        # already happened, so nothing re-delivers it.
+        assert [n for _, n in snapshot].count("fresh") == 1, snapshot
+        assert client.failovers >= 1
+    finally:
+        client.close()
+        if server_b is not None:
+            server_b.shutdown()
+        server_a.shutdown()
+        fwd.kill()
