@@ -158,6 +158,38 @@ def main() -> None:
         "LM bench's d_attn=1024 shape)",
     )
     parser.add_argument(
+        "--roofline-seq", type=int, default=None,
+        help="attention only: sequence length for the per-phase roofline "
+        "(attn fwd / attn bwd / MLP / optimizer: ms, TFLOP, GB moved, "
+        "achieved vs bound — the mechanical version of the hand-built "
+        "table in docs/architecture.md). Default: the longest "
+        "--attn-seq-lens entry (16384 on the driver run); 0 disables",
+    )
+    parser.add_argument(
+        "--roofline-batch", type=int, default=2,
+        help="attention only: per-chip batch for the roofline phases "
+        "(2 = the measured-best 16k LM batch)",
+    )
+    parser.add_argument(
+        "--roofline-layers", type=int, default=16,
+        help="attention only: layer count the per-layer roofline phases "
+        "scale by (16 = the LM bench model)",
+    )
+    parser.add_argument(
+        "--roofline-d-model", type=int, default=1024,
+        help="attention only: model width for the roofline MLP/optimizer "
+        "phases",
+    )
+    parser.add_argument(
+        "--roofline-d-ff", type=int, default=4096,
+        help="attention only: MLP hidden width for the roofline phases",
+    )
+    parser.add_argument(
+        "--roofline-vocab", type=int, default=32_000,
+        help="attention only: vocab size for the roofline optimizer "
+        "phase's parameter count",
+    )
+    parser.add_argument(
         "--attn-dense-max", type=int, default=4096,
         help="attention only: longest S to also time the dense "
         "reference at (it materializes [S, S] scores — at 8k+ it OOMs "
@@ -1374,6 +1406,7 @@ def bench_attention(args) -> None:
 
     from kubeflow_tpu.ops.attention import dense_attention
     from kubeflow_tpu.ops.flash import flash_attention, flash_schedule
+    from kubeflow_tpu.train.profiling import time_phase
 
     seq_lens = [int(s) for s in args.attn_seq_lens.split(",") if s]
     b = args.batch_size or 4
@@ -1385,19 +1418,11 @@ def bench_attention(args) -> None:
     steps = max(1, args.steps)
 
     def timed(fn, *xs) -> float:
-        # Same fencing discipline as timed_run: a scalar device_get is
-        # the only reliable fence on tunneled platforms, and the warmup
-        # (compile + --warmup-steps dispatches) ends with one so no
-        # warmup work leaks into the timed window.
-        out = None
-        for _ in range(max(1, args.warmup_steps)):
-            out = fn(*xs)
-        float(jax.tree_util.tree_leaves(out)[0].sum())
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(*xs)
-        float(jax.tree_util.tree_leaves(out)[0].sum())
-        return (time.perf_counter() - t0) / steps
+        # The shared fence-disciplined timer (seconds per call).
+        return (
+            time_phase(fn, *xs, warmup=args.warmup_steps, steps=steps)
+            / 1000.0
+        )
 
     for s in seq_lens:
         key = jax.random.PRNGKey(0)
@@ -1442,8 +1467,14 @@ def bench_attention(args) -> None:
                 (fwd_flops + bwd_flops) / timed(dense_grad, q, k, v) / 1e12
             )
 
-        sched = flash_schedule(s, s, block_q=bq, block_k=bk, causal=True)
+        sched = flash_schedule(
+            s, s, block_q=bq, block_k=bk, causal=True, head_dim=d,
+            dtype_bytes=jnp.dtype(dtype).itemsize,
+        )
         bh = b * h
+        bwd_ratio = (
+            sched["bwd_hbm_bytes"] / sched["bwd_hbm_bytes_two_pass"]
+        )
         sig4 = lambda x: float(f"{x:.4g}")  # interpret-mode runs are tiny
         rows = (
             (
@@ -1479,6 +1510,17 @@ def bench_attention(args) -> None:
                     sched["lse_bytes"] / sched["lse_replicated_bytes"], 6
                 ),
             ),
+            (
+                f"attention_bwd_hbm_bytes_s{s}",
+                sched["bwd_hbm_bytes"] * bh,
+                f"modeled bwd HBM bytes incl. shared-delta "
+                f"({'fused one-pass' if sched['bwd_fused'] else 'two-pass'}; "
+                f"two-pass = {sched['bwd_hbm_bytes_two_pass'] * bh}, "
+                f"{sched['bwd_total_grid_steps']} bwd grid steps per bh "
+                f"row, fused VMEM "
+                f"{sched['bwd_fused_vmem_bytes'] / 2**20:.1f} MiB)",
+                round(bwd_ratio, 4),
+            ),
         )
         for metric, value, unit, vs in rows:
             print(
@@ -1503,9 +1545,224 @@ def bench_attention(args) -> None:
             f"{dense_note}; grid {sched['grid_steps']}/"
             f"{sched['rect_grid_steps']} steps "
             f"(compact={sched['compact']}), lse "
-            f"{sched['lse_bytes'] * bh}B (packed={sched['lse_packed']})",
+            f"{sched['lse_bytes'] * bh}B (packed={sched['lse_packed']}), "
+            f"bwd {'FUSED' if sched['bwd_fused'] else 'two-pass'} "
+            f"{bwd_ratio:.3f}x two-pass bytes",
             file=sys.stderr,
         )
+
+        # -- fused-backward contract gates --------------------------------
+        # The byte model above IS the accounting `_flash_bwd_kernels`
+        # dispatches on, but the bench additionally proves (a) the traced
+        # program really contains the fused kernel and neither two-pass
+        # kernel, and (b) the model says ~half the two-pass bytes once
+        # the triangle is deep enough for the per-step streams to
+        # dominate (nq >= 8; at shallow grids the resident blocks and
+        # output writes keep the ratio nearer 2/3).
+        if sched["bwd_fused"]:
+            bwd_jaxpr = str(
+                jax.make_jaxpr(jax.grad(flash_loss, argnums=(0, 1, 2)))(
+                    q, k, v
+                )
+            )
+            if (
+                "_dqkv_kernel_fused" not in bwd_jaxpr
+                or "_dq_kernel" in bwd_jaxpr
+                or "_dkv_kernel" in bwd_jaxpr
+            ):
+                raise SystemExit(
+                    f"attention s={s}: flash_schedule says the fused "
+                    "backward engages but the traced grad does not run "
+                    "exactly the fused kernel (fused="
+                    f"{'_dqkv_kernel_fused' in bwd_jaxpr}, two-pass dq="
+                    f"{'_dq_kernel' in bwd_jaxpr}, dkv="
+                    f"{'_dkv_kernel' in bwd_jaxpr}) — schedule accounting "
+                    "and dispatch have drifted"
+                )
+            nq_bwd = sched["padded_seq_q"] // sched["bwd_block_q"]
+            if nq_bwd >= 8 and bwd_ratio > 0.62:
+                raise SystemExit(
+                    f"attention s={s}: fused backward models only "
+                    f"{bwd_ratio:.3f}x the two-pass HBM bytes (expected "
+                    "<= 0.62 at nq >= 8) — the one-pass byte halving "
+                    "regressed"
+                )
+
+    roofline_s = (
+        args.roofline_seq if args.roofline_seq is not None else max(seq_lens)
+    )
+    if roofline_s:
+        _attention_roofline(args, roofline_s, bq, bk, d, dtype)
+
+
+def _attention_roofline(args, s: int, bq: int, bk: int, d: int, dtype):
+    """Mechanical per-phase roofline at sequence length `s` — the
+    docs/architecture.md Round-5 table as a bench artifact instead of a
+    hand-built spreadsheet. Four phases at the LM shape
+    (--roofline-batch/-layers/-d-model/-d-ff/-vocab):
+
+    - attn_fwd:  one layer's flash forward, scaled by layers;
+    - attn_bwd:  grad minus forward — the shared-delta precompute plus
+                 the (fused) dq/dkv backward, the 16k dominant phase;
+    - mlp:       the gated 3-matrix MLP, fwd+bwd;
+    - optimizer: an adamw-shaped update (bf16 mu) over the full LM
+                 parameter count — pure HBM traffic.
+
+    Per phase: measured ms (fence-disciplined), modeled TFLOP (causal
+    MFU accounting — recompute not counted) and GB moved (the same
+    `flash_schedule` byte model the backward dispatch gates on), and
+    the achieved-vs-peak classification naming the binding resource.
+    Off-TPU the wall-clock is the interpreter's (the accounting columns
+    are exact either way) — the driver's TPU run is the artifact that
+    names the saturated resource."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops.flash import flash_attention, flash_schedule
+    from kubeflow_tpu.train.profiling import PhaseRoofline, time_phase
+
+    b = args.roofline_batch
+    dm = args.roofline_d_model
+    dff = args.roofline_d_ff
+    n_layers = args.roofline_layers
+    h = max(1, dm // d)
+    bh = b * h
+    isz = jnp.dtype(dtype).itemsize
+    wu, st = max(1, args.warmup_steps), max(1, args.steps)
+    sched = flash_schedule(
+        s, s, block_q=bq, block_k=bk, causal=True, head_dim=d,
+        dtype_bytes=isz,
+    )
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, h, d), dtype)
+    v = jax.random.normal(kv, (b, s, h, d), dtype)
+
+    attn = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk
+        )
+    )
+    attn_grad = jax.jit(
+        jax.grad(
+            lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2),
+        )
+    )
+    t_attn_fwd = time_phase(attn, q, k, v, warmup=wu, steps=st)
+    t_attn_bwd = max(
+        time_phase(attn_grad, q, k, v, warmup=wu, steps=st) - t_attn_fwd,
+        1e-6,
+    )
+    # Causal MFU accounting, same as the per-S loop: 2 fwd matmuls over
+    # the S²/2 triangle, bwd = 5/2 × fwd. Bytes use the pipeline-stream
+    # model the backward's `bwd_hbm_bytes` uses: the fwd grid is
+    # row-major, so q (read) and o (write) move once per row while K/V
+    # stream once per grid STEP; bwd is the schedule's modeled (fused or
+    # two-pass) figure including the delta precompute.
+    attn_fwd_flops = 2 * b * h * s * s * d
+    sp = sched["padded_seq_q"]
+    attn_fwd_gb = bh * (
+        2 * sp * d * isz  # q read, o write (once per row)
+        + sched["grid_steps"] * 2 * sched["block_k"] * d * isz  # k, v
+        + sched["lse_bytes"]
+    ) / 1e9
+    attn_bwd_gb = bh * sched["bwd_hbm_bytes"] / 1e9
+
+    tokens = b * s
+    x = jax.random.normal(kq, (tokens, dm), dtype)
+    w1 = jax.random.normal(kk, (dm, dff), dtype) * 0.02
+    wg = jax.random.normal(kv, (dm, dff), dtype) * 0.02
+    w2 = jax.random.normal(kq, (dff, dm), dtype) * 0.02
+
+    def mlp(x, w1, wg, w2):
+        hidden = jnp.dot(x, w1) * jax.nn.silu(jnp.dot(x, wg))
+        return jnp.dot(hidden, w2)
+
+    mlp_grad = jax.jit(
+        jax.grad(
+            lambda *a: jnp.sum(mlp(*a).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2, 3),
+        )
+    )
+    t_mlp = time_phase(mlp_grad, x, w1, wg, w2, warmup=wu, steps=st)
+    mlp_flops = 3 * (2 * tokens * 3 * dm * dff)  # fwd + 2x bwd
+    # Activation traffic per fwd pass (x in, two hiddens out, product
+    # in, out written) ≈ 3x in bwd+fwd combined; weights read fwd and
+    # bwd, f32 weight grads written.
+    mlp_act = tokens * (2 * dm + 3 * dff) * isz
+    mlp_wgt = 3 * dm * dff
+    mlp_gb = (3 * mlp_act + 2 * mlp_wgt * isz + mlp_wgt * 4) / 1e9
+
+    d_attn = h * d
+    n_params = (
+        n_layers * (4 * dm * d_attn + 3 * dm * dff)
+        + args.roofline_vocab * dm
+    )
+    p0 = jnp.zeros((n_params,), jnp.float32)
+    g0 = jnp.full((n_params,), 1e-3, jnp.float32)
+    mu0 = jnp.zeros((n_params,), jnp.bfloat16)
+    nu0 = jnp.zeros((n_params,), jnp.float32)
+
+    @jax.jit
+    def opt_step(p, mu, nu, g):
+        # adamw-shaped update with the trainer's bf16 first moment:
+        # reads p/mu/nu/g, writes p/mu/nu — 24 bytes/param, ~0 FLOP.
+        mu32 = 0.9 * mu.astype(jnp.float32) + 0.1 * g
+        nu = 0.999 * nu + 0.001 * g * g
+        p = p - 3e-4 * mu32 / (jnp.sqrt(nu) + 1e-8)
+        return p, mu32.astype(jnp.bfloat16), nu
+
+    t_opt = time_phase(opt_step, p0, mu0, nu0, g0, warmup=wu, steps=st)
+    opt_gb = n_params * 24 / 1e9
+
+    roof = PhaseRoofline()
+    phases = (
+        (
+            "attn_fwd",
+            t_attn_fwd * n_layers,
+            n_layers * attn_fwd_flops / 1e12,
+            n_layers * attn_fwd_gb,
+        ),
+        (
+            "attn_bwd",
+            t_attn_bwd * n_layers,
+            n_layers * attn_fwd_flops * 5 / 2 / 1e12,
+            n_layers * attn_bwd_gb,
+        ),
+        ("mlp", t_mlp * n_layers, n_layers * mlp_flops / 1e12,
+         n_layers * mlp_gb),
+        ("optimizer", t_opt, 0.0, opt_gb),
+    )
+    for name, ms, tflop, gb in phases:
+        row = roof.add(name, ms=ms, tflop=tflop, gb=gb)
+        print(
+            json.dumps(
+                {
+                    "metric": f"roofline_{name}_ms_s{s}",
+                    "value": round(ms, 3),
+                    "unit": (
+                        f"ms ({row['tflop']} TFLOP, {row['gb']} GB; "
+                        f"{row['achieved_tflops']} TF/s "
+                        f"({row['compute_frac'] * 100:.0f}%), "
+                        f"{row['achieved_gbps']} GB/s "
+                        f"({row['bw_frac'] * 100:.0f}%); bound: "
+                        f"{row['bound_by']})"
+                    ),
+                    "vs_baseline": None,
+                }
+            )
+        )
+    print(
+        f"# roofline s={s} b={b} layers={n_layers} d_model={dm} "
+        f"d_ff={dff} params={n_params / 1e6:.0f}M "
+        f"(bwd {'fused' if sched['bwd_fused'] else 'two-pass'}):",
+        file=sys.stderr,
+    )
+    for line in roof.table().splitlines():
+        print(f"# {line}", file=sys.stderr)
+    print(f"# roofline saturated phase — {roof.saturated()}",
+          file=sys.stderr)
 
 
 def bench_pipeline(args) -> None:
@@ -1843,7 +2100,11 @@ def bench_lm(args) -> None:
         6 * (layer_params + head_params)
         + 6 * cfg.n_layers * args.seq_len * d_attn
     )
-    V5E_PEAK_BF16 = 197e12
+    from kubeflow_tpu.train.profiling import V5E_PEAK_TFLOPS
+
+    # One source for the chip peak: the roofline layer's constant (the
+    # roofline_* rows in the same artifact divide by it too).
+    V5E_PEAK_BF16 = V5E_PEAK_TFLOPS * 1e12
     mfu = per_chip * flops_per_token / V5E_PEAK_BF16
     # Baselines are this repo's own r05 driver artifact (BENCH_r05.json),
     # recorded per seq-len in BASELINE.json's `published` map — the MFU
@@ -1859,7 +2120,14 @@ def bench_lm(args) -> None:
     print(
         json.dumps(
             {
-                "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+                # Per-seq-len metric name (like the MFU row) so the three
+                # headline rows in a default-run artifact are distinct
+                # and EVERY one resolves a real vs_baseline from the
+                # published per-S map.
+                "metric": (
+                    "transformer_lm_train_tokens_per_sec_per_chip"
+                    f"_s{args.seq_len}"
+                ),
                 "value": round(per_chip, 1),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": (

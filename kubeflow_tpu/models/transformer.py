@@ -87,9 +87,14 @@ class TransformerConfig:
     # records the winning configs per S).
     flash_block_q: int = 1024
     flash_block_k: int = 1024
-    # Backward-pass tiles (None = same as forward). The bwd kernels carry
-    # two extra f32 VMEM accumulators, so wide fwd tiles can pair with
-    # safer bwd tiles.
+    # Backward-pass tiles (None = same as forward). NOTE: the fused
+    # one-pass dq/dkv backward (ops/flash.py, ISSUE 7) requires SQUARE
+    # bwd tiles (the compact triangular grid) and engages while its dq
+    # ring fits VMEM — asymmetric bwd tiles forfeit both the compact
+    # enumeration and the fusion, and smaller squares raise the
+    # streamed bytes (docs/architecture.md Round-6 dead-end log), so
+    # the (1024, 1024) default is also the fused-backward winner at
+    # every measured S.
     flash_block_q_bwd: int | None = None
     flash_block_k_bwd: int | None = None
     # MoE: 0 experts = dense MLP. Top-1 (switch) routing with capacity.

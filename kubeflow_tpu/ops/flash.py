@@ -26,10 +26,27 @@ on top:
   not lane-aligned fall back to the replicated layout with a slim
   [BH, S, 1] residual.
 - **Shared-delta backward.** A small precompute kernel emits
-  delta = rowsum(dO ∘ O) once per backward; both `_dq_kernel` and
-  `_dkv_kernel` read it as an input instead of each recomputing the
-  rowsum on-chip — which also removes O entirely from both kernels'
-  input streams (dO/O were previously re-streamed by each).
+  delta = rowsum(dO ∘ O) once per backward; the backward kernels read
+  it as an input instead of each recomputing the rowsum on-chip — which
+  also removes O entirely from the backward input streams (dO/O were
+  previously re-streamed by each kernel).
+- **Fused one-pass dq/dkv backward.** On the compact causal grid the
+  backward is ONE kernel (`_dqkv_kernel_fused`) walking the triangle
+  once in column-major order: dk/dv accumulate in per-column VMEM
+  scratch (as the two-pass dkv kernel did), and each step's dq
+  contribution lands in a per-row slot of a VMEM dq ring — every q row
+  is live from the first kv column and retires in row order (row j's
+  last contribution is column j's diagonal step), so slot j flushes to
+  the dq output when column j completes. K/V are fetched once per
+  COLUMN and only Q/dO (plus the slim lse/delta rows) stream per grid
+  step; the two-pass backward streamed K/V per dq step AND Q/dO per
+  dkv step, so fusing halves the dominant bwd HBM traffic — and the
+  (s, p, ds) recurrence is computed once instead of twice (5 block
+  matmuls, not 7). The dq ring costs S·d·4 bytes of VMEM, so fusion is
+  gated by `_bwd_fused` (the same predicate `flash_schedule` reports as
+  `bwd_fused`); past the budget — or on the rectangular fallback — the
+  two-pass kernels run unchanged. `KFTPU_FLASH_FUSED_BWD=0` force-
+  disables fusion (operational escape hatch).
 - **Internal padding.** Sequence lengths with no 8-aligned divisor pad
   to the next lane multiple inside `flash_attention`; the tail is
   masked in-kernel (`kv_len`) and sliced off the output, so ragged
@@ -58,6 +75,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -252,6 +270,116 @@ def _tri_tables(nq: int, order: str):
     return jnp.asarray(i, jnp.int32), jnp.asarray(j, jnp.int32)
 
 
+# -- fused backward gating + HBM byte model ----------------------------------
+#
+# The fused one-pass backward holds a full dq accumulator ring in VMEM
+# (one f32 row-block slot per q block: every row is live from the first
+# kv column), so it engages only while that scratch — plus the dk/dv
+# accumulators and the double-buffered streamed blocks — fits a VMEM
+# budget. ~16 MiB/core on v5e; 12 MiB leaves margin for Mosaic's own
+# buffers. At the flagship shape (S=16384, d=128, bf16, 1024² blocks)
+# the fused footprint is ~11.1 MiB, so the 16k target regime fuses; a
+# 32k/d=128 dq ring alone is 16 MiB and falls back to two-pass.
+_FUSED_VMEM_BUDGET = 12 * 1024 * 1024
+# Operational escape hatch: KFTPU_FLASH_FUSED_BWD=0 pins the two-pass
+# backward everywhere (e.g. if a toolchain rejects the fused kernel).
+# Read at TRACE time — jit caches a traced backward by shapes/static
+# args, so this is a set-before-first-use process knob (a rollback
+# lever for launch scripts), not a runtime toggle: flipping it after a
+# shape has been traced does not retrace that shape.
+_FUSED_ENV = "KFTPU_FLASH_FUSED_BWD"
+
+
+def _fused_enabled() -> bool:
+    return os.environ.get(_FUSED_ENV, "1") != "0"
+
+
+def _lse_bytes_of(sq: int, packed: bool) -> int:
+    return int(np.prod(_lse_layout_shape(1, sq, packed)[1:])) * 4
+
+
+def _lse_block_bytes(bq: int, packed: bool) -> int:
+    return int(np.prod(_lse_block(bq, packed))) * 4
+
+
+def _fused_vmem_bytes(
+    sq: int, bq: int, bk: int, d: int, itemsize: int, packed: bool
+) -> int:
+    """VMEM the fused kernel needs: the dq ring (f32, one slot per q
+    block — i.e. the whole padded sequence), per-column dk/dv f32
+    accumulators, and the Pallas-double-buffered streamed blocks."""
+    return (
+        sq * d * 4  # dq ring scratch
+        + 2 * bk * d * 4  # dk/dv accumulators
+        + 2 * 2 * bq * d * itemsize  # q, do blocks (double-buffered)
+        + 2 * 2 * bk * d * itemsize  # k, v blocks (double-buffered)
+        + 2 * 2 * _lse_block_bytes(bq, packed)  # lse, delta blocks
+    )
+
+
+def _bwd_fused(
+    causal: bool, sq: int, sk: int, bq: int, bk: int, d: int,
+    itemsize: int, packed: bool,
+) -> bool:
+    """Whether the backward runs the fused one-pass kernel: compact
+    causal grid (square blocks, self-attention) AND the dq ring fits
+    the VMEM budget. Shared verbatim by `flash_schedule` (reported as
+    `bwd_fused`) and the `_flash_bwd_kernels` dispatch, so the
+    accounting benches/tests gate on is the schedule that actually
+    runs."""
+    if not _fused_enabled():
+        return False
+    if not _compactable(causal, sq, sk, bq, bk):
+        return False
+    return (
+        _fused_vmem_bytes(sq, bq, bk, d, itemsize, packed)
+        <= _FUSED_VMEM_BUDGET
+    )
+
+
+def _bwd_hbm_bytes(
+    causal: bool, sq: int, sk: int, bq: int, bk: int, d: int,
+    itemsize: int, packed: bool, fused: bool,
+) -> int:
+    """Modeled backward HBM bytes per (batch·head) grid row, including
+    the shared-delta precompute. Counts what each kernel's BlockSpec
+    pipeline actually moves: blocks whose index map is constant across
+    consecutive grid steps are fetched once per row/column (Mosaic
+    elides the re-fetch); blocks whose index changes stream once per
+    step. DMA elision on the predicated rectangular fallback is not
+    modeled (it is not the path this model exists to tune)."""
+    steps, _, _ = _grid_steps(causal, sq, sk, bq, bk)
+    lse_bytes = _lse_bytes_of(sq, packed)
+    lse_blk = _lse_block_bytes(bq, packed)
+    # delta = rowsum(dO ∘ O): one pass over (o, do), one lse-layout write.
+    delta = 2 * sq * d * itemsize + lse_bytes
+    if fused:
+        # One walk, column-major: k/v resident per column; q/do/lse/delta
+        # stream per step; dq+dk+dv written once each.
+        return delta + (
+            2 * sk * d * itemsize  # k, v (once per column)
+            + steps * 2 * bq * d * itemsize  # q, do per step
+            + steps * 2 * lse_blk  # lse, delta rows per step
+            + 3 * sq * d * itemsize  # dq, dk, dv writes
+        )
+    # Two passes over the same grid: the dq kernel (row-major) streams
+    # k/v per step with q/do/lse/delta resident per row; the dkv kernel
+    # (column-major) streams q/do/lse/delta per step with k/v resident.
+    dq_pass = (
+        2 * sq * d * itemsize  # q, do (once per row)
+        + 2 * lse_bytes  # lse, delta (once per row)
+        + steps * 2 * bk * d * itemsize  # k, v per step
+        + sq * d * itemsize  # dq write
+    )
+    dkv_pass = (
+        2 * sk * d * itemsize  # k, v (once per column)
+        + steps * 2 * bq * d * itemsize  # q, do per step
+        + steps * 2 * lse_blk  # lse, delta rows per step
+        + 2 * sk * d * itemsize  # dk, dv writes
+    )
+    return delta + dq_pass + dkv_pass
+
+
 def flash_schedule(
     seq_q: int,
     seq_k: int,
@@ -261,14 +389,18 @@ def flash_schedule(
     bwd_block_q: int | None = None,
     bwd_block_k: int | None = None,
     causal: bool = True,
+    head_dim: int = 128,
+    dtype_bytes: int = 2,
 ) -> dict:
     """Static accounting for the schedule `flash_attention` would run.
 
     This is the single source of truth the kernel impls themselves use
-    (`_grid_steps`, `_lse_is_packed`, `_pad_to_tileable`), exposed so
-    benches and regression tests can assert grid-step counts and lse
-    HBM bytes without launching a kernel. All byte/step figures are per
-    (batch*head) grid row."""
+    (`_grid_steps`, `_lse_is_packed`, `_pad_to_tileable`, `_bwd_fused`),
+    exposed so benches and regression tests can assert grid-step counts
+    and lse/backward HBM bytes without launching a kernel. All
+    byte/step figures are per (batch*head) grid row; `head_dim` and
+    `dtype_bytes` (2 = bf16, the training dtype) parameterize the
+    backward byte/VMEM models only."""
     sp_q = _pad_to_tileable(block_q, seq_q)
     sp_k = _pad_to_tileable(block_k, seq_k)
     bq = _pick_block(block_q, sp_q)
@@ -283,6 +415,12 @@ def flash_schedule(
     )
     packed = _lse_is_packed(sp_q, bq, bq_bwd)
     lse_shape = _lse_layout_shape(1, sp_q, packed)[1:]
+    fused = _bwd_fused(
+        causal, sp_q, sp_k, bq_bwd, bk_bwd, head_dim, dtype_bytes, packed
+    )
+    bwd_bytes = lambda f: _bwd_hbm_bytes(
+        causal, sp_q, sp_k, bq_bwd, bk_bwd, head_dim, dtype_bytes, packed, f
+    )
     return {
         "padded_seq_q": sp_q,
         "padded_seq_k": sp_k,
@@ -296,6 +434,19 @@ def flash_schedule(
         "bwd_compact": bwd_compact,
         "bwd_grid_steps": bwd_steps,
         "bwd_rect_grid_steps": bwd_rect,
+        # Fused one-pass backward: whether it engages at these
+        # shapes/dtype, the total bwd grid steps actually walked (one
+        # triangle pass fused, two passes otherwise — the single-KV-pass
+        # gate), and the modeled HBM bytes per bh row for BOTH paths so
+        # benches can assert the fused path's ~halving.
+        "bwd_fused": fused,
+        "bwd_total_grid_steps": bwd_steps if fused else 2 * bwd_steps,
+        "bwd_fused_vmem_bytes": _fused_vmem_bytes(
+            sp_q, bq_bwd, bk_bwd, head_dim, dtype_bytes, packed
+        ),
+        "bwd_hbm_bytes": bwd_bytes(fused),
+        "bwd_hbm_bytes_fused": bwd_bytes(True),
+        "bwd_hbm_bytes_two_pass": bwd_bytes(False),
         "lse_packed": packed,
         "lse_shape": lse_shape,
         "lse_bytes": int(np.prod(lse_shape)) * 4,
@@ -560,6 +711,93 @@ def _dkv_kernel_compact(
     )
 
 
+def _dqkv_kernel_fused(
+    rows_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref, dq_ring, dk_acc, dv_acc,
+    *, scale: float, causal: bool, bq: int, bk: int,
+    kv_len: int | None, packed: bool, nq: int,
+):
+    """Fused one-pass backward over the compact causal grid, column-major
+    (for each kv block j, q blocks i = j..nq-1 are contiguous).
+
+    Each step computes the (s, p, ds) recurrence ONCE and feeds all
+    three gradients: dk/dv accumulate in per-column scratch exactly like
+    `_dkv_kernel_compact`, and the step's dq contribution ds·K lands in
+    slot i of the dq ring. Every q row is live from column 0 and retires
+    in row order — row j's last contribution is column j's diagonal
+    step (the column's FIRST step, since i ascends from j) — so slot j
+    flushes to the dq output block when column j completes. The three
+    output BlockSpecs all ride the column index, which is constant
+    within a column: one HBM write per output block.
+
+    Input streams are q/do/lse/delta (per step) and k/v (once per
+    column). O is NOT an input — delta carries the rowsum(dO ∘ O)
+    precompute (shared-delta contract, see `_delta_kernel`)."""
+    t = pl.program_id(1)
+    i = rows_ref[t]
+    j = cols_ref[t]
+    first = i == j  # column j's first step (the diagonal block)
+    last = i == nq - 1  # column j's last step
+
+    @pl.when(first)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        s = _causal_mask(s, i, j, bq, bk)
+    if kv_len is not None:
+        s = _kv_tail_mask(s, j, bk, kv_len)
+    lse = _read_rows(lse_ref[0], packed)
+    p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - lse))
+    do = do_ref[0].astype(jnp.float32)
+    dv_acc[:] = dv_acc[:] + lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = lax.dot_general(
+        do,
+        v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - _read_rows(delta_ref[0], packed))
+    dk_acc[:] = dk_acc[:] + lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # dq contribution for row i from column j; q was loaded pre-scaled,
+    # so the ring carries the 1/sqrt(d) factor once more at flush (same
+    # algebra as `_dq_body`'s finalize).
+    dq_i = lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    slot = pl.ds(i * bq, bq)
+
+    @pl.when(j == 0)
+    def _seed():
+        # Column 0 is every row's first contribution — a store, not an
+        # accumulate, so the ring never needs a zeroing pass.
+        dq_ring[slot, :] = dq_i
+
+    @pl.when(j > 0)
+    def _accum():
+        dq_ring[slot, :] = dq_ring[slot, :] + dq_i
+
+    @pl.when(last)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        # Row j retired at this column's diagonal step; its completed
+        # slot flushes into the column-indexed dq output block.
+        dq_ref[0] = (dq_ring[pl.ds(j * bq, bq), :] * scale).astype(
+            dq_ref.dtype
+        )
+
+
 # -- clamped index maps (rectangular fallback only) --------------------------
 
 
@@ -697,15 +935,21 @@ def _flash_delta_impl(o, do, block_q, interpret, packed):
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "causal", "block_q", "block_k", "interpret", "kv_len", "packed"
+        "causal", "block_q", "block_k", "interpret", "kv_len", "packed",
+        "fused",
     ),
 )
 def _flash_bwd_kernels(
     q, k, v, do, lse, delta, causal, block_q, block_k, interpret,
-    kv_len=None, packed=False,
+    kv_len=None, packed=False, fused=None,
 ):
-    """dQ and dK/dV kernels over a precomputed (lse, delta) pair, both in
-    the kernel lse layout."""
+    """Backward kernels over a precomputed (lse, delta) pair (both in
+    the kernel lse layout): the fused one-pass dq/dkv kernel when
+    `_bwd_fused` allows (compact causal grid + dq ring fits VMEM), else
+    the two-pass dq + dkv kernels. `fused=None` auto-selects via the
+    same predicate `flash_schedule` reports; tests pass True/False to
+    pin a path (True on a non-compactable or over-budget shape is an
+    error — the fused kernel only exists on the compact grid)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = _pick_block(block_q, sq)
@@ -713,6 +957,25 @@ def _flash_bwd_kernels(
     scale = 1.0 / math.sqrt(d)
     steps, _, compact = _grid_steps(causal, sq, sk, bq, bk)
     nq, nk = sq // bq, sk // bk
+    if fused is None:
+        fused = _bwd_fused(
+            causal, sq, sk, bq, bk, d, q.dtype.itemsize, packed
+        )
+    elif fused:
+        if not _compactable(causal, sq, sk, bq, bk):
+            raise ValueError(
+                "fused flash backward requires the compact causal grid "
+                f"(causal self-attention, square blocks); got "
+                f"causal={causal} sq={sq} sk={sk} bq={bq} bk={bk}"
+            )
+        vmem = _fused_vmem_bytes(sq, bq, bk, d, q.dtype.itemsize, packed)
+        if vmem > _FUSED_VMEM_BUDGET:
+            raise ValueError(
+                "fused flash backward forced on an over-budget shape: "
+                f"the dq ring + accumulators need {vmem / 2**20:.1f} MiB "
+                f"of VMEM (budget {_FUSED_VMEM_BUDGET / 2**20:.0f} MiB) "
+                "— use the two-pass path"
+            )
     kw = dict(
         scale=scale, causal=causal, bq=bq, bk=bk, kv_len=kv_len,
         packed=packed,
@@ -732,6 +995,55 @@ def _flash_bwd_kernels(
                 _lse_block(bq, packed), lambda *a: (a[0], qidx(*a[1:]), 0)
             ),
         ]
+
+    if fused:
+        # One pass over the triangle, column-major: dk/dv per-column
+        # accumulators + the dq ring (see `_dqkv_kernel_fused`). All
+        # three outputs ride the column index. The cost estimate counts
+        # the 5 block matmuls (the two-pass path re-derives s/dp and
+        # pays 7) and the modeled one-pass HBM bytes.
+        rows_c, cols_c = _tri_tables(nq, "col")
+        cost = pl.CostEstimate(
+            flops=10 * bh * steps * bq * bk * d,
+            bytes_accessed=bh * (
+                _bwd_hbm_bytes(
+                    causal, sq, sk, bq, bk, d, q.dtype.itemsize, packed,
+                    True,
+                )
+                - 2 * sq * d * q.dtype.itemsize  # delta precompute's share
+                - _lse_bytes_of(sq, packed)
+            ),
+            transcendentals=bh * steps * bq * bk,
+        )
+        col_idx = lambda b, t, rs, cs: (b, cs[t], 0)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dqkv_kernel_fused, nq=nq, **kw),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, steps),
+                in_specs=_row_specs(
+                    lambda t, rs, cs: rs[t], lambda t, rs, cs: cs[t]
+                ),
+                out_specs=[
+                    pl.BlockSpec((1, bq, d), col_idx),
+                    pl.BlockSpec((1, bk, d), col_idx),
+                    pl.BlockSpec((1, bk, d), col_idx),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((nq * bq, d), jnp.float32),  # dq ring
+                    pltpu.VMEM((bk, d), jnp.float32),
+                    pltpu.VMEM((bk, d), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            ],
+            cost_estimate=cost,
+            interpret=interpret,
+        )(rows_c, cols_c, q, k, v, do, lse, delta)
+        return dq, dk, dv
 
     if compact:
         rows, cols = _tri_tables(nq, "row")
@@ -945,8 +1257,10 @@ def flash_attention(
         b * h, x.shape[1], d
     )
     # The backward kernels carry bigger VMEM footprints (extra f32
-    # accumulators), so wide forward tiles can be paired with safer
-    # backward tiles; default = same blocks both ways.
+    # accumulators, and the fused one-pass kernel's dq ring), so wide
+    # forward tiles can be paired with safer backward tiles; default =
+    # same blocks both ways. Note the fused backward needs SQUARE bwd
+    # blocks (compact grid) — asymmetric pairs fall back to two-pass.
     o, lse = _flash_bhsd(
         to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, block_q, block_k,
         bwd_block_q or block_q, bwd_block_k or block_k, interp, kv_len,

@@ -18,8 +18,11 @@ from kubeflow_tpu.train.loop import (
 )
 from kubeflow_tpu.train.profiling import (
     MetricsLogger,
+    PhaseRoofline,
+    PhaseStat,
     Profiler,
     ProfileSchedule,
     annotate,
     annotated_scope,
+    time_phase,
 )

@@ -11,7 +11,14 @@ tracing is expensive: profile steps [start, start+steps), not the whole
 run.
 
 Also here: `annotate` / `annotated_scope` — TraceAnnotation wrappers so
-named regions show up on the trace timeline.
+named regions show up on the trace timeline — and the per-phase
+roofline layer (`time_phase`, `PhaseRoofline`): the mechanical version
+of the hand-built phase table in docs/architecture.md Round 5. A bench
+times each phase of a step (attention fwd/bwd, MLP, optimizer) with the
+fence discipline tunneled TPUs require, attaches the phase's modeled
+TFLOP and HBM bytes, and the roofline classifies which hardware
+resource each phase saturates against the chip's peaks — so "where the
+ceiling is" is a printed artifact, not a one-off spreadsheet.
 """
 
 from __future__ import annotations
@@ -100,6 +107,133 @@ class Profiler:
     @property
     def trace_written(self) -> bool:
         return self._done
+
+
+# -- per-phase roofline ------------------------------------------------------
+
+# v5e chip peaks (docs/architecture.md roofline sections use the same
+# constants): bf16 matmul throughput and HBM bandwidth.
+V5E_PEAK_TFLOPS = 197.0
+V5E_PEAK_GBPS = 819.0
+
+
+def time_phase(fn, *args, warmup: int = 2, steps: int = 5) -> float:
+    """Milliseconds per call of `fn(*args)`, fence-disciplined.
+
+    Same contract as bench.py's `timed_run`: on tunneled/remote
+    platforms `block_until_ready` can return before the device has
+    executed, so the warmup ends — and the timed window closes — with a
+    scalar device_get of the first output leaf (the only reliable
+    fence)."""
+    import jax
+
+    out = None
+    for _ in range(max(1, warmup)):
+        out = fn(*args)
+    float(jax.tree_util.tree_leaves(out)[0].sum())
+    t0 = time.perf_counter()
+    for _ in range(max(1, steps)):
+        out = fn(*args)
+    float(jax.tree_util.tree_leaves(out)[0].sum())
+    return (time.perf_counter() - t0) / max(1, steps) * 1000.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseStat:
+    """One measured phase with its modeled work: wall-clock plus the
+    analytic TFLOP / GB-moved the phase's schedule says it must do
+    (model FLOPs and modeled HBM bytes — recompute is NOT counted,
+    matching the MFU convention)."""
+
+    name: str
+    ms: float
+    tflop: float
+    gb: float
+
+    def achieved_tflops(self) -> float:
+        return self.tflop / (self.ms / 1000.0) if self.ms > 0 else 0.0
+
+    def achieved_gbps(self) -> float:
+        return self.gb / (self.ms / 1000.0) if self.ms > 0 else 0.0
+
+
+class PhaseRoofline:
+    """Mechanical per-phase roofline: add phases, read the table.
+
+    `bound_by` mirrors the classification convention of the hand-built
+    Round-5 table (docs/architecture.md): the phase is "HBM" when
+    bandwidth utilization dominates compute by >= 0.3 of peak,
+    "MXU-side" when compute dominates by >= 0.15, and "mixed → <dominant>"
+    in between — the mixed labels name the resource any further win
+    must come from."""
+
+    def __init__(
+        self,
+        peak_tflops: float = V5E_PEAK_TFLOPS,
+        peak_gbps: float = V5E_PEAK_GBPS,
+    ):
+        self.peak_tflops = peak_tflops
+        self.peak_gbps = peak_gbps
+        self.phases: list[PhaseStat] = []
+
+    def add(self, name: str, *, ms: float, tflop: float, gb: float) -> dict:
+        self.phases.append(PhaseStat(name, ms, tflop, gb))
+        return self.rows()[-1]
+
+    def _bound(self, compute_frac: float, bw_frac: float) -> str:
+        if bw_frac - compute_frac >= 0.3:
+            return "HBM"
+        if compute_frac - bw_frac >= 0.15:
+            return "MXU-side"
+        return "mixed → HBM" if bw_frac >= compute_frac else "mixed → MXU"
+
+    def rows(self) -> list[dict]:
+        out = []
+        for p in self.phases:
+            tf = p.achieved_tflops()
+            gbps = p.achieved_gbps()
+            cf = tf / self.peak_tflops if self.peak_tflops else 0.0
+            bf = gbps / self.peak_gbps if self.peak_gbps else 0.0
+            out.append(
+                {
+                    "phase": p.name,
+                    "ms": round(p.ms, 2),
+                    "tflop": round(p.tflop, 2),
+                    "gb": round(p.gb, 2),
+                    "achieved_tflops": round(tf, 1),
+                    "achieved_gbps": round(gbps, 1),
+                    "compute_frac": round(cf, 3),
+                    "bw_frac": round(bf, 3),
+                    "bound_by": self._bound(cf, bf),
+                }
+            )
+        return out
+
+    def saturated(self) -> str:
+        """The step's binding resource: the bound of the phase that
+        spends the most wall-clock (what "attack the dominant phase"
+        should attack)."""
+        if not self.phases:
+            return "none"
+        rows = self.rows()
+        top = max(rows, key=lambda r: r["ms"])
+        return f"{top['phase']}: {top['bound_by']}"
+
+    def table(self) -> str:
+        """Markdown table, same columns as the Round-5 hand-built one."""
+        lines = [
+            "| phase | ms | TFLOP | GB moved | achieved | bound by |",
+            "|---|---|---|---|---|---|",
+        ]
+        for r in self.rows():
+            lines.append(
+                f"| {r['phase']} | {r['ms']:g} | {r['tflop']:g} | "
+                f"{r['gb']:g} | {r['achieved_tflops']:g} TF/s "
+                f"({r['compute_frac'] * 100:.0f}%), "
+                f"{r['achieved_gbps']:g} GB/s "
+                f"({r['bw_frac'] * 100:.0f}%) | {r['bound_by']} |"
+            )
+        return "\n".join(lines)
 
 
 def annotate(name: str):

@@ -20,6 +20,9 @@ def test_attention_bench_smoke_emits_parsable_metrics():
             "--warmup-steps", "1", "--batch-size", "1",
             "--head-dim", "32", "--attn-heads", "2",
             "--flash-block-q", "128", "--flash-block-k", "128",
+            "--roofline-seq", "128", "--roofline-batch", "1",
+            "--roofline-layers", "2", "--roofline-d-model", "64",
+            "--roofline-d-ff", "128", "--roofline-vocab", "512",
         ],
         cwd=REPO,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
@@ -43,6 +46,7 @@ def test_attention_bench_smoke_emits_parsable_metrics():
             "attention_flash_fwdbwd_tflops",
             "attention_causal_grid_steps",
             "attention_lse_hbm_bytes",
+            "attention_bwd_hbm_bytes",
         ):
             assert f"{stem}_s{s}" in metrics, (stem, s, sorted(metrics))
     # The schedule accounting must show the overhaul: at S=256 with
@@ -54,3 +58,20 @@ def test_attention_bench_smoke_emits_parsable_metrics():
     assert abs(lse["vs_baseline"] - 1 / 128) < 1e-6, lse
     # Dense ran at these lengths, so the TFLOP/s rows carry a real ratio.
     assert metrics["attention_flash_fwd_tflops_s256"]["vs_baseline"] > 0
+    # Fused one-pass backward (ISSUE 7): the bwd HBM-byte row's ratio is
+    # the fused/two-pass fraction — strictly < 1 whenever fused engages
+    # (these shapes fuse; the run would have FAILED on the jaxpr gate if
+    # dispatch and accounting drifted), and the unit names the path.
+    for s in (128, 256):
+        bwd = metrics[f"attention_bwd_hbm_bytes_s{s}"]
+        assert 0 < bwd["vs_baseline"] < 1, bwd
+        assert "fused one-pass" in bwd["unit"], bwd
+    # Per-phase roofline rows (the mechanical docs/architecture.md
+    # table): one ms row per phase, unit carrying TFLOP/GB/bound.
+    for phase in ("attn_fwd", "attn_bwd", "mlp", "optimizer"):
+        row = metrics[f"roofline_{phase}_ms_s128"]
+        assert row["value"] > 0, row
+        assert "bound:" in row["unit"], row
+    # The roofline table itself rides stderr for humans.
+    assert "| phase | ms | TFLOP | GB moved |" in result.stderr
+    assert "roofline saturated phase" in result.stderr

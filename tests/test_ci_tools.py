@@ -191,6 +191,58 @@ def test_flash_attention_hot_path_stays_blockwise():
     assert "_lse_is_packed" in src and "_pack_rows" in src
 
 
+def test_fused_flash_bwd_shared_delta_and_single_kv_pass():
+    """Lint-style perf gate (docs/perf.md, ISSUE 7): the fused dq/dkv
+    backward's contracts, pinned mechanically:
+
+    - its input streams must not contain O — the shared-delta rewrite
+      removed O from the backward (delta = rowsum(dO ∘ O) arrives
+      precomputed), and an `o_ref` creeping back into the fused kernel
+      silently restores an S·d HBM re-stream per step;
+    - the backward walks the compact triangle ONCE: via the
+      `flash_schedule` accounting every bench and test shares,
+      `bwd_total_grid_steps` must equal the per-pass step count when
+      fused (and exactly two passes when not).
+    """
+    import inspect
+
+    from kubeflow_tpu.ops import flash
+
+    params = list(
+        inspect.signature(flash._dqkv_kernel_fused).parameters
+    )
+    refs = [p for p in params if p.endswith("_ref")]
+    assert refs == [
+        "rows_ref", "cols_ref", "q_ref", "k_ref", "v_ref", "do_ref",
+        "lse_ref", "delta_ref", "dq_ref", "dk_ref", "dv_ref",
+    ], f"fused kernel input/output streams changed: {refs}"
+    assert "o_ref" not in params, (
+        "O reappeared in the fused backward's streams (shared-delta "
+        "regression — delta must arrive precomputed)"
+    )
+
+    fused = flash.flash_schedule(4096, 4096, block_q=256, block_k=256)
+    assert fused["bwd_fused"], fused
+    assert fused["bwd_total_grid_steps"] == fused["bwd_grid_steps"], (
+        "fused backward no longer single-KV-pass: "
+        f"{fused['bwd_total_grid_steps']} total vs "
+        f"{fused['bwd_grid_steps']} per pass"
+    )
+    two_pass = flash.flash_schedule(
+        4096, 4096, block_q=256, block_k=256, causal=False
+    )
+    assert not two_pass["bwd_fused"]
+    assert (
+        two_pass["bwd_total_grid_steps"] == 2 * two_pass["bwd_grid_steps"]
+    )
+    # The bench gate rides the same accounting: the fused model must
+    # report well under the two-pass bytes at deep triangles.
+    assert (
+        fused["bwd_hbm_bytes_fused"]
+        <= 0.62 * fused["bwd_hbm_bytes_two_pass"]
+    ), fused
+
+
 def test_pipeline_hot_path_psums_scalars_only():
     """Lint-style perf gate (docs/perf.md, ISSUE 4): the pipeline layer
     must never all-reduce a non-scalar buffer across pp. The seed design

@@ -1,7 +1,9 @@
-"""The long-context attention schedule (ISSUE 3): compacted causal grid,
-lane-packed lse, shared-delta backward, and internal padding — interpret-mode
-parity against the dense reference plus static-schedule regression gates
-(grid-step count, lse HBM bytes)."""
+"""The long-context attention schedule (ISSUE 3) and the fused one-pass
+backward (ISSUE 7): compacted causal grid, lane-packed lse, shared-delta
+backward, fused dq/dkv kernel, and internal padding — interpret-mode
+parity against the dense reference (and against the two-kernel backward)
+plus static-schedule regression gates (grid-step count, lse HBM bytes,
+backward HBM-byte halving, fused VMEM gating)."""
 
 import jax
 import jax.numpy as jnp
@@ -11,6 +13,8 @@ import pytest
 from kubeflow_tpu.ops.attention import dense_attention
 from kubeflow_tpu.ops.flash import (
     _LANES,
+    _bwd_fused,
+    _flash_bwd_kernels,
     _flash_delta_impl,
     _flash_fwd_impl,
     _grid_steps,
@@ -189,6 +193,235 @@ def test_shared_delta_precompute_matches_rowsum():
     np.testing.assert_allclose(
         replicated[:, :, 0], want, atol=1e-5, rtol=1e-5
     )
+
+
+# -- fused one-pass dq/dkv backward (ISSUE 7) -------------------------------
+
+
+def _bwd_kernel_counts(attn, q, k, v):
+    """(fused, two_pass_dq, two_pass_dkv) kernel-trace counts in the
+    grad jaxpr — the same mechanical engagement check the attention
+    bench gates on."""
+    jaxpr = str(
+        jax.make_jaxpr(
+            jax.grad(
+                lambda q, k, v: jnp.sum(
+                    attn(q, k, v).astype(jnp.float32) ** 2
+                ),
+                argnums=(0, 1, 2),
+            )
+        )(q, k, v)
+    )
+    return (
+        jaxpr.count("_dqkv_kernel_fused"),
+        jaxpr.count("_dq_kernel"),
+        jaxpr.count("_dkv_kernel"),
+    )
+
+
+@pytest.mark.parametrize(
+    "s,block,packed",
+    [(512, 128, True), (256, 64, False), (384, 128, True)],
+)
+def test_fused_bwd_engages_and_matches_dense(s, block, packed):
+    """The compact causal grid now runs ONE backward kernel: the
+    schedule reports it, the grad jaxpr contains exactly the fused
+    kernel (neither two-pass kernel), and grads match dense — in both
+    the lane-packed and the replicated lse layout."""
+    sched = flash_schedule(
+        s, s, block_q=block, block_k=block, causal=True,
+        head_dim=32, dtype_bytes=4,
+    )
+    assert sched["bwd_fused"], sched
+    assert sched["lse_packed"] == packed
+    assert sched["bwd_total_grid_steps"] == sched["bwd_grid_steps"]
+
+    q, k, v = _qkv(jax.random.PRNGKey(10), 2, s, 2, 32)
+    attn = lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=block, block_k=block, interpret=True
+    )
+    fused, dq2, dkv2 = _bwd_kernel_counts(attn, q, k, v)
+    assert fused == 1 and dq2 == 0 and dkv2 == 0, (fused, dq2, dkv2)
+
+    got = _grads(attn, q, k, v)
+    want = _grads(
+        lambda q, k, v: dense_attention(q, k, v, causal=True), q, k, v
+    )
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            g, w, atol=5e-5, rtol=5e-5, err_msg=f"d{name} mismatch"
+        )
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_fused_matches_two_kernel_path(packed):
+    """Pin fused == two-pass on identical (lse, delta) inputs: the
+    fusion must be a pure schedule change, not a numerics change. Both
+    lse layouts (packed 128-blocks, replicated 64-blocks)."""
+    bh, s, d = 2, 256, 32
+    block = 128 if packed else 64
+    keys = jax.random.split(jax.random.PRNGKey(11), 4)
+    q, k, v, do = (
+        jax.random.normal(kx, (bh, s, d)) for kx in keys
+    )
+    o, lse = _flash_fwd_impl(q, k, v, True, block, block, True, None, packed)
+    delta = _flash_delta_impl(o, do, block, True, packed)
+    fused = _flash_bwd_kernels(
+        q, k, v, do, lse, delta, True, block, block, True, None, packed,
+        True,
+    )
+    two = _flash_bwd_kernels(
+        q, k, v, do, lse, delta, True, block, block, True, None, packed,
+        False,
+    )
+    for f, t, name in zip(fused, two, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            f, t, atol=1e-5, rtol=1e-5, err_msg=f"{name} fused!=two-pass"
+        )
+
+
+def test_noncausal_and_uneven_blocks_stay_two_pass():
+    """The rectangular fallback is preserved unchanged: non-causal and
+    uneven-block configurations must not fuse (schedule AND traced
+    program), and forcing fused there is a loud error."""
+    sched = flash_schedule(256, 256, causal=False, head_dim=16,
+                           dtype_bytes=4)
+    assert not sched["bwd_fused"]
+    assert sched["bwd_total_grid_steps"] == 2 * sched["bwd_grid_steps"]
+    assert not flash_schedule(
+        256, 256, block_q=64, block_k=128, causal=True
+    )["bwd_fused"]
+
+    q, k, v = _qkv(jax.random.PRNGKey(12), 1, 256, 2, 16)
+    attn = lambda q, k, v: flash_attention(
+        q, k, v, causal=False, block_q=128, block_k=128, interpret=True
+    )
+    fused, dq2, dkv2 = _bwd_kernel_counts(attn, q, k, v)
+    assert fused == 0 and dq2 == 1 and dkv2 == 1, (fused, dq2, dkv2)
+
+    qf = q.transpose(0, 2, 1, 3).reshape(2, 256, 16)
+    o, lse = _flash_fwd_impl(qf, qf, qf, False, 128, 128, True, None, True)
+    delta = _flash_delta_impl(o, jnp.ones_like(o), 128, True, True)
+    with pytest.raises(ValueError, match="compact causal grid"):
+        _flash_bwd_kernels(
+            qf, qf, qf, jnp.ones_like(o), lse, delta, False, 128, 128,
+            True, None, True, True,
+        )
+
+
+def test_fused_vmem_budget_gates_engagement(monkeypatch):
+    """The dq ring costs S·d·4 bytes of VMEM, so fusion must fall back
+    past the budget (32k × d=128 is a 16 MiB ring on a ~16 MiB core)
+    — and the KFTPU_FLASH_FUSED_BWD=0 escape hatch pins two-pass
+    everywhere."""
+    assert flash_schedule(16384, 16384)["bwd_fused"]
+    big = flash_schedule(32768, 32768)
+    assert big["compact"] and not big["bwd_fused"]
+    assert big["bwd_fused_vmem_bytes"] > 12 * 2**20
+    # The impl-side predicate is the same function the schedule reports.
+    assert _bwd_fused(True, 16384, 16384, 1024, 1024, 128, 2, True)
+    assert not _bwd_fused(True, 32768, 32768, 1024, 1024, 128, 2, True)
+
+    # Forcing fused=True past the budget is a LOUD error (the dq ring
+    # would exhaust core VMEM with an opaque Mosaic failure otherwise).
+    z = lambda shape: jnp.zeros(shape, jnp.float32)
+    with pytest.raises(ValueError, match="over-budget"):
+        _flash_bwd_kernels(
+            z((1, 32768, 128)), z((1, 32768, 128)), z((1, 32768, 128)),
+            z((1, 32768, 128)), z((1, 256, 128)), z((1, 256, 128)),
+            True, 1024, 1024, True, None, True, True,
+        )
+
+    monkeypatch.setenv("KFTPU_FLASH_FUSED_BWD", "0")
+    assert not flash_schedule(16384, 16384)["bwd_fused"]
+    assert not _bwd_fused(True, 16384, 16384, 1024, 1024, 128, 2, True)
+
+
+def test_bwd_hbm_byte_model_fused_halves_two_pass():
+    """The acceptance gate (ISSUE 7): at the 16k flagship shape the
+    fused backward must model ~half the two-pass HBM bytes (the
+    per-step K/V re-streaming is gone; residents and output writes keep
+    the ratio a little above 0.5), monotonically approaching 1/2 as the
+    triangle deepens."""
+    ratios = {}
+    for s in (2048, 4096, 8192, 16384):
+        sc = flash_schedule(s, s)
+        assert sc["bwd_hbm_bytes_fused"] < sc["bwd_hbm_bytes_two_pass"]
+        ratios[s] = sc["bwd_hbm_bytes_fused"] / sc["bwd_hbm_bytes_two_pass"]
+    assert ratios[16384] <= 0.6, ratios
+    assert ratios[8192] <= 0.6, ratios
+    assert all(
+        ratios[a] >= ratios[b]
+        for a, b in ((2048, 4096), (4096, 8192), (8192, 16384))
+    ), ratios
+    # The chosen-path figure follows the fused flag.
+    sc = flash_schedule(16384, 16384)
+    assert sc["bwd_fused"] and sc["bwd_hbm_bytes"] == sc["bwd_hbm_bytes_fused"]
+
+
+def test_fused_under_remat_flash_policy_never_reruns_fwd():
+    """remat_policy="flash" × fused backward: a block checkpoint that
+    pins the kernel's named (out, lse) residuals must still dead-code
+    the forward kernel out of the backward — the fused kernel must not
+    have changed the residual set. Asserted from the grad jaxpr: the
+    checkpointed grad traces the forward kernel exactly as often as the
+    un-checkpointed grad, and runs the fused backward."""
+    from kubeflow_tpu.models.transformer import checkpoint_policy
+
+    s, block = 256, 128
+    q, k, v = _qkv(jax.random.PRNGKey(13), 1, s, 2, 32)
+
+    def attn(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, block_q=block, block_k=block,
+            interpret=True,
+        )
+
+    def loss_plain(q, k, v):
+        return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+
+    loss_ckpt = jax.checkpoint(
+        loss_plain, policy=checkpoint_policy("flash")
+    )
+    grads = lambda f: jax.grad(f, argnums=(0, 1, 2))
+    jaxpr_plain = str(jax.make_jaxpr(grads(loss_plain))(q, k, v))
+    jaxpr_ckpt = str(jax.make_jaxpr(grads(loss_ckpt))(q, k, v))
+    assert (
+        jaxpr_ckpt.count("_fwd_kernel") == jaxpr_plain.count("_fwd_kernel")
+    ), "remat_policy='flash' re-runs the flash forward in the backward"
+    assert jaxpr_ckpt.count("_dqkv_kernel_fused") == 1
+    assert "_dq_kernel" not in jaxpr_ckpt
+    # And the checkpointed grads equal the plain ones.
+    for a, b, name in zip(
+        grads(loss_ckpt)(q, k, v), grads(loss_plain)(q, k, v), "qkv"
+    ):
+        np.testing.assert_allclose(
+            a, b, atol=1e-5, rtol=1e-5, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_fused_handles_ragged_padded_tail():
+    """Ragged S rides the fused kernel too: 321 pads to 384 (compact,
+    square blocks, kv_len tail mask) and grads must match dense."""
+    s = 321
+    sched = flash_schedule(s, s, block_q=128, block_k=128, head_dim=16,
+                           dtype_bytes=4)
+    assert sched["padded_seq_q"] == 384 and sched["bwd_fused"], sched
+
+    q, k, v = _qkv(jax.random.PRNGKey(14), 1, s, 2, 16)
+    attn = lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+    )
+    fused, dq2, dkv2 = _bwd_kernel_counts(attn, q, k, v)
+    assert fused == 1 and dq2 == 0 and dkv2 == 0
+    got = _grads(attn, q, k, v)
+    want = _grads(
+        lambda q, k, v: dense_attention(q, k, v, causal=True), q, k, v
+    )
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            g, w, atol=5e-4, rtol=5e-4, err_msg=f"d{name} mismatch"
+        )
 
 
 # -- internal padding (ragged sequence lengths) -----------------------------

@@ -10,6 +10,7 @@ from kubeflow_tpu.models.resnet import tiny_resnet
 from kubeflow_tpu.parallel import MeshSpec, build_mesh
 from kubeflow_tpu.train import (
     MetricsLogger,
+    PhaseRoofline,
     Profiler,
     ProfileSchedule,
     SyntheticImages,
@@ -17,6 +18,7 @@ from kubeflow_tpu.train import (
     Trainer,
     annotated_scope,
     fit,
+    time_phase,
 )
 
 
@@ -92,3 +94,45 @@ def test_metrics_logger_roundtrip(tmp_path):
     rows = logger.read()
     assert [r["step"] for r in rows] == [10, 20]
     assert all("ts" in r for r in rows)
+
+
+# -- per-phase roofline (ISSUE 7) -------------------------------------------
+
+
+def test_phase_roofline_math_and_bounds():
+    """The mechanical roofline's arithmetic and the bound classifier
+    (same convention as the hand-built docs/architecture.md table):
+    achieved TF/s = TFLOP/s-of-wall-clock, achieved GB/s likewise, and
+    the binding resource follows the dominant utilization."""
+    roof = PhaseRoofline(peak_tflops=200.0, peak_gbps=800.0)
+    # 10 TFLOP in 100 ms = 100 TF/s (50%); 8 GB in 100 ms = 80 GB/s
+    # (10%): compute dominates by 0.4 -> MXU-side.
+    mxu = roof.add("fwd", ms=100.0, tflop=10.0, gb=8.0)
+    assert mxu["achieved_tflops"] == 100.0 and mxu["achieved_gbps"] == 80.0
+    assert mxu["bound_by"] == "MXU-side"
+    # ~0 TFLOP, 72 GB in 100 ms = 720 GB/s (90%) vs 0% compute -> HBM.
+    hbm = roof.add("optimizer", ms=100.0, tflop=0.0, gb=72.0)
+    assert hbm["bound_by"] == "HBM"
+    # 64% compute vs 69% bandwidth (the r05 backward) -> mixed, HBM
+    # dominant.
+    mixed = roof.add("bwd", ms=100.0, tflop=12.8, gb=55.2)
+    assert mixed["bound_by"] == "mixed → HBM"
+    # The step's saturated resource is the longest phase's bound.
+    roof.phases[-1] = roof.phases[-1].__class__("bwd", 300.0, 12.8, 55.2)
+    assert roof.saturated().startswith("bwd:")
+    # Table renders the Round-5 columns.
+    table = roof.table()
+    assert table.splitlines()[0] == (
+        "| phase | ms | TFLOP | GB moved | achieved | bound by |"
+    )
+    assert "MXU-side" in table and "HBM" in table
+
+
+def test_time_phase_fenced_timer():
+    """time_phase returns positive wall-clock ms for a jitted fn and
+    fences through a scalar device_get (it must not explode on pytree
+    outputs either)."""
+    f = jax.jit(lambda x: (x * 2.0, {"aux": x.sum()}))
+    x = jnp.ones((32, 32))
+    ms = time_phase(f, x, warmup=1, steps=2)
+    assert ms > 0.0
