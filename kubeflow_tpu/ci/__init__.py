@@ -5,6 +5,12 @@ manifest-test regeneration (`application_util.py:12-97`): pin component
 image tags across the deploy bundles and keep golden manifest snapshots
 in `manifests/` that a test diffs against the generator — drift between
 code and checked-in manifests fails CI instead of shipping.
+
+`lint/` is **kftpu-lint** (docs/lint.md): AST + traced-program static
+analysis of the platform's own contracts (host-sync-in-jit,
+thaw-before-mutate, lock-discipline, collective wire contracts, ...)
+with per-line suppressions and a justified baseline — run via
+`python -m kubeflow_tpu.ci lint`.
 """
 
 from kubeflow_tpu.ci.application_util import (

@@ -124,7 +124,13 @@ def main(argv: list[str] | None = None) -> int:
         "--overlay", action="append", default=[],
         help="overlay YAML file (repeatable, applied in order)",
     )
+    from kubeflow_tpu.ci.lint.cli import add_lint_parser, run_lint
+
+    add_lint_parser(sub)
     args = parser.parse_args(argv)
+
+    if args.cmd == "lint":
+        return run_lint(args)
 
     if args.cmd == "render":
         print(render_overlaid_yaml(args.bundle, args.overlay), end="")

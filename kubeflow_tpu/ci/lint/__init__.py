@@ -1,0 +1,41 @@
+"""kftpu-lint: static analysis for the platform's own contracts.
+
+Two backends share one reporting path (findings, suppressions,
+baseline, deterministic output):
+
+- the **AST pass** (`engine.py` + `rules.py`): visitor-based rules
+  over every `.py` under `kubeflow_tpu/` — host-sync-in-jit,
+  thaw-before-mutate, lock-discipline, no-bare-except,
+  no-interrupt-swallow, no-deepcopy-hot-path, endpoint-list-clients,
+  scalar-psum-only, flash-blockwise, fused-kernel-streams;
+- the **program pass** (`contracts.py`): declarative per-program
+  contracts over traced jaxprs and compiled HLO (the
+  `testing/hlo.py` accounting, generalized) — collective counts and
+  sizes, no [S, S] HBM buffers, fused-kernel engagement, remat
+  no-forward-rerun.
+
+CLI: ``python -m kubeflow_tpu.ci lint [--json] [--baseline PATH]
+[--programs] [--rule ID ...]``. Rule catalog: docs/lint.md.
+"""
+
+from kubeflow_tpu.ci.lint.engine import (
+    DEFAULT_BASELINE,
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    default_files,
+    lint_files,
+    lint_repo,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "default_files",
+    "lint_files",
+    "lint_repo",
+]

@@ -1,0 +1,83 @@
+"""`python -m kubeflow_tpu.ci lint` — the kftpu-lint command line.
+
+Exit status is the CI contract: 0 = zero unsuppressed findings, 1 =
+findings (text or --json on stdout), 2 = usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+
+def add_lint_parser(sub) -> None:
+    p = sub.add_parser(
+        "lint",
+        help="run kftpu-lint (AST rules; --programs adds traced "
+        "program contracts)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: the checked-in "
+        "kubeflow_tpu/ci/lint/baseline.json; 'none' disables)",
+    )
+    p.add_argument(
+        "--rule", action="append", default=None, metavar="ID",
+        help="run only this rule (repeatable)",
+    )
+    p.add_argument(
+        "--programs", action="store_true",
+        help="also run the traced program-contract pass (slow: jax "
+        "tracing + compilation)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+
+
+def run_lint(args) -> int:
+    from kubeflow_tpu.ci.lint import engine
+
+    if args.list_rules:
+        for rule_id, rule in sorted(engine.all_rules().items()):
+            print(f"{rule_id}: {rule.rationale}")
+        return 0
+
+    if args.programs:
+        # Tracing needs a multi-device CPU topology; set it up before
+        # jax's first import (a no-op if the caller already did).
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    baseline: pathlib.Path | None = engine.DEFAULT_BASELINE
+    if args.baseline == "none":
+        baseline = None
+    elif args.baseline is not None:
+        baseline = pathlib.Path(args.baseline)
+        if not baseline.exists():
+            print(f"baseline file not found: {baseline}", file=sys.stderr)
+            return 2
+
+    try:
+        result = engine.lint_repo(
+            rules=args.rule, baseline=baseline, programs=args.programs
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    sys.stdout.write(
+        result.to_json() if args.json else result.render()
+    )
+    return 0 if result.clean else 1

@@ -1,0 +1,445 @@
+"""kftpu-lint program pass: declarative per-program contracts.
+
+The AST rules pin what the SOURCE says; these contracts pin what the
+TRACED PROGRAM does — the `testing/hlo.py` accounting (collective
+counts, per-buffer all-reduce sizes, jaxpr scan lengths), generalized
+from five hand-rolled tests into one table. Each `ProgramContract`
+names a program builder (trace the train step, the interleaved
+pipeline, the fused flash grad, the serving batch) and the assertions
+that hold over its compiled HLO / traced jaxpr:
+
+- collective families expected present / forbidden;
+- every all-reduced buffer below a program-specific element cap (the
+  scalar-psum-only wire contract, measured not grepped);
+- exact kernel-trace counts in the grad jaxpr (fused backward engaged,
+  two-pass kernels dead);
+- remat no-forward-rerun (the checkpointed grad traces the forward
+  kernel exactly as often as the plain grad);
+- no quadratic [S, S] buffer anywhere in the traced program;
+- schedule-model booleans (`flash_schedule`'s single-KV-pass and
+  byte-ratio accounting — the same numbers `bench.py` gates on).
+
+Violations surface as ordinary lint findings with path
+``<program:NAME>`` so they ride the same baseline/reporting path as
+the AST rules. Tracing is slow (seconds, jax import + compilation), so
+the CLI runs this pass only under ``--programs``;
+`tests/test_program_contracts.py` runs it in tier-1. Builders need the
+test topology (8 virtual CPU devices) — the CLI sets it up before
+jax's first import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable
+
+from kubeflow_tpu.ci.lint.engine import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class Program:
+    """What a builder hands the assertion layer."""
+
+    hlo: str | None = None
+    jaxpr: str | None = None
+    meta: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramContract:
+    """One row of the contract table. String-valued fields name keys
+    in the built program's `meta` dict, so the table stays declarative
+    while builders supply the numbers."""
+
+    name: str
+    description: str
+    build: Callable[[], Program]
+    # HLO: collective families that must / must not appear.
+    expect_collectives: tuple[str, ...] = ()
+    forbid_collectives: tuple[str, ...] = ()
+    # HLO: every all-reduced buffer stays under meta[<key>] elements.
+    allreduce_cap: str | None = None
+    # jaxpr: substring -> exact trace count (int) or meta key (str).
+    jaxpr_counts: dict = dataclasses.field(default_factory=dict)
+    # jaxpr: no shape token matching meta[<key>] (regex) anywhere.
+    forbid_jaxpr_shapes: str | None = None
+    # meta keys that must be truthy / pairs that must be equal /
+    # (container_key, member_key) membership.
+    meta_true: tuple[str, ...] = ()
+    meta_equal: tuple[tuple[str, str], ...] = ()
+    meta_contains: tuple[tuple[str, str], ...] = ()
+
+
+def _require_devices(n: int) -> None:
+    import jax
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"program contracts need >= {n} devices; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "before jax first imports (the lint CLI and tests/conftest "
+            "both do)"
+        )
+
+
+# -- builders ---------------------------------------------------------------
+
+
+def _build_train_step() -> Program:
+    """The classification train step on a dp=2 mesh: cross-replica
+    traffic is gradient-sized all-reduce, never activations or
+    gathered params."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_tpu.testing.hlo import compiled_hlo
+    from kubeflow_tpu.testing.tinymodels import TinyMLP
+    from kubeflow_tpu.train import TrainConfig, Trainer
+
+    _require_devices(2)
+    mesh = build_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    trainer = Trainer(
+        TinyMLP(),
+        TrainConfig(
+            batch_size=4, total_steps=2, warmup_steps=1, optimizer="sgd"
+        ),
+        mesh,
+        example_input_shape=(4, 8, 8, 1),
+    )
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    step = trainer.make_train_step()
+    # Shard the batch the way the data path does — with a replicated
+    # batch the partitioner legally replicates the whole step and the
+    # contract would be vacuous.
+    batch = {
+        "image": jax.device_put(
+            jnp.zeros((4, 8, 8, 1), jnp.float32),
+            trainer.batch_sharding(4),
+        ),
+        "label": jax.device_put(
+            jnp.zeros((4,), jnp.int32), trainer.batch_sharding(1)
+        ),
+    }
+    # Largest parameter buffer: grads are param-shaped, so any
+    # all-reduce above this is activations/logits leaking into the
+    # cross-dp channel.
+    cap = 1 + max(
+        leaf.size for leaf in jax.tree_util.tree_leaves(state.params)
+    )
+    return Program(
+        hlo=compiled_hlo(step, state, batch),
+        meta={"param_cap": cap},
+    )
+
+
+def _build_pipeline(interleave: int) -> Program:
+    """The interleaved pipelined LM loss path (PR 4's wire contract):
+    activations move by collective-permute, the only all-reduce near
+    activation size is none, and the traced loop is the published
+    schedule's."""
+    import flax.linen as nn
+    import jax
+
+    from kubeflow_tpu.models.transformer import (
+        PipelinedTransformerLM,
+        TransformerConfig,
+    )
+    from kubeflow_tpu.parallel import (
+        MeshSpec,
+        build_mesh,
+        pipeline_schedule,
+    )
+    from kubeflow_tpu.testing.hlo import compiled_hlo, scan_lengths
+
+    _require_devices(2)
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=4, n_heads=2, head_dim=8,
+        d_ff=16, remat=False, dtype=jax.numpy.float32,
+        attention_impl="dense",
+    )
+    mesh = build_mesh(MeshSpec(dp=1, pp=2), jax.devices()[:2])
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 64), 0, 64)
+    labels = jax.random.randint(jax.random.PRNGKey(9), (8, 64), 0, 64)
+    pipe = PipelinedTransformerLM(
+        cfg, n_stages=2 * interleave, num_microbatches=4, mesh=mesh,
+        interleave=interleave,
+    )
+    params = nn.meta.unbox(
+        jax.jit(pipe.init)(jax.random.PRNGKey(1), tokens)
+    )["params"]
+
+    def loss_grad(p):
+        return jax.value_and_grad(
+            lambda q: pipe.apply({"params": q}, tokens, labels=labels)
+        )(p)
+
+    sched = pipeline_schedule(2 * interleave, 4, interleave)
+    return Program(
+        hlo=compiled_hlo(jax.jit(loss_grad), params),
+        meta={
+            # One microbatch's activations: [mb, S, d_model].
+            "microbatch_activation": (8 // 4) * 64 * cfg.d_model,
+            "scan_lengths": scan_lengths(loss_grad, params),
+            "loop_ticks": sched["loop_ticks"],
+        },
+    )
+
+
+def _build_fused_flash_grad() -> Program:
+    """The flash attention grad at a compact-causal shape: the fused
+    one-pass backward engaged (two-pass kernels dead), remat="flash"
+    never re-runs the forward kernel, no [S, S] buffer anywhere, the
+    fused kernel's ref streams pinned, and the schedule model's
+    single-KV-pass + byte-ratio accounting holding."""
+    import inspect
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.transformer import checkpoint_policy
+    from kubeflow_tpu.ops import flash
+
+    s, block, bh, d = 256, 128, 2, 32
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(keys[0], (1, s, bh, d))
+    k = jax.random.normal(keys[1], (1, s, bh, d))
+    v = jax.random.normal(keys[2], (1, s, bh, d))
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash.flash_attention(
+                q, k, v, causal=True, block_q=block, block_k=block,
+                interpret=True,
+            ).astype(jnp.float32) ** 2
+        )
+
+    grads = lambda f: jax.grad(f, argnums=(0, 1, 2))
+    jaxpr_plain = str(jax.make_jaxpr(grads(loss))(q, k, v))
+    jaxpr_ckpt = str(
+        jax.make_jaxpr(
+            grads(jax.checkpoint(loss, policy=checkpoint_policy("flash")))
+        )(q, k, v)
+    )
+
+    sched = flash.flash_schedule(s, s, block_q=block, block_k=block)
+    # Byte-model accounting at the deep-triangle flagship shape (the
+    # bench-gated regime, nq >= 8): the ratio approaches 1/2 as the
+    # triangle deepens and only means anything there.
+    deep = flash.flash_schedule(4096, 4096, block_q=256, block_k=256)
+    noncausal = flash.flash_schedule(
+        4096, 4096, block_q=256, block_k=256, causal=False
+    )
+    refs = [
+        p
+        for p in inspect.signature(flash._dqkv_kernel_fused).parameters
+        if p.endswith("_ref")
+    ]
+    return Program(
+        jaxpr=jaxpr_ckpt,
+        meta={
+            "seq_shape": rf"\[(?:\d+,)*{s},{s}\]",
+            "fwd_count_plain": jaxpr_plain.count("_fwd_kernel"),
+            "fwd_count_ckpt": jaxpr_ckpt.count("_fwd_kernel"),
+            "bwd_fused": sched["bwd_fused"],
+            "single_kv_pass": (
+                sched["bwd_total_grid_steps"] == sched["bwd_grid_steps"]
+            ),
+            "deep_fused": deep["bwd_fused"],
+            "deep_single_kv_pass": (
+                deep["bwd_total_grid_steps"] == deep["bwd_grid_steps"]
+            ),
+            "noncausal_two_pass": (
+                not noncausal["bwd_fused"]
+                and noncausal["bwd_total_grid_steps"]
+                == 2 * noncausal["bwd_grid_steps"]
+            ),
+            "byte_model_ok": (
+                deep["bwd_hbm_bytes_fused"]
+                <= 0.62 * deep["bwd_hbm_bytes_two_pass"]
+            ),
+            "streams_pinned": refs
+            == [
+                "rows_ref", "cols_ref", "q_ref", "k_ref", "v_ref",
+                "do_ref", "lse_ref", "delta_ref", "dq_ref", "dk_ref",
+                "dv_ref",
+            ],
+        },
+    )
+
+
+def _build_serving_batch() -> Program:
+    """One servable bucket execution: a single-device program — no
+    collective of any family may appear (a sharded-serving refactor
+    that silently leaves one in costs every request a device fence)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.serving.servable import Servable
+    from kubeflow_tpu.testing.hlo import compiled_hlo
+    from kubeflow_tpu.testing.tinymodels import TinyMLP
+
+    model = TinyMLP()
+    x = jnp.zeros((4, 8, 8, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    sv = Servable(
+        name="contract", apply_fn=model.apply, variables=variables,
+        max_batch=4,
+    )
+    return Program(hlo=compiled_hlo(sv._jitted, sv.variables, x))
+
+
+# -- the table --------------------------------------------------------------
+
+CONTRACTS: tuple[ProgramContract, ...] = (
+    ProgramContract(
+        name="train-step-dp",
+        description="dp train step: grad-sized all-reduce only",
+        build=_build_train_step,
+        expect_collectives=("all-reduce",),
+        forbid_collectives=("all-to-all",),
+        allreduce_cap="param_cap",
+    ),
+    ProgramContract(
+        name="pipeline-wire-v1",
+        description="GPipe loss path: ppermute + scalar psum only",
+        build=lambda: _build_pipeline(1),
+        expect_collectives=("collective-permute",),
+        allreduce_cap="microbatch_activation",
+        meta_contains=(("scan_lengths", "loop_ticks"),),
+    ),
+    ProgramContract(
+        name="pipeline-wire-v2",
+        description="interleaved loss path: same wire contract, "
+        "v2 schedule ticks",
+        build=lambda: _build_pipeline(2),
+        expect_collectives=("collective-permute",),
+        allreduce_cap="microbatch_activation",
+        meta_contains=(("scan_lengths", "loop_ticks"),),
+    ),
+    ProgramContract(
+        name="fused-flash-grad",
+        description="fused one-pass backward engaged; remat never "
+        "re-runs the forward kernel; no [S,S] buffer",
+        build=_build_fused_flash_grad,
+        jaxpr_counts={
+            "_dqkv_kernel_fused": 1,
+            "_dq_kernel": 0,
+            "_dkv_kernel": 0,
+        },
+        forbid_jaxpr_shapes="seq_shape",
+        meta_true=(
+            "bwd_fused", "single_kv_pass", "deep_fused",
+            "deep_single_kv_pass", "noncausal_two_pass",
+            "byte_model_ok", "streams_pinned",
+        ),
+        meta_equal=(("fwd_count_ckpt", "fwd_count_plain"),),
+    ),
+    ProgramContract(
+        name="serving-batch",
+        description="servable bucket program: zero collectives",
+        build=_build_serving_batch,
+        forbid_collectives=(
+            "all-gather", "reduce-scatter", "all-reduce",
+            "collective-permute", "all-to-all",
+        ),
+    ),
+)
+
+
+# -- the runner -------------------------------------------------------------
+
+
+def check_contract(contract: ProgramContract) -> list[Finding]:
+    """Build the program and evaluate every declarative assertion;
+    returns findings (empty = contract holds)."""
+    from kubeflow_tpu.testing.hlo import (
+        allreduce_element_counts,
+        collective_counts,
+    )
+
+    path = f"<program:{contract.name}>"
+    out: list[Finding] = []
+
+    def fail(msg: str) -> None:
+        out.append(Finding(path, 0, "program-contract", msg))
+
+    try:
+        prog = contract.build()
+    except Exception as e:  # surface, don't crash the whole run
+        fail(f"builder raised {type(e).__name__}: {e}")
+        return out
+
+    if contract.expect_collectives or contract.forbid_collectives:
+        counts = collective_counts(prog.hlo or "")
+        for op in contract.expect_collectives:
+            if not counts.get(op):
+                fail(
+                    f"expected {op!r} in compiled HLO but found none "
+                    f"(counts: {counts}) — the sharding silently "
+                    "degenerated"
+                )
+        for op in contract.forbid_collectives:
+            if counts.get(op):
+                fail(
+                    f"forbidden {op!r} appears {counts[op]}x in "
+                    "compiled HLO — the program materializes what it "
+                    "should stream"
+                )
+    if contract.allreduce_cap is not None:
+        cap = prog.meta[contract.allreduce_cap]
+        big = [
+            n for n in allreduce_element_counts(prog.hlo or "") if n >= cap
+        ]
+        if big:
+            fail(
+                f"all-reduce of {big} elements >= "
+                f"{contract.allreduce_cap}={cap} — the scalar/grad-only "
+                "wire contract regressed"
+            )
+    for pattern, want in sorted(contract.jaxpr_counts.items()):
+        want_n = prog.meta[want] if isinstance(want, str) else want
+        got = (prog.jaxpr or "").count(pattern)
+        if got != want_n:
+            fail(
+                f"jaxpr traces {pattern!r} {got}x, contract says "
+                f"{want_n}x"
+            )
+    if contract.forbid_jaxpr_shapes is not None:
+        rx = prog.meta[contract.forbid_jaxpr_shapes]
+        hits = sorted(set(re.findall(rx, prog.jaxpr or "")))
+        if hits:
+            fail(
+                f"quadratic buffer shape(s) {hits} in the traced "
+                "program — the score matrix is materializing"
+            )
+    for key in contract.meta_true:
+        if not prog.meta.get(key):
+            fail(f"`{key}` is falsy: {prog.meta.get(key)!r}")
+    for a, b in contract.meta_equal:
+        if prog.meta[a] != prog.meta[b]:
+            fail(f"`{a}`={prog.meta[a]!r} != `{b}`={prog.meta[b]!r}")
+    for container, member in contract.meta_contains:
+        if prog.meta[member] not in prog.meta[container]:
+            fail(
+                f"`{member}`={prog.meta[member]!r} not in "
+                f"`{container}`={prog.meta[container]!r}"
+            )
+    return out
+
+
+def run_contract(name: str) -> None:
+    """Assert one contract holds — the thin-wrapper entry point tests
+    keep their historical names on."""
+    by_name = {c.name: c for c in CONTRACTS}
+    findings = check_contract(by_name[name])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def contract_findings() -> list[Finding]:
+    """Every contract, as lint findings (the `--programs` backend)."""
+    out: list[Finding] = []
+    for contract in CONTRACTS:
+        out.extend(check_contract(contract))
+    return out

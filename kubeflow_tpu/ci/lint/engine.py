@@ -1,0 +1,395 @@
+"""kftpu-lint engine: rule registry, suppressions, baseline, reporting.
+
+The platform's correctness contracts (scalar-psum-only pipelines,
+frozen-snapshot thaw discipline, interrupt hygiene, endpoint-list
+clients, ...) started life as ad-hoc regex greps in
+`tests/test_ci_tools.py`. This module is the real analyzer those greps
+grew into: a visitor-based AST pass over every `.py` under
+`kubeflow_tpu/` (plus the e2e workers for the rules that scope there),
+with
+
+- per-line suppressions: ``# kftpu-lint: disable=<rule>[,<rule>...]``
+  on the finding's line;
+- unused-suppression detection (a disable comment that silences
+  nothing is itself a finding — suppressions must not outlive the code
+  they excuse);
+- a checked-in baseline (`baseline.json`) for grandfathered findings,
+  each carrying a written justification; a baseline entry that no
+  longer matches anything is reported as ``stale-baseline`` so the
+  file only shrinks;
+- deterministic output: files are discovered in sorted order,
+  `__pycache__`/hidden/generated files are skipped by rule (not by
+  filesystem accident), and findings sort on (path, line, rule,
+  message) — lint output is byte-stable across runs.
+
+Rules live in `rules.py` (AST backend) and `contracts.py` (traced
+jaxpr/HLO program backend). The CLI is `python -m kubeflow_tpu.ci
+lint`; `tests/test_lint_clean.py` runs the same engine as the tier-1
+gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Callable, Iterable, Iterator
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[3]
+
+# Comment grammar. Anchored to the finding's line; `disable=` names one
+# or more rule ids.
+_SUPPRESS_RE = re.compile(
+    r"#\s*kftpu-lint:\s*disable=([A-Za-z0-9_,\- ]+)"
+)
+
+# Files whose first two lines carry this marker are machine-written
+# (protobuf-style); the engine never reports into them.
+_GENERATED_MARKER = "@generated"
+
+META_RULES = ("unused-suppression", "stale-baseline", "parse-error")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint finding. `message` is line-number-free on purpose: the
+    baseline keys on (path, rule, message) so findings survive
+    unrelated edits shifting line numbers."""
+
+    path: str  # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule sees about one file: parsed once, shared by
+    every rule that applies."""
+
+    relpath: str
+    source: str
+    lines: list[str]
+    tree: ast.Module
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = (
+            node_or_line
+            if isinstance(node_or_line, int)
+            else getattr(node_or_line, "lineno", 1)
+        )
+        return Finding(self.relpath, line, rule, message)
+
+
+class Rule:
+    """Base class for AST rules. Subclasses set `id`/`rationale`,
+    narrow `applies` to their path scope, and yield findings from
+    `check`."""
+
+    id: str = ""
+    rationale: str = ""
+    # Default scope: the whole package. Rules override with tighter
+    # predicates (a directory, or one specific module).
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("kubeflow_tpu/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    rule = cls()
+    assert rule.id and rule.id not in _REGISTRY, rule.id
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """id -> rule instance, importing the rule modules on first use."""
+    from kubeflow_tpu.ci.lint import rules  # noqa: F401  (registers)
+
+    return dict(_REGISTRY)
+
+
+# -- discovery --------------------------------------------------------------
+
+
+def default_files(root: pathlib.Path | None = None) -> list[pathlib.Path]:
+    """The repo's lintable set: every `.py` under `kubeflow_tpu/`, plus
+    the e2e worker scripts (the endpoint-list rule scopes there).
+    Sorted, `__pycache__`/hidden dirs skipped — deterministic by
+    construction, never by directory-iteration order."""
+    root = root or REPO_ROOT
+    files = list((root / "kubeflow_tpu").rglob("*.py"))
+    e2e = root / "tests" / "e2e"
+    if e2e.is_dir():
+        files += e2e.glob("*.py")
+    return sorted(p for p in files if not _skipped(p, root))
+
+
+def _skipped(path: pathlib.Path, root: pathlib.Path) -> bool:
+    rel = path.relative_to(root).parts
+    return any(part == "__pycache__" or part.startswith(".") for part in rel)
+
+
+def _is_generated(source: str) -> bool:
+    head = source.split("\n", 2)[:2]
+    return any(_GENERATED_MARKER in line for line in head)
+
+
+def suppressions(source: str) -> dict[int, set[str]]:
+    """line number -> rule ids disabled on that line. Anchored to real
+    COMMENT tokens, so a disable string quoted inside a docstring (e.g.
+    documentation showing the syntax) neither suppresses anything nor
+    trips unused-suppression."""
+    import io
+    import tokenize
+
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # The file parsed (callers check first), so this is unreachable
+        # in practice; fall back to the conservative line scan.
+        tokens = None
+    if tokens is None:
+        candidates = [
+            (i, line) for i, line in enumerate(source.splitlines(), 1)
+        ]
+    else:
+        candidates = [
+            (tok.start[0], tok.string)
+            for tok in tokens
+            if tok.type == tokenize.COMMENT
+        ]
+    for lineno, text in candidates:
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out[lineno] = {
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            }
+    return out
+
+
+# -- baseline ---------------------------------------------------------------
+
+DEFAULT_BASELINE = pathlib.Path(__file__).with_name("baseline.json")
+
+
+def load_baseline(path: pathlib.Path | None) -> list[dict]:
+    """Grandfathered findings: [{path, rule, message, why}]. Every
+    entry MUST carry a written justification (`why`)."""
+    if path is None or not path.exists():
+        return []
+    doc = json.loads(path.read_text())
+    entries = doc.get("findings", [])
+    for e in entries:
+        missing = {"path", "rule", "message", "why"} - set(e)
+        if missing:
+            raise ValueError(
+                f"baseline entry {e!r} missing {sorted(missing)} — "
+                "grandfathered findings need a written justification"
+            )
+    return entries
+
+
+# -- the run ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]  # unsuppressed, post-baseline — the gate
+    suppressed: list[Finding]
+    baselined: list[Finding]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def render(self) -> str:
+        out = [f.render() for f in self.findings]
+        out.append(
+            f"{len(self.findings)} finding(s), "
+            f"{len(self.suppressed)} suppressed, "
+            f"{len(self.baselined)} baselined"
+        )
+        return "\n".join(out) + "\n"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "findings": [f.to_dict() for f in self.findings],
+                "suppressed": [f.to_dict() for f in self.suppressed],
+                "baselined": [f.to_dict() for f in self.baselined],
+            },
+            indent=2,
+            sort_keys=True,
+        ) + "\n"
+
+
+def lint_files(
+    files: Iterable[pathlib.Path],
+    *,
+    root: pathlib.Path | None = None,
+    rules: Iterable[str] | None = None,
+    baseline: pathlib.Path | None = DEFAULT_BASELINE,
+    extra_checks: Iterable[
+        Callable[[], Iterable[Finding]]
+    ] = (),
+) -> LintResult:
+    """Run the engine over `files` (paths under `root`). `rules`
+    narrows to a subset of rule ids; `extra_checks` lets callers splice
+    in non-AST passes (the program-contract backend) so their findings
+    ride the same suppression-free reporting path."""
+    root = root or REPO_ROOT
+    registry = all_rules()
+    if rules is not None:
+        unknown = set(rules) - set(registry)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        registry = {k: v for k, v in registry.items() if k in rules}
+
+    raw: list[Finding] = []
+    suppressed: list[Finding] = []
+    used: set[tuple[str, int, str]] = set()
+    all_suppressions: list[tuple[str, int, set[str]]] = []
+
+    for path in sorted(set(files)):
+        relpath = path.relative_to(root).as_posix()
+        source = path.read_text()
+        if _is_generated(source):
+            continue
+        lines = source.splitlines()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            raw.append(
+                Finding(
+                    relpath, e.lineno or 1, "parse-error",
+                    f"file does not parse: {e.msg}",
+                )
+            )
+            continue
+        ctx = FileContext(relpath, source, lines, tree)
+        supp = suppressions(source)
+        for lineno, ids in sorted(supp.items()):
+            all_suppressions.append((relpath, lineno, ids))
+        for rule in registry.values():
+            if not rule.applies(relpath):
+                continue
+            for finding in rule.check(ctx):
+                ids = supp.get(finding.line, set())
+                if finding.rule in ids:
+                    suppressed.append(finding)
+                    used.add((relpath, finding.line, finding.rule))
+                else:
+                    raw.append(finding)
+
+    # Unused suppressions: a disable comment whose (line, rule) matched
+    # nothing. Only raised for rules this run actually executed, so a
+    # --rule-narrowed invocation never mislabels live suppressions.
+    for relpath, lineno, ids in all_suppressions:
+        for rule_id in sorted(ids):
+            if rule_id not in registry:
+                if rules is None:
+                    raw.append(
+                        Finding(
+                            relpath, lineno, "unused-suppression",
+                            f"disable comment names unknown rule "
+                            f"{rule_id!r}",
+                        )
+                    )
+                continue
+            if (relpath, lineno, rule_id) not in used:
+                raw.append(
+                    Finding(
+                        relpath, lineno, "unused-suppression",
+                        f"disable comment for {rule_id!r} suppresses "
+                        "nothing — remove it",
+                    )
+                )
+
+    for check in extra_checks:
+        raw.extend(check())
+
+    # Baseline: grandfathered findings subtract from the gate; stale
+    # entries are themselves findings so the baseline only shrinks.
+    entries = load_baseline(baseline)
+    by_key = {(e["path"], e["rule"], e["message"]): e for e in entries}
+    matched: set[tuple[str, str, str]] = set()
+    findings: list[Finding] = []
+    baselined: list[Finding] = []
+    for f in raw:
+        if f.key in by_key:
+            matched.add(f.key)
+            baselined.append(f)
+        else:
+            findings.append(f)
+    if rules is None:
+        # Program-contract entries (path `<program:NAME>`) can only be
+        # judged stale on runs where the program pass actually executed
+        # (extra_checks carries it); the AST-only default run must not
+        # flag them.
+        programs_ran = bool(extra_checks)
+        for key, e in by_key.items():
+            if key in matched:
+                continue
+            if e["path"].startswith("<program:") and not programs_ran:
+                continue
+            findings.append(
+                Finding(
+                    e["path"], 0, "stale-baseline",
+                    f"baseline entry for [{e['rule']}] "
+                    f"{e['message']!r} no longer matches — remove "
+                    "it from baseline.json",
+                )
+            )
+
+    return LintResult(
+        findings=sorted(findings),
+        suppressed=sorted(suppressed),
+        baselined=sorted(baselined),
+    )
+
+
+def lint_repo(
+    *,
+    root: pathlib.Path | None = None,
+    rules: Iterable[str] | None = None,
+    baseline: pathlib.Path | None = DEFAULT_BASELINE,
+    programs: bool = False,
+) -> LintResult:
+    """The full engine over the repo's default file set — what both the
+    CLI and `tests/test_lint_clean.py` run."""
+    extra: list[Callable[[], Iterator[Finding]]] = []
+    if programs:
+        from kubeflow_tpu.ci.lint.contracts import contract_findings
+
+        extra.append(contract_findings)
+    return lint_files(
+        default_files(root),
+        root=root,
+        rules=rules,
+        baseline=baseline,
+        extra_checks=extra,
+    )

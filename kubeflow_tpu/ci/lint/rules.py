@@ -1,0 +1,1027 @@
+"""kftpu-lint AST rules: the platform's contracts as declarative checks.
+
+Each rule encodes one correctness contract the repo already relies on
+(docs/lint.md is the catalog — id, rationale, example finding,
+suppression syntax). Six of these replaced the regex lints that lived
+in `tests/test_ci_tools.py`; the rest cover the bug classes the
+ROADMAP's next items multiply: host syncs inside jitted step
+functions, mutation of frozen copy-on-write snapshots without
+`.thaw()`, and lock-discipline races in the threaded control plane.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from kubeflow_tpu.ci.lint.engine import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`a.b.c` as "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node: ast.AST) -> str | None:
+    """The base identifier of a Name/Attribute/Subscript/Call chain:
+    `x.spec["a"].b` -> "x"."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = (
+            node.func
+            if isinstance(node, ast.Call)
+            else node.value
+        )
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def func_defs(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+_MUTATOR_METHODS = {
+    "update", "setdefault", "pop", "popitem", "clear",
+    "append", "extend", "insert", "remove", "sort", "reverse",
+}
+
+
+def flat_targets(targets: list[ast.AST]) -> Iterator[ast.AST]:
+    """Assignment targets with tuple/list unpacking (and starred
+    elements) flattened: `self.a, (b, *self.c) = ...` yields
+    `self.a`, `b`, `self.c`."""
+    for tgt in targets:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            yield from flat_targets(tgt.elts)
+        elif isinstance(tgt, ast.Starred):
+            yield from flat_targets([tgt.value])
+        else:
+            yield tgt
+
+
+# -- host-sync-in-jit -------------------------------------------------------
+
+
+_HOST_SYNC_CALLS = {
+    "jax.device_get", "jax.block_until_ready",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray",
+}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_SYNC_BUILTINS = {"float", "int", "bool", "print"}
+
+
+@register
+class HostSyncInJit(Rule):
+    """No host synchronization inside jit-traced step functions.
+
+    `.item()` / `float()` / `np.asarray` / `jax.device_get` / `print`
+    on a tracer inside a jitted step forces a device->host fence every
+    step (or fails at trace time after a refactor) — metrics must stay
+    on device and sync only at log boundaries (the PR 5 guard
+    contract: zero per-step host sync)."""
+
+    id = "host-sync-in-jit"
+    rationale = (
+        "host syncs inside jitted steps serialize the device pipeline"
+    )
+
+    _DIRS = (
+        "kubeflow_tpu/train/", "kubeflow_tpu/ops/",
+        "kubeflow_tpu/parallel/", "kubeflow_tpu/models/",
+    )
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith(self._DIRS)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        jitted = self._jitted_defs(ctx.tree)
+        seen: set[int] = set()
+        for fn in jitted:
+            for node in ast.walk(fn):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                msg = self._host_sync(node)
+                if msg:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{msg} inside jit-traced "
+                        f"`{self._jit_name(fn)}` — keep the step "
+                        "device-side (sync at log boundaries)",
+                    )
+
+    @staticmethod
+    def _jit_name(fn: ast.AST) -> str:
+        return getattr(fn, "name", "<lambda>")
+
+    def _jitted_defs(self, tree: ast.Module) -> list[ast.AST]:
+        """Functions traced under jit: defs decorated with jit (incl.
+        partial(jax.jit, ...)), defs/lambdas passed to a jit call, and
+        everything nested inside those."""
+        by_name: dict[int, dict[str, ast.AST]] = {}
+
+        def scope_defs(scope: ast.AST) -> dict[str, ast.AST]:
+            if id(scope) not in by_name:
+                names = {}
+                for stmt in ast.iter_child_nodes(scope):
+                    if isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        names[stmt.name] = stmt
+                by_name[id(scope)] = names
+            return by_name[id(scope)]
+
+        def is_jit_expr(node: ast.AST) -> bool:
+            name = dotted(node)
+            if name and name.split(".")[-1] in ("jit", "pjit"):
+                return True
+            if isinstance(node, ast.Call):
+                # functools.partial(jax.jit, ...) / decorator factories
+                fname = dotted(node.func)
+                if fname and fname.split(".")[-1] == "partial":
+                    return any(is_jit_expr(a) for a in node.args[:1])
+            return False
+
+        roots: list[ast.AST] = []
+        scopes = [tree] + [
+            n for n in ast.walk(tree)
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+        ]
+        for scope in scopes:
+            local = scope_defs(scope)
+            for node in ast.walk(scope):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and any(
+                    is_jit_expr(d) for d in node.decorator_list
+                ):
+                    roots.append(node)
+                if isinstance(node, ast.Call) and is_jit_expr(node.func):
+                    for arg in node.args[:1]:
+                        if isinstance(arg, ast.Lambda):
+                            roots.append(arg)
+                        elif (
+                            isinstance(arg, ast.Name)
+                            and arg.id in local
+                        ):
+                            roots.append(local[arg.id])
+        # Dedup, outermost only (nested defs are walked via ast.walk).
+        uniq: list[ast.AST] = []
+        ids: set[int] = set()
+        for r in roots:
+            if id(r) not in ids:
+                ids.add(id(r))
+                uniq.append(r)
+        return uniq
+
+    @staticmethod
+    def _host_sync(node: ast.AST) -> str | None:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted(node.func)
+        if name in _HOST_SYNC_CALLS:
+            return f"`{name}(...)`"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_SYNC_METHODS
+        ):
+            return f"`.{node.func.attr}()`"
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _HOST_SYNC_BUILTINS
+        ):
+            # float()/int()/bool() of a literal or pure-constant
+            # expression is trace-time arithmetic, not a sync.
+            if node.func.id != "print" and all(
+                isinstance(a, ast.Constant) for a in node.args
+            ):
+                return None
+            return f"`{node.func.id}(...)`"
+        return None
+
+
+# -- thaw-before-mutate -----------------------------------------------------
+
+
+_API_RECEIVERS = ("api", "client", "apiserver", "store", "leases")
+_API_METHODS = {"get", "create", "update"}
+# `list` results are plain (mutable) lists OF frozen snapshots, so only
+# iteration targets are tracked, not the list binding itself.
+_API_ITER_METHODS = _API_METHODS | {"list"}
+
+
+def _api_call(node: ast.AST, methods: frozenset | set = None) -> bool:
+    """True for `<...api|client|...>.get(...)`-shaped calls whose result
+    is a (possibly frozen) shared Resource snapshot."""
+    if not (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in (methods or _API_METHODS)
+    ):
+        return False
+    recv = dotted(node.func.value)
+    if recv is None:
+        return False
+    leaf = recv.split(".")[-1].lstrip("_")
+    return any(leaf == r or leaf.endswith(r) for r in _API_RECEIVERS)
+
+
+@register
+class ThawBeforeMutate(Rule):
+    """Read-modify-write on store results goes through `.thaw()`.
+
+    The copy-on-write store (PR 2) shares ONE frozen snapshot per
+    commit with every consumer; mutating an `api.get(...)` result in
+    place corrupts every other consumer — at runtime it raises
+    `FrozenResourceError`, but only on the code path that actually
+    runs. The canonical idiom is `fresh = api.get(...).thaw()`."""
+
+    id = "thaw-before-mutate"
+    rationale = "frozen shared snapshots must be thawed before mutation"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in func_defs(ctx.tree):
+            yield from self._check_scope(ctx, fn)
+
+    def _check_scope(
+        self, ctx: FileContext, fn: ast.AST
+    ) -> Iterator[Finding]:
+        frozen: set[str] = set()
+
+        def ends_in_thaw(call: ast.AST) -> bool:
+            return (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("thaw", "deepcopy", "to_dict")
+            )
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.findings: list[Finding] = []
+
+            def visit_FunctionDef(self, node):
+                if node is not fn:
+                    return  # nested scopes analyzed separately
+                self.generic_visit(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Assign(self, node):
+                self.generic_visit(node)
+                tracked = _api_call(node.value) and not ends_in_thaw(
+                    node.value
+                )
+                for tgt in flat_targets(node.targets):
+                    self._mutation(tgt, node)
+                    if isinstance(tgt, ast.Name):
+                        if tracked and not isinstance(
+                            node.targets[0], (ast.Tuple, ast.List)
+                        ):
+                            frozen.add(tgt.id)
+                        else:
+                            frozen.discard(tgt.id)
+
+            def visit_AugAssign(self, node):
+                self.generic_visit(node)
+                self._mutation(node.target, node)
+
+            def visit_For(self, node):
+                if (
+                    _api_call(node.iter, _API_ITER_METHODS)
+                    and isinstance(node.target, ast.Name)
+                ):
+                    frozen.add(node.target.id)
+                self.generic_visit(node)
+
+            def visit_Call(self, node):
+                self.generic_visit(node)
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATOR_METHODS
+                ):
+                    base = root_name(node.func.value)
+                    # `x.update(...)` on the resource itself is not a
+                    # container mutation; only chains that descend into
+                    # spec/status/metadata containers are.
+                    if (
+                        base in frozen
+                        and isinstance(node.func.value, ast.Attribute)
+                    ):
+                        self.findings.append(
+                            ctx.finding(
+                                ThawBeforeMutate.id, node,
+                                f"`{base}` comes from the store "
+                                "frozen; call `.thaw()` before "
+                                f"`.{node.func.attr}(...)` "
+                                "(read-modify-write on a shared "
+                                "snapshot)",
+                            )
+                        )
+
+            def _mutation(self, tgt: ast.AST, node: ast.AST) -> None:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    base = root_name(tgt)
+                    if base in frozen:
+                        self.findings.append(
+                            ctx.finding(
+                                ThawBeforeMutate.id, node,
+                                f"`{base}` comes from the store "
+                                "frozen; call `.thaw()` before "
+                                "assigning into it (read-modify-write "
+                                "on a shared snapshot)",
+                            )
+                        )
+
+        v = V()
+        for stmt in fn.body:
+            v.visit(stmt)
+        yield from v.findings
+
+
+# -- lock-discipline --------------------------------------------------------
+
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore")
+
+
+@register
+class LockDiscipline(Rule):
+    """Attributes written under a lock are written under it everywhere.
+
+    In the threaded control-plane classes, an attribute that SOME
+    method assigns inside `with self._lock:` is part of that lock's
+    protected state; a write to it outside the lock (in any method
+    other than `__init__`, which runs before threads exist, or a
+    `*_locked` helper, which documents lock-held context) is a race.
+    Plain lock-free READS are a documented idiom here (GIL-atomic
+    reference reads, e.g. `FileLeaseStore.read_spec`), so only writes
+    and container RMW (`+=`, `.append`, subscript stores) count."""
+
+    id = "lock-discipline"
+    rationale = "guarded state must not be written outside its lock"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> set[str]:
+        locks: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                fname = dotted(node.value.func) or ""
+                if fname.split(".")[-1] in _LOCK_FACTORIES:
+                    for tgt in node.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            locks.add(tgt.attr)
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    name = dotted(item.context_expr)
+                    if name and name.startswith("self."):
+                        attr = name.split(".", 1)[1]
+                        if "lock" in attr or attr.endswith(
+                            ("_cv", "_cond")
+                        ):
+                            locks.add(attr)
+        return locks
+
+    def _check_class(
+        self, ctx: FileContext, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        locks = self._lock_attrs(cls)
+        if not locks:
+            return
+
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+        def exempt(m: ast.AST) -> bool:
+            return m.name == "__init__" or m.name.endswith("_locked")
+
+        def self_write_targets(node: ast.AST) -> Iterator[str]:
+            """self.X names written by this statement (attr assign,
+            aug-assign, subscript store rooted at self.X, incl. inside
+            tuple/list unpacking)."""
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for tgt in flat_targets(targets):
+                base = tgt
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if (
+                    isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                ):
+                    yield base.attr
+
+        def self_mutator_target(node: ast.AST) -> str | None:
+            """self.X for `self.X.append(...)`-style container RMW."""
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS
+            ):
+                base = node.func.value
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    if (
+                        isinstance(base, ast.Attribute)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id == "self"
+                    ):
+                        return base.attr
+                    base = base.value
+            return None
+
+        def walk(node, held: bool, sink) -> None:
+            if isinstance(node, ast.With):
+                now_held = held or any(
+                    (dotted(i.context_expr) or "").startswith("self.")
+                    and (dotted(i.context_expr) or "").split(".", 1)[1]
+                    in locks
+                    for i in node.items
+                )
+                for child in node.body:
+                    walk(child, now_held, sink)
+                return
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return  # nested defs: deferred execution, skip
+            sink(node, held)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, sink)
+
+        guarded: set[str] = set()
+
+        def collect(node, held):
+            if held:
+                guarded.update(self_write_targets(node))
+                m = self_mutator_target(node)
+                if m:
+                    guarded.add(m)
+
+        for m in methods:
+            if m.name != "__init__":
+                for stmt in m.body:
+                    walk(stmt, False, collect)
+        guarded -= locks
+        if not guarded:
+            return
+
+        findings: list[Finding] = []
+
+        def audit_method(m):
+            def audit(node, held):
+                if held:
+                    return
+                for attr in self_write_targets(node):
+                    if attr in guarded:
+                        findings.append(
+                            ctx.finding(
+                                self.id, node,
+                                f"`self.{attr}` is assigned under "
+                                f"`{cls.name}`'s lock elsewhere but "
+                                f"written lock-free in "
+                                f"`{m.name}` — take the lock or "
+                                "rename the helper `*_locked`",
+                            )
+                        )
+                mut = self_mutator_target(node)
+                if mut in guarded:
+                    findings.append(
+                        ctx.finding(
+                            self.id, node,
+                            f"`self.{mut}` is lock-guarded state but "
+                            f"mutated lock-free in `{m.name}` — take "
+                            "the lock or rename the helper `*_locked`",
+                        )
+                    )
+
+            for stmt in m.body:
+                walk(stmt, False, audit)
+
+        for m in methods:
+            if not exempt(m):
+                audit_method(m)
+        yield from findings
+
+
+# -- no-bare-except ---------------------------------------------------------
+
+
+def _catches(handler: ast.ExceptHandler, name: str) -> bool:
+    t = handler.type
+    types = (
+        list(t.elts) if isinstance(t, ast.Tuple) else [t] if t else []
+    )
+    for typ in types:
+        d = dotted(typ)
+        if d and d.split(".")[-1] == name:
+            return True
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(
+        isinstance(n, ast.Raise) and n.exc is None
+        for n in ast.walk(handler)
+    )
+
+
+@register
+class NoBareExcept(Rule):
+    """No bare `except:` / swallowed `except BaseException` repo-wide.
+
+    Both catch KeyboardInterrupt and SystemExit, turning a preemption
+    or shutdown into a hang or a half-written state. A
+    cleanup-then-reraise handler (`except BaseException: ...; raise`)
+    is allowed — it doesn't swallow. (train/ has the stricter
+    no-interrupt-swallow rule on top of this one.)"""
+
+    id = "no-bare-except"
+    rationale = "bare excepts swallow interrupts and shutdowns"
+
+    def applies(self, relpath: str) -> bool:
+        # Truly repo-wide across the engine's file set: the e2e worker
+        # and driver scripts are long-lived subprocesses where a
+        # swallowed SystemExit hangs the harness.
+        return relpath.startswith(("kubeflow_tpu/", "tests/e2e/"))
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield ctx.finding(
+                    self.id, node,
+                    "bare `except:` — catch `Exception` (or narrower); "
+                    "bare catches swallow KeyboardInterrupt/SystemExit",
+                )
+            elif _catches(node, "BaseException") and not _reraises(node):
+                yield ctx.finding(
+                    self.id, node,
+                    "`except BaseException` without re-raise — this "
+                    "swallows KeyboardInterrupt/SystemExit; catch "
+                    "`Exception` or re-raise",
+                )
+
+
+@register
+class NoInterruptSwallow(Rule):
+    """train/ never intercepts interrupts, even to re-raise.
+
+    The preemption contract (docs/resilience.md, PR 5) relies on
+    SIGTERM/SIGINT and process exit flowing untouched to `fit()`'s
+    step-boundary handler; an `except KeyboardInterrupt` mid-step —
+    even one that re-raises — is a place for a half-written save to
+    hide."""
+
+    id = "no-interrupt-swallow"
+    rationale = "preemption must reach fit()'s boundary handler"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("kubeflow_tpu/train/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield ctx.finding(
+                    self.id, node,
+                    "bare `except:` in train/ — interrupts must reach "
+                    "fit()'s boundary handler (docs/resilience.md)",
+                )
+                continue
+            for name in (
+                "BaseException", "KeyboardInterrupt", "SystemExit",
+            ):
+                if _catches(node, name):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"`except {name}` in train/ — preemption is "
+                        "handled at step boundaries via signal "
+                        "handlers, never by catching the exception "
+                        "mid-step (docs/resilience.md)",
+                    )
+
+
+# -- no-deepcopy-hot-path ---------------------------------------------------
+
+
+@register
+class NoDeepcopyHotPath(Rule):
+    """No deepcopy in the store fan-out/read hot paths.
+
+    The copy-on-write rewrite (PR 2, docs/perf.md) removed every
+    defensive deepcopy from event dispatch and get/list of BOTH store
+    backends; one creeping back silently restores O(watchers x events)
+    copying."""
+
+    id = "no-deepcopy-hot-path"
+    rationale = "hot paths share frozen snapshots, never copies"
+
+    _HOT: dict[str, tuple[str, ...]] = {
+        "kubeflow_tpu/testing/fake_apiserver.py": (
+            "_emit", "_dispatch_loop", "get", "list",
+            "select_journal_events",
+        ),
+        "kubeflow_tpu/native/apiserver.py": (
+            "_drain_events", "get", "list",
+        ),
+    }
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in self._HOT
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        hot = self._HOT[ctx.relpath]
+        found: set[str] = set()
+        for fn in func_defs(ctx.tree):
+            if fn.name not in hot:
+                continue
+            found.add(fn.name)
+            for node in ast.walk(fn):
+                used = None
+                if isinstance(node, ast.Name) and node.id == "deepcopy":
+                    used = "deepcopy"
+                elif (
+                    isinstance(node, ast.Attribute)
+                    and node.attr in ("deepcopy", "__deepcopy__")
+                ):
+                    used = f".{node.attr}"
+                if used:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"`{used}` in hot path `{fn.name}` — fan-out "
+                        "and reads must share frozen snapshots "
+                        "(docs/perf.md)",
+                    )
+        # A renamed/deleted hot path would otherwise silently drop its
+        # guard (the pre-migration test resolved these at runtime and
+        # failed loudly on rename) — keep the rule config honest.
+        for name in sorted(set(hot) - found):
+            yield ctx.finding(
+                self.id, 1,
+                f"hot path `{name}` not found in {ctx.relpath} — "
+                "update the no-deepcopy-hot-path rule config to track "
+                "its new name",
+            )
+
+
+# -- endpoint-list-clients --------------------------------------------------
+
+
+@register
+class EndpointListClients(Rule):
+    """Config-driven HttpApiClients parse endpoint LISTS.
+
+    The `--apiserver`/`--server` flags and KFTPU_APISERVER env are the
+    endpoint-list channel (comma-separated for active-passive HA
+    pairs). `HttpApiClient(args.apiserver)` treats "url1,url2" as one
+    malformed URL — or, handed only the active's URL, stalls forever
+    when that facade dies. Config strings go through
+    `endpoints_from_env`."""
+
+    id = "endpoint-list-clients"
+    rationale = "failover rides the endpoint list"
+
+    # The config-driven entry points (flags/env are their only input):
+    # in these files, ANY HttpApiClient construction without an
+    # endpoints_from_env reference somewhere in the file is a finding,
+    # even when the dataflow pass can't trace the config (threaded
+    # through a helper parameter or an instance attribute) — the
+    # file-level backstop the pre-migration regex test enforced.
+    _CONFIG_DRIVEN = (
+        "kubeflow_tpu/cli.py",
+        "kubeflow_tpu/controllers/__main__.py",
+        "kubeflow_tpu/controllers/webhook.py",
+        "kubeflow_tpu/deploy/worker.py",
+        "kubeflow_tpu/sidecar/__main__.py",
+    )
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("kubeflow_tpu/") or (
+            relpath.startswith("tests/e2e/") and "worker" in relpath
+        )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        found_any = False
+        for finding in self._dataflow(ctx):
+            found_any = True
+            yield finding
+        if found_any or not (
+            ctx.relpath in self._CONFIG_DRIVEN
+            or ctx.relpath.startswith("tests/e2e/")
+        ):
+            return
+        client_calls = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.Call)
+            and (dotted(n.func) or "").split(".")[-1] == "HttpApiClient"
+        ]
+        uses_helper = any(
+            (isinstance(n, ast.Name) and n.id == "endpoints_from_env")
+            or (
+                isinstance(n, ast.Attribute)
+                and n.attr == "endpoints_from_env"
+            )
+            for n in ast.walk(ctx.tree)
+        )
+        if client_calls and not uses_helper:
+            yield ctx.finding(
+                self.id, client_calls[0],
+                "this config-driven entry point builds HttpApiClient "
+                "without referencing `endpoints_from_env` anywhere — "
+                "however the endpoint string travels (helper param, "
+                "attribute), it must be parsed as a list "
+                "(docs/resilience.md)",
+            )
+
+    def _dataflow(self, ctx: FileContext) -> Iterator[Finding]:
+        # Each scope tracks its own config-derived locals and walks
+        # only its own statements (pruned at nested defs, which get
+        # their own pass) — a `server = args.x` inside one function
+        # must not taint an unrelated function's `server`.
+        for fn in [ctx.tree, *func_defs(ctx.tree)]:
+            config_vars: set[str] = set()
+            for sub in self._scope_walk(fn.body, prune=True):
+                if isinstance(sub, ast.Assign):
+                    derived = self._from_config(sub.value, config_vars)
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            if derived:
+                                config_vars.add(tgt.id)
+                            else:
+                                config_vars.discard(tgt.id)
+                if (
+                    isinstance(sub, ast.Call)
+                    and (dotted(sub.func) or "").split(".")[-1]
+                    == "HttpApiClient"
+                    and sub.args
+                    and self._from_config(sub.args[0], config_vars)
+                ):
+                    yield ctx.finding(
+                        self.id, sub,
+                        "HttpApiClient built from a bare config "
+                        "string — parse it with "
+                        "`endpoints_from_env(...)` so HA endpoint "
+                        "lists survive (docs/resilience.md)",
+                    )
+
+    @staticmethod
+    def _scope_walk(body, prune: bool):
+        """Source-ordered walk of a scope's statements; with `prune`,
+        nested function bodies are skipped (they get their own pass)."""
+        stack = list(reversed(body))
+        while stack:
+            node = stack.pop()
+            yield node
+            if prune and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+    @classmethod
+    def _from_config(cls, node: ast.AST, config_vars: set[str]) -> bool:
+        """arg derives from argparse/env config without going through
+        endpoints_from_env — including config woven through f-strings,
+        concatenation, or formatting calls (`f"http://{args.server}"`
+        is still one bare endpoint string)."""
+        if isinstance(node, ast.Call):
+            name = (dotted(node.func) or "").split(".")[-1]
+            if name == "endpoints_from_env":
+                return False
+            if (
+                dotted(node.func) in ("os.environ.get", "os.getenv")
+                or name == "getenv"
+            ):
+                return True
+            # "...{}".format(args.x) / ",".join(env_list) / any other
+            # transformation of a config string is still a config
+            # string (only endpoints_from_env sanctifies it).
+            parts = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Attribute):
+                parts.append(node.func.value)
+            return any(cls._from_config(p, config_vars) for p in parts)
+        if isinstance(node, ast.JoinedStr):
+            return any(
+                cls._from_config(v.value, config_vars)
+                for v in node.values
+                if isinstance(v, ast.FormattedValue)
+            )
+        if isinstance(node, ast.BinOp):
+            return cls._from_config(
+                node.left, config_vars
+            ) or cls._from_config(node.right, config_vars)
+        if isinstance(node, ast.Subscript):
+            return dotted(node.value) == "os.environ"
+        if isinstance(node, ast.Attribute):
+            return isinstance(node.value, ast.Name) and node.value.id in (
+                "args", "ns", "opts",
+            )
+        if isinstance(node, ast.Name):
+            return node.id in config_vars
+        return False
+
+
+# -- scalar-psum-only -------------------------------------------------------
+
+
+@register
+class ScalarPsumOnly(Rule):
+    """The pipeline layer all-reduces scalars only.
+
+    The seed design ended every step with `lax.psum(outputs, pp)` — an
+    all-reduce of the whole [M, mb, ...] activation buffer. The PR 4
+    contract: the ONLY `lax.psum` in parallel/pipeline.py is the
+    scalar loss reduction (activations move by ppermute, eval
+    broadcasts by ring rotation), and the transformer's pipelined path
+    adds no psum of its own."""
+
+    id = "scalar-psum-only"
+    rationale = "cross-pp traffic is ppermute + one scalar psum"
+
+    _ALLOWED = {"kubeflow_tpu/parallel/pipeline.py": ("local_loss",)}
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in (
+            "kubeflow_tpu/parallel/pipeline.py",
+            "kubeflow_tpu/models/transformer.py",
+        )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        allowed = self._ALLOWED.get(ctx.relpath, ())
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and (dotted(node.func) or "").split(".")[-1] == "psum"
+            ):
+                continue
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Name) and arg.id in allowed:
+                continue
+            what = (
+                (dotted(arg) or ast.unparse(arg)) if arg else "?"
+            )
+            yield ctx.finding(
+                self.id, node,
+                f"`lax.psum({what}, ...)` — the pipeline hot path's "
+                "only cross-pp all-reduce is the scalar loss "
+                "(docs/perf.md)",
+            )
+
+
+# -- flash-blockwise --------------------------------------------------------
+
+
+@register
+class FlashBlockwise(Rule):
+    """ops/flash.py never materializes the score matrix in HBM.
+
+    A `jnp.einsum` is the dense reference's O(S^2) formulation (that
+    lives in ops/attention.py); an [S, S]-shaped kernel `out_shape`
+    means scores are being written back to HBM. Every legitimate
+    output is an O(S*d) tile or an O(S) lse/delta tile. The
+    lane-packed lse helpers disappearing means the 128x-replicated
+    buffer came back."""
+
+    id = "flash-blockwise"
+    rationale = "the score matrix stays blockwise on-chip"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath == "kubeflow_tpu/ops/flash.py"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        seq_names = {"sq", "sk"}
+        defined: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defined.add(node.name)
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+            if name == "einsum":
+                yield ctx.finding(
+                    self.id, node,
+                    "`einsum` in ops/flash.py — the score matrix must "
+                    "stay blockwise on-chip (dense formulations live "
+                    "in ops/attention.py)",
+                )
+            if (
+                isinstance(node, ast.Call)
+                and (dotted(node.func) or "").split(".")[-1]
+                == "ShapeDtypeStruct"
+                and node.args
+                and isinstance(node.args[0], ast.Tuple)
+            ):
+                elts = node.args[0].elts
+                if (
+                    len(elts) >= 3
+                    and all(
+                        isinstance(e, ast.Name) and e.id in seq_names
+                        for e in elts[1:3]
+                    )
+                ):
+                    yield ctx.finding(
+                        self.id, node,
+                        "[S, S]-shaped HBM output "
+                        f"`{ast.unparse(node.args[0])}` — kernel "
+                        "outputs must be O(S*d) or O(S) lse/delta "
+                        "tiles (docs/perf.md)",
+                    )
+        for required in ("_lse_is_packed", "_pack_rows"):
+            if required not in defined:
+                yield ctx.finding(
+                    self.id, 1,
+                    f"lane-packed lse helper `{required}` is gone — "
+                    "the 128x-replicated lse buffer came back "
+                    "silently (docs/perf.md)",
+                )
+
+
+# -- fused-kernel-streams ---------------------------------------------------
+
+
+@register
+class FusedKernelStreams(Rule):
+    """The fused flash backward's ref streams stay exactly pinned.
+
+    `_dqkv_kernel_fused` consumes {rows, cols, q, k, v, do, lse,
+    delta} and produces {dq, dk, dv}; an `o_ref` creeping back in
+    silently restores an S*d HBM re-stream per step (the shared-delta
+    rewrite removed O from the backward). The single-KV-pass half of
+    this contract is runtime accounting — the `fused-flash-grad`
+    program contract covers it."""
+
+    id = "fused-kernel-streams"
+    rationale = "shared-delta backward streams no O"
+
+    _EXPECT = [
+        "rows_ref", "cols_ref", "q_ref", "k_ref", "v_ref", "do_ref",
+        "lse_ref", "delta_ref", "dq_ref", "dk_ref", "dv_ref",
+    ]
+
+    def applies(self, relpath: str) -> bool:
+        return relpath == "kubeflow_tpu/ops/flash.py"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in func_defs(ctx.tree):
+            if fn.name != "_dqkv_kernel_fused":
+                continue
+            refs = [
+                a.arg for a in fn.args.args if a.arg.endswith("_ref")
+            ]
+            if "o_ref" in refs:
+                yield ctx.finding(
+                    self.id, fn,
+                    "`o_ref` reappeared in the fused backward's "
+                    "streams — delta must arrive precomputed "
+                    "(shared-delta regression, docs/perf.md)",
+                )
+            elif refs != self._EXPECT:
+                yield ctx.finding(
+                    self.id, fn,
+                    f"fused kernel streams changed: {refs} != "
+                    f"{self._EXPECT}",
+                )
+            return
+        yield ctx.finding(
+            self.id, 1,
+            "`_dqkv_kernel_fused` is gone from ops/flash.py — the "
+            "one-pass backward (PR 7) disappeared",
+        )

@@ -151,7 +151,7 @@ class BatchingQueue:
                     self._closed
                     or self._pending_count >= self.config.max_batch
                 ):
-                    return self._cut()
+                    return self._cut_locked()
                 if not self._pending:
                     if self._closed:
                         return []
@@ -163,9 +163,9 @@ class BatchingQueue:
                 # wait indefinitely.
                 remaining = self._pending[0].arrived + timeout - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
-                    return self._cut()
+                    return self._cut_locked()
 
-    def _cut(self) -> list[_Entry]:
+    def _cut_locked(self) -> list[_Entry]:
         take: list[_Entry] = []
         count = 0
         while self._pending:
@@ -194,17 +194,44 @@ class BatchingQueue:
             for entry in entries:
                 key = (entry.instances.shape[1:], entry.instances.dtype.str)
                 groups.setdefault(key, []).append(entry)
-            for group in groups.values():
-                self._run_group(group)
+            try:
+                for group in groups.values():
+                    self._run_group(group)
+            except BaseException as e:
+                # An interrupt/exit is taking this scheduler thread
+                # down: close the queue and unblock EVERY caller that
+                # hasn't been signalled yet (later signature groups in
+                # this flush, plus everything still pending), then let
+                # it propagate — a dying batcher must never leave a
+                # predict() parked on an event nobody will set.
+                self._abort(entries, e)
+                raise
+
+    def _abort(self, entries: list[_Entry], e: BaseException) -> None:
+        with self._cv:
+            self._closed = True  # later predict() gets QueueClosed
+            pending, self._pending = self._pending, []
+            self._pending_count = 0
+            self._cv.notify_all()
+        for entry in entries + pending:
+            if not entry.event.is_set():
+                entry.error = e
+                entry.event.set()
 
     def _run_group(self, group: list[_Entry]) -> None:
         try:
             merged = np.concatenate([e.instances for e in group], axis=0)
             out = self.servable.predict(merged)
-        except BaseException as e:  # propagate to THIS group only
+        except BaseException as e:
+            # Execution failures propagate to THIS group only. An
+            # interrupt/exit also fails the group (the callers must not
+            # hang), then re-raises so _loop can abort the rest of the
+            # flush and die loudly instead of swallowing a shutdown.
             for entry in group:
                 entry.error = e
                 entry.event.set()
+            if not isinstance(e, Exception):
+                raise
             return
         self.batches_total.inc(model=self.servable.name)
         self.batched_instances_total.inc(

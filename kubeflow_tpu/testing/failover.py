@@ -15,7 +15,7 @@ active-passive pair over shared durable storage:
   BESIDE the store directory, with the same CAS surface the elector
   expects: get/create/update with resourceVersion preconditions,
   serialized under an OS file lock.
-- On takeover the new active replays the WAL (`FakeApiServer._restore`:
+- On takeover the new active replays the WAL (`FakeApiServer._restore_locked`:
   snapshot + journal tail, torn-tail repair, watch journal re-seeded at
   the durable resourceVersion so pre-failover bookmarks get an honest
   410 → relist), then `checkpoint()`s — which, via `PyWal.snapshot`'s
@@ -25,7 +25,7 @@ active-passive pair over shared durable storage:
 - Belt to that suspender: the active's WAL is wrapped in `FencedWal`,
   which re-reads the lease before every append/snapshot. The instant
   the term moves, the next durable write raises `WalFenced`, the store
-  fail-stops (`fake_apiserver._fail_stop` — in-memory divergence becomes
+  fail-stops (`fake_apiserver._fail_stop_locked` — in-memory divergence becomes
   unobservable, every op 503s), clients rotate to the new active via
   their endpoint list, and the deposed process exits. An acked write is
   therefore either in the WAL the successor replayed, or was never
@@ -61,7 +61,7 @@ LEASE_KIND = "Lease"
 
 class WalFenced(Exception):
     """A durable write was attempted after this process's term ended.
-    Deliberately NOT an ApiError: `FakeApiServer._persist` maps unknown
+    Deliberately NOT an ApiError: `FakeApiServer._persist_locked` maps unknown
     exceptions to fail-stop (every subsequent op raises Unavailable),
     which is exactly the posture a deposed active must take."""
 

@@ -367,7 +367,7 @@ class FakeApiServer:
         self._snapshot_every = max(1, snapshot_every)
         self._appends_since_snapshot = 0
         # Set on the first WAL/snapshot IO failure; every public op then
-        # raises Unavailable (see _fail_stop).
+        # raises Unavailable (see _fail_stop_locked).
         self._broken: BaseException | None = None
         if persist_dir is not None:
             from kubeflow_tpu.testing import persist
@@ -380,7 +380,12 @@ class FakeApiServer:
                 # deposed active fail-stops instead of acking writes its
                 # successor will never replay.
                 self._wal = wal_wrap(self._wal)
-            self._restore()
+            # Construction runs before any thread shares this server,
+            # but _restore can checkpoint a torn tail and
+            # _checkpoint_locked's contract is caller-holds-lock — hold
+            # it for real (RLock, uncontended) instead of by argument.
+            with self._lock:
+                self._restore_locked()
 
     # -- storage (copy-on-write commit point) -----------------------------
 
@@ -405,8 +410,9 @@ class FakeApiServer:
 
     # -- persistence ------------------------------------------------------
 
-    def _restore(self) -> None:
-        """Load snapshot + replay WAL (construction time, no lock needed).
+    def _restore_locked(self) -> None:
+        """Load snapshot + replay WAL (construction time; the caller
+        holds the lock for _checkpoint_locked's torn-tail repair).
         Replay stops at the first undecodable line — a torn tail from a
         crash mid-append loses only the un-acked record. Records at or
         below the snapshot's rv are skipped (a crash between snapshot
@@ -459,7 +465,7 @@ class FakeApiServer:
         # the (empty) in-memory journal: 410 Gone → they relist.
         self._floor = self._rv
 
-    def _fail_stop(self, cause: BaseException) -> None:
+    def _fail_stop_locked(self, cause: BaseException) -> None:
         """Durable-write failure (disk full, IO error): the in-memory
         mutation that triggered it has NOT reached the journal or any
         watcher yet, but it is in self._objects — so rather than audit a
@@ -496,7 +502,7 @@ class FakeApiServer:
 
         check_lease_guard(lookup, guard, kind)
 
-    def _persist(self, event: str, obj: Resource) -> None:
+    def _persist_locked(self, event: str, obj: Resource) -> None:
         """WAL-append one committed write (caller holds the lock). Runs
         BEFORE the in-memory journal append / watch delivery: an event a
         watcher saw must never be missing after a crash."""
@@ -519,7 +525,7 @@ class FakeApiServer:
         except ApiError:
             raise
         except Exception as e:
-            self._fail_stop(e)
+            self._fail_stop_locked(e)
 
     def _checkpoint_locked(self) -> None:
         import json as _json
@@ -541,7 +547,7 @@ class FakeApiServer:
                 )
             )
         except Exception as e:
-            self._fail_stop(e)
+            self._fail_stop_locked(e)
         self._appends_since_snapshot = 0
 
     def checkpoint(self) -> None:
@@ -799,7 +805,7 @@ class FakeApiServer:
         # watcher can observe the event, so an acked write survives a
         # crash that follows it.
         if self._wal is not None:
-            self._persist(event, obj)
+            self._persist_locked(event, obj)
         # Journal under the lock (all callers hold it) so journal order is
         # resourceVersion order — a watcher resuming from rv N can never
         # miss an event that commits with rv > N after N was served.
@@ -1066,7 +1072,7 @@ class FakeApiServer:
                     self._store_obj(stored)
                     self._emit("MODIFIED", stored)
                 return
-            self._remove(key)
+            self._remove_locked(key)
 
     def _maybe_finalize(self, stored: Resource) -> bool:
         """Remove an object whose deletion was pending and whose last
@@ -1080,11 +1086,11 @@ class FakeApiServer:
             and not stored.metadata.finalizers
         ):
             self._emit("DELETED", stored)
-            self._remove(stored.key, emit_delete=False)
+            self._remove_locked(stored.key, emit_delete=False)
             return True
         return False
 
-    def _remove(self, key: tuple, *, emit_delete: bool = True) -> None:
+    def _remove_locked(self, key: tuple, *, emit_delete: bool = True) -> None:
         obj = self._unstore(key)
         if emit_delete:
             # Deletion is a state transition of its own: give the DELETED

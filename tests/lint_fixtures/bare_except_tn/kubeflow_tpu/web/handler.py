@@ -1,0 +1,16 @@
+"""True negative: narrow catches, and cleanup-then-reraise."""
+
+
+def serve_once(handler):
+    try:
+        return handler()
+    except Exception:
+        return None
+
+
+def drain(conn, queue):
+    try:
+        queue.flush()
+    except BaseException:
+        conn.close()  # cleanup-then-reraise does not swallow
+        raise

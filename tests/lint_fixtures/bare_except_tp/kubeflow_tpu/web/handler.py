@@ -1,0 +1,15 @@
+"""True positive: interrupt-swallowing except handlers."""
+
+
+def serve_once(handler):
+    try:
+        return handler()
+    except:  # noqa: E722  finding: bare except
+        return None
+
+
+def drain(queue):
+    try:
+        queue.flush()
+    except BaseException as e:  # finding: swallowed BaseException
+        return e

@@ -1,0 +1,21 @@
+"""Missing-hot-path fixture: a renamed hot function must not silently
+drop its guard (one finding per missing name)."""
+
+
+def select_journal_events(journal, floor):
+    return [e for e in journal if e.rv > floor]
+
+
+class FakeApiServer:
+    def _emit(self, event, obj):
+        self._journal.append((event, obj))
+
+    def _dispatch(self):  # renamed from _dispatch_loop: finding
+        while True:
+            self._deliver(self._queue.get())
+
+    def get(self, kind, name, namespace="default"):
+        return self._objects[(kind, namespace, name)]
+
+    def list(self, kind, namespace=None):
+        return list(self._objects.values())

@@ -1,0 +1,26 @@
+"""True negative: hot paths share frozen snapshots."""
+
+import copy
+
+
+def select_journal_events(journal, floor):
+    return [e for e in journal if e.rv > floor]
+
+
+class FakeApiServer:
+    def _emit(self, event, obj):
+        assert obj.frozen
+        self._journal.append((event, obj))  # shared, zero copies
+
+    def _dispatch_loop(self):
+        while True:
+            self._deliver(self._queue.get())
+
+    def get(self, kind, name, namespace="default"):
+        return self._objects[(kind, namespace, name)]
+
+    def list(self, kind, namespace=None):
+        return list(self._objects.values())
+
+    def _apply(self, obj):
+        self._objects[obj.key] = copy.deepcopy(obj)  # commit point: fine

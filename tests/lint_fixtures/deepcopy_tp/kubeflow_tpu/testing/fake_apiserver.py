@@ -1,0 +1,27 @@
+"""True positive: deepcopy back in the fan-out/read hot paths."""
+
+import copy
+
+
+def select_journal_events(journal, floor):
+    return [e for e in journal if e.rv > floor]
+
+
+class FakeApiServer:
+    def _emit(self, event, obj):
+        snapshot = copy.deepcopy(obj)  # finding: O(watchers x events)
+        self._journal.append((event, snapshot))
+
+    def _dispatch_loop(self):
+        while True:
+            self._deliver(self._queue.get())
+
+    def get(self, kind, name, namespace="default"):
+        return self._objects[(kind, namespace, name)].deepcopy()  # finding
+
+    def list(self, kind, namespace=None):
+        return list(self._objects.values())
+
+    def _apply(self, obj):
+        # Not a hot path: commit-side copies are the ONE copy per write.
+        self._objects[obj.key] = copy.deepcopy(obj)

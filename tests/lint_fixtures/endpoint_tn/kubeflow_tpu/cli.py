@@ -1,0 +1,23 @@
+"""True negative: config strings parsed with endpoints_from_env."""
+
+import os
+
+from kubeflow_tpu.testing.apiserver_http import (
+    HttpApiClient,
+    endpoints_from_env,
+)
+
+
+def from_args(args):
+    return HttpApiClient(endpoints_from_env(args.server))
+
+
+def from_env():
+    return HttpApiClient(
+        endpoints_from_env(os.environ["KFTPU_APISERVER"])
+    )
+
+
+def hardcoded_test_only():
+    # A literal (non-config) endpoint is out of the rule's scope.
+    return HttpApiClient("http://127.0.0.1:8443")

@@ -1,0 +1,27 @@
+"""True positive: HttpApiClient built from bare config strings."""
+
+import os
+
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+
+
+def from_args(args):
+    return HttpApiClient(args.server)  # finding: "url1,url2" = one bad URL
+
+
+def from_env():
+    return HttpApiClient(os.environ["KFTPU_APISERVER"])  # finding
+
+
+def from_var(args):
+    server = args.apiserver
+    return HttpApiClient(server)  # finding: one hop through a local
+
+
+def from_fstring(args):
+    return HttpApiClient(f"https://{args.server}")  # finding: still config
+
+
+def from_concat():
+    url = "https://" + os.environ["KFTPU_APISERVER"]
+    return HttpApiClient(url)  # finding: concat doesn't launder config

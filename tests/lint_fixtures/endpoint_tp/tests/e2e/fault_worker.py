@@ -1,0 +1,10 @@
+"""True positive (e2e worker scope): env endpoint passed bare."""
+
+import os
+
+from kubeflow_tpu.testing.apiserver_http import HttpApiClient
+
+
+def main():
+    api = HttpApiClient(os.environ["KFTPU_APISERVER"])  # finding
+    return api

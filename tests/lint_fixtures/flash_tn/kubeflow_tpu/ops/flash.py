@@ -1,0 +1,30 @@
+"""True negative: blockwise flash with pinned fused-kernel streams.
+
+Doubles as the fused-kernel-streams true negative: the kernel below
+carries exactly the contract's ref streams.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _lse_is_packed(shape):
+    return True
+
+
+def _pack_rows(x):
+    return x
+
+
+def _dqkv_kernel_fused(
+    rows_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+    delta_ref, dq_ref, dk_ref, dv_ref,
+):
+    dq_ref[...] = jnp.zeros_like(q_ref)
+
+
+def _fwd(q, bh, sq, d):
+    # O(S*d) output tile and an O(S) lse tile: the legitimate shapes.
+    out = jax.ShapeDtypeStruct((bh, sq, d), jnp.float32)
+    lse = jax.ShapeDtypeStruct((bh, sq), jnp.float32)
+    return out, lse

@@ -1,0 +1,27 @@
+"""True positive: host syncs inside jit-traced step functions."""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_step(state, batch):
+    loss = (batch["x"] ** 2).mean()
+    print("loss", loss)  # finding: print on a tracer
+    return state, float(loss)  # finding: float() on a tracer
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def partial_jitted_step(x, flag=True):
+    return np.asarray(x)  # finding: np.asarray inside jit
+
+
+def make_step():
+    def train_step(state, batch):
+        metrics = {"loss": batch.sum()}
+        host = jax.device_get(metrics)  # finding: device_get inside jit
+        return state, host["loss"].item()  # finding: .item() inside jit
+
+    return jax.jit(train_step, donate_argnums=0)

@@ -1,0 +1,10 @@
+"""True negative: train/ catches only real exceptions."""
+
+
+def fit_step(step):
+    try:
+        return step()
+    except ValueError:
+        return None
+    except Exception as e:
+        raise RuntimeError("step failed") from e

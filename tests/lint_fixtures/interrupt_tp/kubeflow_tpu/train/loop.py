@@ -1,0 +1,10 @@
+"""True positive: train/ intercepting interrupts (even re-raised)."""
+
+
+def fit_step(step):
+    try:
+        return step()
+    except KeyboardInterrupt:  # finding: interrupts bypass fit()'s handler
+        raise
+    except SystemExit:  # finding
+        return None

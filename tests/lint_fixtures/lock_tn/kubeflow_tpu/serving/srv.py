@@ -1,0 +1,29 @@
+"""True negative: lock discipline held (or helpers named *_locked)."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._count = 0
+
+    def add(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+            self._count += 1
+
+    def evict(self, key):
+        with self._lock:
+            self._evict_locked(key)
+
+    def _evict_locked(self, key):
+        # Caller holds the lock — the *_locked suffix documents it.
+        self._entries.pop(key, None)
+        self._count -= 1
+
+    def snapshot(self):
+        # A lock-free READ of a guarded reference is the documented
+        # GIL-atomic idiom, not a finding.
+        return dict(self._entries)

@@ -1,0 +1,25 @@
+"""True positive: lock-guarded state written lock-free."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._count = 0  # __init__ writes are exempt (pre-threading)
+
+    def add(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+            self._count += 1
+
+    def evict(self, key):
+        # finding x2: both writes race add() without the lock
+        self._entries.pop(key, None)
+        self._count -= 1
+
+    def reset(self):
+        # finding x2: tuple unpacking is still a lock-free write to
+        # both guarded attrs
+        self._entries, self._count = {}, 0

@@ -1,0 +1,9 @@
+"""True negative: scalar loss is the only cross-pp all-reduce."""
+
+from jax import lax
+
+
+def pipeline_step(state, local_loss, axis):
+    moved = lax.ppermute(state, axis, [(0, 1), (1, 0)])
+    loss = lax.psum(local_loss, axis)
+    return moved, loss

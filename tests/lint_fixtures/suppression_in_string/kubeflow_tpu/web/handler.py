@@ -1,0 +1,9 @@
+"""Docstring fixture: the text '# kftpu-lint: disable=no-bare-except'
+inside a string is documentation, not a suppression — it must neither
+silence findings nor trip unused-suppression."""
+
+SYNTAX_EXAMPLE = "use '# kftpu-lint: disable=no-bare-except' on the line"
+
+
+def describe():
+    return SYNTAX_EXAMPLE

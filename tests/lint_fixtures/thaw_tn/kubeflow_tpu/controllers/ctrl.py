@@ -1,0 +1,22 @@
+"""True negative: the canonical thaw idiom (and plain reads)."""
+
+
+def reconcile(api, name, ns):
+    job = api.get("TpuJob", name, ns).thaw()
+    job.status["phase"] = "Running"
+    api.update(job)
+
+
+def annotate(self, name, ns):
+    fresh = self.api.get("TpuJob", name, ns)
+    fresh = fresh.thaw()  # rebinding through thaw clears the tracking
+    fresh.metadata.labels.update({"a": "b"})
+    return fresh
+
+
+def read_only(api, name, ns):
+    job = api.get("TpuJob", name, ns)
+    phase = job.status.get("phase")  # reads are fine on the snapshot
+    settings = {}.get("x", {})  # dict.get is not a store read
+    settings["y"] = 1
+    return phase

@@ -1,0 +1,19 @@
+"""True positive: read-modify-write on store results without .thaw()."""
+
+
+def reconcile(api, name, ns):
+    job = api.get("TpuJob", name, ns)
+    job.status["phase"] = "Running"  # finding: subscript store, no thaw
+    api.update(job)
+
+
+def annotate(self, name, ns):
+    fresh = self.api.get("TpuJob", name, ns)
+    fresh.metadata.labels.update({"a": "b"})  # finding: mutator call
+    fresh.metadata.generation += 1  # finding: aug-assign into snapshot
+    return fresh
+
+
+def adopt_all(api, owner):
+    for pod in api.list("Pod", owner.ns):
+        pod.metadata.owner_references.append(owner.ref)  # finding

@@ -1,0 +1,8 @@
+"""Unused-suppression fixture: the disable comment silences nothing."""
+
+
+def serve_once(handler):
+    try:
+        return handler()
+    except Exception:  # kftpu-lint: disable=no-bare-except
+        return None

@@ -1,0 +1,73 @@
+"""Tier-1 gate: the full kftpu-lint AST engine runs clean on the repo.
+
+One test, the whole engine, every rule: any unsuppressed,
+un-baselined finding in `kubeflow_tpu/` (or the e2e workers, for the
+rules scoped there) fails CI with the exact file:line list. This is
+the same run as `python -m kubeflow_tpu.ci lint` — keep them in sync
+by construction (both call `lint_repo`).
+"""
+
+import subprocess
+import sys
+
+from kubeflow_tpu.ci.lint import lint_repo
+
+
+def test_repo_lint_clean():
+    result = lint_repo()
+    assert result.clean, "\n" + result.render()
+
+
+def test_repo_lint_output_is_byte_stable():
+    """Deflake guard: two full engine runs render identical bytes
+    (sorted findings, sorted file discovery, __pycache__/generated
+    skipped deterministically)."""
+    a, b = lint_repo(), lint_repo()
+    assert a.render() == b.render()
+    assert a.to_json() == b.to_json()
+
+
+def test_lint_cli_exits_zero_on_clean_repo():
+    """The acceptance-criteria invocation, exactly as CI runs it."""
+    result = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.ci", "lint"],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "0 finding(s)" in result.stdout
+
+
+def test_lint_cli_json_and_rule_flags():
+    import json
+
+    result = subprocess.run(
+        [
+            sys.executable, "-m", "kubeflow_tpu.ci", "lint", "--json",
+            "--rule", "no-bare-except",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    doc = json.loads(result.stdout)
+    assert doc["findings"] == []
+
+
+def test_lint_cli_list_rules_names_the_catalog():
+    result = subprocess.run(
+        [
+            sys.executable, "-m", "kubeflow_tpu.ci", "lint",
+            "--list-rules",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+    for rule in (
+        "host-sync-in-jit", "thaw-before-mutate", "lock-discipline",
+        "no-bare-except", "no-interrupt-swallow",
+        "no-deepcopy-hot-path", "endpoint-list-clients",
+        "scalar-psum-only", "flash-blockwise", "fused-kernel-streams",
+    ):
+        assert rule in result.stdout, result.stdout

@@ -308,7 +308,7 @@ def test_writer_racing_a_fail_stop_cannot_commit_unlogged(tmp_path, backend):
     from kubeflow_tpu.testing.fake_apiserver import Unavailable
 
     api = _server(tmp_path, backend)
-    api._broken = RuntimeError("disk full")  # as _fail_stop leaves it
+    api._broken = RuntimeError("disk full")  # as _fail_stop_locked leaves it
     api._wal.close()
     api._wal = None
     with api._lock:

@@ -482,53 +482,17 @@ def test_pipelined_loss_and_grads_match_flat(v):
 
 @pytest.mark.parametrize("v", [1, 2])
 def test_pipeline_loss_scalar_only_cross_pp_collectives(v):
-    """Collective-accounting regression (the wire contract): the
+    """Collective-accounting regression (the wire contract), now a
+    thin wrapper over the `pipeline-wire-v{1,2}` rows of the kftpu-lint
+    program-contract table (ISSUE 8, `ci/lint/contracts.py`): the
     compiled fwd+bwd of the pipelined loss path contains NO all-reduce
-    of activation-sized buffers across pp — only scalars and
-    replicated-weight gradients — and the schedule really moves
-    activations by collective-permute. Shapes are chosen so even ONE
-    microbatch's activations ([mb, S, d_model]) outweigh the largest
-    weight buffer, making the threshold strict."""
-    import flax.linen as nn
+    at or above one microbatch's activations ([mb, S, d_model] — the
+    shapes make even that outweigh the largest weight buffer), moves
+    activations by collective-permute, and loops exactly the published
+    schedule's tick count."""
+    from kubeflow_tpu.ci.lint.contracts import run_contract
 
-    from kubeflow_tpu.models.transformer import PipelinedTransformerLM
-    from kubeflow_tpu.testing.hlo import (
-        allreduce_element_counts,
-        collective_counts,
-        compiled_hlo,
-        scan_lengths,
-    )
-
-    cfg = _tiny_lm_cfg(d_ff=16)
-    mesh = build_mesh(MeshSpec(dp=1, pp=2), jax.devices()[:2])
-    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 64), 0, 64)
-    labels = jax.random.randint(jax.random.PRNGKey(9), (8, 64), 0, 64)
-    pipe = PipelinedTransformerLM(
-        cfg, n_stages=2 * v, num_microbatches=4, mesh=mesh, interleave=v
-    )
-    params = nn.meta.unbox(
-        jax.jit(pipe.init)(jax.random.PRNGKey(1), tokens)
-    )["params"]
-
-    def loss_grad(p):
-        return jax.value_and_grad(
-            lambda q: pipe.apply({"params": q}, tokens, labels=labels)
-        )(p)
-
-    mb_act = (8 // 4) * 64 * cfg.d_model  # one microbatch's activations
-    hlo = compiled_hlo(jax.jit(loss_grad), params)
-    counts = collective_counts(hlo)
-    assert counts["collective-permute"] > 0, counts
-    sizes = allreduce_element_counts(hlo)
-    big = [s for s in sizes if s >= mb_act]
-    assert not big, (
-        f"activation-sized all-reduce(s) across pp: {big} elements "
-        f"(microbatch activation = {mb_act}) — the scalar-only "
-        f"contract regressed; all sizes: {sorted(set(sizes))}"
-    )
-    # The loop in the traced program is exactly the schedule's.
-    sched = pipeline_schedule(2 * v, 4, v)
-    assert sched["loop_ticks"] in scan_lengths(loss_grad, params)
+    run_contract(f"pipeline-wire-v{v}")
 
 
 def test_grad_accumulation_matches_full_batch():
