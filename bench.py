@@ -719,17 +719,23 @@ def bench_chaos(args) -> None:
 
 
 def bench_resilience(args) -> None:
-    """Nightly kill-and-resume training soak (the elastic-training
-    headline): run the slow-tier seeded soak (`tests/e2e/
-    test_train_resilience_e2e.py::test_resilience_soak_nightly`) —
-    subprocess `fit()` incarnations driven through kills, SIGTERMs,
-    checkpoint/manifest corruption and loss spikes — and report the
-    resilience economics: goodput (useful steps / executed steps),
-    steps lost per kill, and recovery time, vs BASELINE.json's
-    published floors. Same repro contract as the chaos soak: the seed
-    is chosen HERE, printed up front AND on failure, and
-    `--chaos-seed <seed>` (or KFTPU_RESILIENCE_SEED=<seed>) replays the
-    byte-identical fault schedule."""
+    """Nightly kill-and-resume training soaks (the elastic-training
+    headline), BOTH resilience contracts:
+
+    - restart-shaped (`test_resilience_soak_nightly`): subprocess
+      `fit()` incarnations driven through kills, SIGTERMs,
+      checkpoint/manifest corruption and loss spikes — goodput ~0.67,
+      ~10 steps lost per kill;
+    - elastic resize (`test_resilience_soak_elastic_nightly`, ISSUE 9):
+      ONE incarnation absorbing real SIGTERMs by reshaping the mesh
+      (shrink->grow cycles) — published as the `resilience_*_elastic`
+      rows, goodput ~1.0 and steps-lost-per-kill ~0 vs BASELINE.json's
+      floors.
+
+    Same repro contract as the chaos soak: the seed is chosen HERE,
+    printed up front AND on failure, and `--chaos-seed <seed>` (or
+    KFTPU_RESILIENCE_SEED=<seed>) replays the byte-identical fault
+    schedules for both."""
     import os
     import random
     import subprocess
@@ -746,46 +752,50 @@ def bench_resilience(args) -> None:
     else:
         seed = random.randrange(2**31)
     print(f"# resilience soak seed={seed}", file=sys.stderr)
-    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
-        metrics_path = f.name
-    try:
-        t0 = time.perf_counter()
-        proc = subprocess.run(
-            [
-                sys.executable, "-m", "pytest",
-                "tests/e2e/test_train_resilience_e2e.py::"
-                "test_resilience_soak_nightly",
-                "-q", "-rs", "-p", "no:cacheprovider", "-p", "no:randomly",
-            ],
-            cwd=repo,
-            env={
-                **os.environ,
-                "JAX_PLATFORMS": "cpu",
-                "KFTPU_RESILIENCE_SEED": str(seed),
-                "KFTPU_RESILIENCE_METRICS": metrics_path,
-            },
-            capture_output=True,
-            text=True,
-        )
-        elapsed = time.perf_counter() - t0
-        sys.stderr.write(proc.stdout)
-        sys.stderr.write(proc.stderr)
-        if proc.returncode != 0:
-            print(
-                f"# resilience soak FAILED (seed {seed}) — reproduce the "
-                f"exact fault schedule with:\n"
-                f"#   KFTPU_RESILIENCE_SEED={seed} python bench.py "
-                f"--workload resilience --chaos-seed {seed}",
-                file=sys.stderr,
-            )
-            raise SystemExit(proc.returncode)
-        with open(metrics_path) as f:
-            m = json.load(f)
-    finally:
+
+    def run_soak(test_name: str) -> tuple[dict, float]:
+        with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+            metrics_path = f.name
         try:
-            os.unlink(metrics_path)
-        except OSError:
-            pass
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "pytest",
+                    f"tests/e2e/test_train_resilience_e2e.py::{test_name}",
+                    "-q", "-rs", "-p", "no:cacheprovider",
+                    "-p", "no:randomly",
+                ],
+                cwd=repo,
+                env={
+                    **os.environ,
+                    "JAX_PLATFORMS": "cpu",
+                    "KFTPU_RESILIENCE_SEED": str(seed),
+                    "KFTPU_RESILIENCE_METRICS": metrics_path,
+                },
+                capture_output=True,
+                text=True,
+            )
+            elapsed = time.perf_counter() - t0
+            sys.stderr.write(proc.stdout)
+            sys.stderr.write(proc.stderr)
+            if proc.returncode != 0:
+                print(
+                    f"# {test_name} FAILED (seed {seed}) — reproduce the "
+                    f"exact fault schedule with:\n"
+                    f"#   KFTPU_RESILIENCE_SEED={seed} python bench.py "
+                    f"--workload resilience --chaos-seed {seed}",
+                    file=sys.stderr,
+                )
+                raise SystemExit(proc.returncode)
+            with open(metrics_path) as f:
+                return json.load(f), elapsed
+        finally:
+            try:
+                os.unlink(metrics_path)
+            except OSError:
+                pass
+
+    m, elapsed = run_soak("test_resilience_soak_nightly")
     rows = (
         (
             "resilience_goodput",
@@ -823,6 +833,45 @@ def bench_resilience(args) -> None:
     print(
         f"# resilience soak converged in {elapsed:.1f}s (seed {seed}, "
         f"coverage={m['coverage']})",
+        file=sys.stderr,
+    )
+
+    # -- the elastic contract (ISSUE 9): preemption absorbed, not fatal
+    me, elapsed_e = run_soak("test_resilience_soak_elastic_nightly")
+    elastic_rows = (
+        (
+            "resilience_goodput_elastic",
+            round(me["goodput"], 4),
+            f"useful/executed steps, {me['kills']} preemptions absorbed "
+            f"by {me['resizes']} mesh resizes in ONE incarnation "
+            "(higher is better)",
+            _published_baseline("resilience_goodput_elastic"),
+        ),
+        (
+            "resilience_steps_lost_per_kill_elastic",
+            round(me["steps_lost_per_kill"], 2),
+            "steps recomputed per absorbed preemption (lower is better; "
+            "~10 under the restart-shaped contract)",
+            _published_baseline("resilience_steps_lost_per_kill_elastic"),
+        ),
+    )
+    for metric, value, unit, base in elastic_rows:
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": unit,
+                    "vs_baseline": (
+                        round(value / base, 4) if base else None
+                    ),
+                }
+            )
+        )
+    print(
+        f"# elastic resize soak converged in {elapsed_e:.1f}s "
+        f"(seed {seed}, coverage={me['coverage']}, "
+        f"mean resize {me['resize_seconds']:.3f}s)",
         file=sys.stderr,
     )
 

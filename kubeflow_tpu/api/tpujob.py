@@ -49,6 +49,14 @@ class TpuJobSpec:
     # strictly lower priority in its pool (whole gangs — all-or-nothing
     # both ways). 0 = default; negative = preemptible batch tier.
     priority: int = 0
+    # Elastic gang floor (ISSUE 9, docs/resilience.md): >= 1 declares
+    # the gang ELASTIC — its workload can reshape its data-parallel
+    # mesh at a step boundary, so instead of evicting the whole gang
+    # the scheduler may OFFER it a shrink-to-fit target no smaller than
+    # this floor (status.resize proposal; the gang worker acks by
+    # resizing, and an acked resize counts as ZERO evictions). 0 (the
+    # default) keeps today's rigid all-or-nothing semantics.
+    elastic_min_replicas: int = 0
 
     def validate(self) -> None:
         if self.replicas < 1:
@@ -62,6 +70,12 @@ class TpuJobSpec:
             )
         if self.max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
+        if not 0 <= self.elastic_min_replicas <= self.replicas:
+            raise ValueError(
+                f"elastic_min_replicas ({self.elastic_min_replicas}) "
+                f"must be between 0 (rigid gang) and replicas "
+                f"({self.replicas})"
+            )
         from kubeflow_tpu.api.objects import parse_quantity
 
         for resource, value in self.resources:
@@ -90,6 +104,7 @@ class TpuJobSpec:
             "maxRestarts": self.max_restarts,
             "checkpointDir": self.checkpoint_dir,
             "priority": self.priority,
+            "elasticMinReplicas": self.elastic_min_replicas,
             "resources": {k: v for k, v in self.resources},
         }
 
@@ -131,6 +146,7 @@ class TpuJobSpec:
             max_restarts=d.get("maxRestarts", 3),
             checkpoint_dir=d.get("checkpointDir", ""),
             priority=int(d.get("priority", 0)),
+            elastic_min_replicas=int(d.get("elasticMinReplicas", 0)),
             resources=tuple(
                 sorted((d.get("resources") or {}).items())
             ),

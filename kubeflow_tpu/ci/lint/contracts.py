@@ -269,6 +269,68 @@ def _build_fused_flash_grad() -> Program:
     )
 
 
+def _build_elastic_resize_step() -> Program:
+    """The train step traced on a SHRUNK mesh after an elastic resize
+    (ISSUE 9): the steady-state step must be indistinguishable from a
+    fresh dp train step — gradient-sized all-reduce only. The resize
+    transition's resharding traffic (device_put across device sets)
+    happens ONCE at the boundary and must not leak a collective
+    (all-gather / collective-permute / all-to-all) into the compiled
+    per-step program, or every post-resize step pays for the one-time
+    move."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_tpu.testing.hlo import compiled_hlo
+    from kubeflow_tpu.testing.tinymodels import TinyMLP
+    from kubeflow_tpu.train import TrainConfig, Trainer
+
+    _require_devices(4)
+    mesh4 = build_mesh(MeshSpec(dp=4), jax.devices()[:4])
+    trainer4 = Trainer(
+        TinyMLP(),
+        TrainConfig(
+            batch_size=8, total_steps=2, warmup_steps=1,
+            optimizer="sgd", fsdp_params=False,
+        ),
+        mesh4,
+        example_input_shape=(8, 8, 8, 1),
+    )
+    state4 = trainer4.init_state(jax.random.PRNGKey(0))
+    # The elastic transition under test: resize 4 -> 2, live reshard.
+    mesh2 = build_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    trainer2 = trainer4.resize(mesh2)
+    state2 = trainer2.reshard_state(state4)
+    step = trainer2.make_train_step()
+    batch = {
+        "image": jax.device_put(
+            jnp.zeros((8, 8, 8, 1), jnp.float32),
+            trainer2.batch_sharding(4),
+        ),
+        "label": jax.device_put(
+            jnp.zeros((8,), jnp.int32), trainer2.batch_sharding(1)
+        ),
+    }
+    cap = 1 + max(
+        leaf.size for leaf in jax.tree_util.tree_leaves(state2.params)
+    )
+    shrunk_devices = set(mesh2.devices.reshape(-1))
+    return Program(
+        hlo=compiled_hlo(step, state2, batch),
+        meta={
+            "param_cap": cap,
+            # The resharded state actually LIVES on the shrunk mesh —
+            # a reshard that silently kept old-mesh residency would
+            # make every step a cross-mesh fetch.
+            "state_on_shrunk_mesh": all(
+                set(leaf.sharding.device_set) <= shrunk_devices
+                for leaf in jax.tree_util.tree_leaves(state2)
+            ),
+        },
+    )
+
+
 def _build_serving_batch() -> Program:
     """One servable bucket execution: a single-device program — no
     collective of any family may appear (a sharded-serving refactor
@@ -335,6 +397,19 @@ CONTRACTS: tuple[ProgramContract, ...] = (
             "byte_model_ok", "streams_pinned",
         ),
         meta_equal=(("fwd_count_ckpt", "fwd_count_plain"),),
+    ),
+    ProgramContract(
+        name="elastic-resize",
+        description="post-resize step on the shrunk mesh: grad-sized "
+        "all-reduce only, no resharding collective in steady state",
+        build=_build_elastic_resize_step,
+        expect_collectives=("all-reduce",),
+        forbid_collectives=(
+            "all-gather", "reduce-scatter", "all-to-all",
+            "collective-permute",
+        ),
+        allreduce_cap="param_cap",
+        meta_true=("state_on_shrunk_mesh",),
     ),
     ProgramContract(
         name="serving-batch",

@@ -12,7 +12,20 @@ reference never had (§7.3 hard parts):
   ICI mesh), bounded by spec.maxRestarts, counted in status.restarts;
 - **topology-aware placement**: pods carry `google.com/tpu` resource asks
   plus node selectors for accelerator type/topology, and the per-worker
-  TPU_WORKER_ID/TPU_WORKER_HOSTNAMES env so libtpu assembles the slice.
+  TPU_WORKER_ID/TPU_WORKER_HOSTNAMES env so libtpu assembles the slice;
+- **elastic gang resize** (ISSUE 9, docs/resilience.md): a gang whose
+  spec declares `elasticMinReplicas >= 1` can reshape its data-parallel
+  mesh at a step boundary (`train/loop.ElasticResize`), so before the
+  preemption path grows a victim set for full eviction it OFFERS the
+  best victim a shrink-to-fit target via `status.resize`; the gang
+  worker acks (`status.resizeAck`, see `ack_resize`) by resizing
+  instead of dying, the controller trims the released pods, and the
+  preemption accounting records ZERO evictions — phase, restart budget
+  and gang incarnation untouched. When capacity returns, the same
+  proposal/ack handshake grows the gang back to spec.replicas. A gang
+  that never acks within the grace window falls back to the rigid
+  eviction path. `status.elasticReplicas` carries the gang's effective
+  size while it differs from spec.replicas.
 
 Job phases: Pending → Running → Succeeded | Failed (with Restarting
 transitions in between).
@@ -20,6 +33,7 @@ transitions in between).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 
@@ -81,6 +95,44 @@ def coordinator_address(job: Resource) -> str:
     return f"{worker_name(job.metadata.name, 0)}.{job.metadata.name}.{ns}.svc:{COORDINATOR_PORT}"
 
 
+def effective_replicas(job: Resource, spec: TpuJobSpec) -> int:
+    """The gang's CURRENT size: spec.replicas unless an acked elastic
+    resize shrank it (status.elasticReplicas), clamped to sane bounds."""
+    eff = int(job.status.get("elasticReplicas") or spec.replicas)
+    return max(1, min(eff, spec.replicas))
+
+
+def ack_resize(api: FakeApiServer, name: str, ns: str = "default") -> int | None:
+    """The gang worker's half of the resize handshake: accept the
+    pending `status.resize` proposal by writing `status.resizeAck`.
+    The worker calls this AFTER its training loop committed to the
+    resize at a step boundary (`ElasticResize.on_resize`); the
+    controller then trims/creates pods to the acked size. Returns the
+    acked worker count, or None when no proposal is pending — or when
+    the proposal is already PAST its deadline: a late ack would race
+    the preemptor's withdrawal (which may already have fallen back to
+    eviction), so the caller must treat an expired offer as never made
+    rather than commit a resize nobody is waiting for."""
+    acked: dict = {}
+
+    def write() -> None:
+        try:
+            fresh = api.get(KIND, name, ns).thaw()
+        except NotFound:
+            return
+        proposal = fresh.status.get("resize")
+        if not proposal:
+            return
+        if proposal.get("deadline", 0) <= time.time():
+            return  # expired: the withdrawal owns this offer now
+        fresh.status["resizeAck"] = {"replicas": int(proposal["replicas"])}
+        api.update_status(fresh)
+        acked["replicas"] = int(proposal["replicas"])
+
+    retry_on_conflict(write)
+    return acked.get("replicas")
+
+
 class TpuJobController:
     def __init__(
         self,
@@ -89,10 +141,18 @@ class TpuJobController:
         scheduler=None,
         quota_retry_seconds: float = 10.0,
         preempt_stall=None,
+        resize_grace_seconds: float = 5.0,
+        grow_retry_seconds: float = 5.0,
     ):
         self.api = api
         self._scheduler_factory = scheduler
         self._quota_retry_seconds = quota_retry_seconds
+        # Elastic resize (ISSUE 9): how long a gang gets to ack a
+        # shrink/grow proposal (it needs a step boundary) before the
+        # offer expires — shrink falls back to eviction, grow retries —
+        # and how often a shrunk gang re-probes for grow-back capacity.
+        self._resize_grace_seconds = resize_grace_seconds
+        self._grow_retry_seconds = grow_retry_seconds
         # Chaos seam (tests/e2e/test_ha_preemption_e2e.py): called after
         # the victims are evicted, before the preemptor's requeue-and-
         # place — the widest-impact window for a leader to die in. The
@@ -114,6 +174,14 @@ class TpuJobController:
             "tpujob_gang_placements_total",
             "gang placements decided by the scheduler",
             ("backend",),
+        )
+        # Acked elastic resizes applied (direction: shrink | grow).
+        # The preemption-accounting contract: an acked resize counts
+        # here and NEVER in gang_restarts or as a Preempted victim.
+        self.elastic_resizes = metrics.counter(
+            "tpujob_elastic_resizes_total",
+            "acked elastic gang resizes applied",
+            ("job", "direction"),
         )
         self.controller = Controller(
             api,
@@ -142,15 +210,20 @@ class TpuJobController:
         return svc
 
     def _desired_pod(
-        self, job: Resource, spec: TpuJobSpec, idx: int, incarnation: int
+        self, job: Resource, spec: TpuJobSpec, idx: int, incarnation: int,
+        replicas: int | None = None,
     ) -> Resource:
+        # `replicas` is the gang size the pod's coordination env should
+        # reflect — the EFFECTIVE size for elastic gangs, spec.replicas
+        # otherwise.
+        replicas = spec.replicas if replicas is None else replicas
         name = worker_name(job.metadata.name, idx)
-        procs_per_slice = spec.replicas // spec.num_slices
+        procs_per_slice = max(1, replicas // spec.num_slices)
         env = dict(spec.env)
         env.update(
             dist.ProcessEnv(
                 coordinator=coordinator_address(job),
-                num_processes=spec.replicas,
+                num_processes=replicas,
                 process_id=idx,
                 num_slices=spec.num_slices,
                 slice_id=idx // procs_per_slice,
@@ -226,13 +299,16 @@ class TpuJobController:
         api: FakeApiServer,
         placing_job: str,
         exclude: frozenset[str] = frozenset(),
+        exclude_pods: frozenset[str] = frozenset(),
     ):
         """Construct a fresh native scheduler from OBSERVED state — current
         Nodes plus reservations implied by live pods' nodeName — for one
         placement decision. No long-lived mirror: deleted/recreated nodes,
         spec edits, and operator restarts can't desynchronize what doesn't
         persist. `exclude` drops additional gangs' reservations (preemption
-        what-if planning). Returns None when the cluster model has no
+        what-if planning); `exclude_pods` drops INDIVIDUAL pods'
+        (``ns/pod-name``) — elastic shrink what-ifs, where only a gang's
+        released tail frees up. Returns None when the cluster model has no
         Nodes."""
         nodes = api.list("Node")
         if not nodes:
@@ -288,6 +364,8 @@ class TpuJobController:
             gang = f"{pod.metadata.namespace}/{owner}"
             if gang == placing_job or gang in exclude:
                 continue  # replaced (own stale pods) or hypothetically evicted
+            if f"{pod.metadata.namespace}/{pod.metadata.name}" in exclude_pods:
+                continue  # hypothetically released by an elastic shrink
             sched.reserve(
                 gang, node, container_limits_total(pod, "google.com/tpu")
             )
@@ -412,13 +490,42 @@ class TpuJobController:
                 c[0], -(c[1].metadata.creation_timestamp or 0)
             )
         )
+        gang_id = f"{job.metadata.namespace}/{job.metadata.name}"
+
+        # -- elastic shrink offers (ISSUE 9) ---------------------------
+        # BEFORE any eviction: a victim gang that declared itself
+        # elastic (spec.elasticMinReplicas >= 1) may be able to SHRINK
+        # to fit this preemptor — the scheduler and the trainer
+        # negotiate instead of one killing the other. A pending offer
+        # for this preemptor holds the eviction path back until it is
+        # acked (the gang needs a step boundary) or expires; an acked
+        # offer is applied by the victim's own reconcile and the
+        # preemption accounting records ZERO evictions.
+        now = time.time()
+        for _, other, gang in candidates:
+            pending = other.status.get("resize") or {}
+            if pending.get("forJob") != gang_id:
+                continue
+            if other.status.get("resizeAck") is not None:
+                return True  # acked: the victim's reconcile trims pods
+            if pending.get("deadline", 0) > now:
+                return True  # offered: give the gang its grace window
+            # Expired without an ack: withdraw the offer and fall
+            # through to the rigid eviction path below.
+            self._clear_resize(
+                api, other, refused=True,
+                event=("ResizeExpired",
+                       f"shrink offer for {gang_id} expired unacked; "
+                       "falling back to eviction"),
+            )
+        if self._offer_resize(api, job, spec, candidates, gang_id):
+            return True
 
         # Grow the victim set until the gang actually PLACES on a what-if
         # scheduler with those reservations removed — aggregate chip
         # counts aren't enough (freed chips fragmented across nodes can
         # leave the preemptor Unschedulable anyway, and evicting for that
         # would be pure disruption).
-        gang_id = f"{job.metadata.namespace}/{job.metadata.name}"
         victims: list = []
         excluded: set[str] = set()
         feasible = False
@@ -471,6 +578,14 @@ class TpuJobController:
                     break
                 fresh.status["phase"] = "Pending"
                 fresh.status["reason"] = "Preempted"
+                # An eviction moots any in-flight resize handshake
+                # (possibly with a DIFFERENT preemptor): a victim
+                # parked on a stale proposal would defer its own
+                # recreation, and a concurrent ack must not record a
+                # "zero-eviction" resize for a gang that was just
+                # evicted whole.
+                fresh.status.pop("resize", None)
+                fresh.status.pop("resizeAck", None)
                 try:
                     api.update_status(fresh)
                     break
@@ -487,6 +602,298 @@ class TpuJobController:
             # leader-death window.
             self._preempt_stall()
         return True
+
+    # -- elastic resize ---------------------------------------------------
+
+    def _offer_resize(
+        self, api, job, spec: TpuJobSpec, candidates, gang_id: str
+    ) -> bool:
+        """Offer ONE victim gang a shrink-to-fit target instead of
+        eviction. Victims are tried in eviction order (lowest priority,
+        youngest first); for each elastic one, the SMALLEST shrink that
+        lets the preemptor's what-if placement succeed wins — workers
+        are released from the top of the index range, never below the
+        gang's declared elastic floor. Returns True when an offer was
+        written (the caller requeues and waits for the ack)."""
+        from kubeflow_tpu.native import PlacementError
+
+        now = time.time()
+        for _, victim, gang in candidates:
+            try:
+                vspec = TpuJobSpec.from_dict(victim.spec)
+            except Exception:
+                continue
+            if vspec.elastic_min_replicas < 1:
+                continue  # rigid gang: eviction is all it understands
+            status = victim.status
+            if status.get("resize") or status.get("resizeAck"):
+                continue  # a handshake is already in flight
+            refused = status.get("resizeRefused", 0)
+            if refused and now < refused + 4 * self._resize_grace_seconds:
+                continue  # recently ignored an offer: don't spin on it
+            vns = victim.metadata.namespace
+            live = sorted(
+                (
+                    p for p in api.list(
+                        "Pod", vns,
+                        label_selector={LABEL_JOB: victim.metadata.name},
+                    )
+                    if p.status.get("phase") not in ("Succeeded", "Failed")
+                    and p.metadata.labels.get(LABEL_WORKER, "").isdigit()
+                ),
+                key=lambda p: int(p.metadata.labels[LABEL_WORKER]),
+            )
+            cur = len(live)
+            floor = min(vspec.elastic_min_replicas, cur)
+            # Targets must keep the gang's slice arithmetic valid:
+            # replicas % num_slices == 0 (a multi-slice gang sheds
+            # WHOLE slices — a ragged tail would emit out-of-range
+            # slice ids in the workers' coordination env).
+            aligned = [
+                t for t in range(cur - 1, floor - 1, -1)
+                if t % vspec.num_slices == 0 and t >= vspec.num_slices
+            ] if vspec.num_slices > 1 else list(
+                range(cur - 1, floor - 1, -1)
+            )
+            for target in aligned:
+                released = frozenset(
+                    f"{p.metadata.namespace}/{p.metadata.name}"
+                    for p in live[target:]
+                )
+                trial = self._build_scheduler(
+                    api, gang_id, exclude_pods=released
+                )
+                if trial is None:
+                    return False
+                try:
+                    self._place(trial, gang_id, spec, count=False)
+                except PlacementError:
+                    continue  # not enough — release one more worker
+                deadline = now + self._resize_grace_seconds
+
+                def write() -> None:
+                    fresh = api.get(
+                        KIND, victim.metadata.name, vns
+                    ).thaw()
+                    if fresh.status.get("resize") or fresh.status.get(
+                        "resizeAck"
+                    ):
+                        return  # someone else's offer landed first
+                    fresh.status["resize"] = {
+                        "replicas": target,
+                        "forJob": gang_id,
+                        "deadline": deadline,
+                    }
+                    fresh.status.pop("resizeRefused", None)
+                    fresh.status["conditions"] = list(
+                        fresh.status.get("conditions", [])
+                    ) + [{"type": "ResizeProposed"}]
+                    api.update_status(fresh)
+
+                try:
+                    retry_on_conflict(write)
+                except NotFound:
+                    break  # victim vanished; try the next candidate
+                api.record_event(
+                    victim,
+                    "ResizeProposed",
+                    f"shrink to {target} worker(s) offered by "
+                    f"higher-priority gang {gang_id} "
+                    f"(priority {spec.priority}) instead of eviction",
+                )
+                api.record_event(
+                    job,
+                    "ResizeRequested",
+                    f"offered {gang} a shrink to {target} worker(s) — "
+                    "zero evictions if acked",
+                )
+                return True
+        return False
+
+    def _clear_resize(
+        self, api, victim, *,
+        event: tuple[str, str] | None = None,
+        refused: bool = False,
+    ) -> None:
+        """Withdraw a pending resize proposal (expired or obsolete).
+        `refused=True` — ONLY for offers the gang actually ignored past
+        their deadline — additionally stamps `resizeRefused` so the
+        offer loop backs off from that gang for a few grace windows; a
+        withdrawal for any other reason (capacity vanished, stale ack)
+        must not penalize a gang that did nothing wrong."""
+
+        def write() -> None:
+            try:
+                fresh = api.get(
+                    KIND, victim.metadata.name, victim.metadata.namespace
+                ).thaw()
+            except NotFound:
+                return
+            if not fresh.status.get("resize") and not fresh.status.get(
+                "resizeAck"
+            ):
+                return
+            fresh.status.pop("resize", None)
+            fresh.status.pop("resizeAck", None)
+            if refused:
+                fresh.status["resizeRefused"] = time.time()
+            api.update_status(fresh)
+
+        retry_on_conflict(write)
+        if event is not None:
+            api.record_event(victim, event[0], event[1], type_="Warning")
+
+    def _apply_resize(
+        self, api, job, spec: TpuJobSpec, target: int, pods
+    ) -> Result:
+        """An ACKED resize: reshape the gang to `target` workers with
+        the gang intact — trim released pods (shrink) or place-and-
+        create the missing ones (grow). Never touches phase, restarts,
+        or the gang incarnation: an acked resize is zero evictions and
+        zero restarts, the whole point of negotiating."""
+        ns, name = job.metadata.namespace, job.metadata.name
+        by_index = {
+            int(p.metadata.labels[LABEL_WORKER]): p
+            for p in pods
+            if p.metadata.labels.get(LABEL_WORKER, "").isdigit()
+        }
+        cur = len(by_index)
+        direction = "shrink" if target < cur else "grow"
+        if target < cur:
+            for idx, p in sorted(by_index.items()):
+                if idx >= target:
+                    try:
+                        api.delete("Pod", p.metadata.name, ns)
+                    except NotFound:
+                        pass
+        elif target > cur:
+            missing = [i for i in range(target) if i not in by_index]
+            assignment = None
+            # The sentinel placing-job id keeps the gang's OWN live pods
+            # reserved in the what-if (they aren't moving); only the
+            # missing workers get placed.
+            sched = self._build_scheduler(api, f"{ns}/{name}/grow")
+            if sched is not None:
+                from kubeflow_tpu.native import PlacementError
+
+                grow_spec = dataclasses.replace(
+                    spec, replicas=len(missing)
+                )
+                try:
+                    assignment, _ = self._place(
+                        sched, f"{ns}/{name}/grow", grow_spec
+                    )
+                except PlacementError as e:
+                    # Capacity vanished between proposal and ack: drop
+                    # the handshake; the grow-back probe will retry.
+                    self._clear_resize(api, job)
+                    api.record_event(
+                        job, "ResizeAborted",
+                        f"grow-back to {target} no longer places: {e}",
+                        type_="Warning",
+                    )
+                    return Result(requeue_after=self._grow_retry_seconds)
+            incarnation = job.status.get("restarts", 0)
+            created = []
+            try:
+                for j, i in enumerate(missing):
+                    pod = self._desired_pod(
+                        job, spec, i, incarnation, replicas=target
+                    )
+                    if assignment is not None:
+                        pod.spec["nodeName"] = assignment[j]
+                    api.create(pod)
+                    created.append(pod)
+            except Invalid as e:
+                # Quota rejected the growth: unwind it — the gang stays
+                # whole at its current (shrunk) size.
+                for p in created:
+                    try:
+                        api.delete("Pod", p.metadata.name, ns)
+                    except NotFound:
+                        pass
+                self._clear_resize(api, job)
+                api.record_event(
+                    job, "ResizeAborted",
+                    f"grow-back to {target} rejected: {e}",
+                    type_="Warning",
+                )
+                return Result(requeue_after=self._grow_retry_seconds)
+
+        def write() -> None:
+            fresh = api.get(KIND, name, ns).thaw()
+            fresh.status.pop("resize", None)
+            fresh.status.pop("resizeAck", None)
+            fresh.status.pop("resizeRefused", None)
+            fresh.status["resizedAt"] = time.time()
+            if target == spec.replicas:
+                fresh.status.pop("elasticReplicas", None)
+            else:
+                fresh.status["elasticReplicas"] = target
+            fresh.status["conditions"] = list(
+                fresh.status.get("conditions", [])
+            ) + [{"type": "Resized"}]
+            api.update_status(fresh)
+
+        retry_on_conflict(write)
+        self.elastic_resizes.inc(job=f"{ns}/{name}", direction=direction)
+        api.record_event(
+            job,
+            "Resized",
+            f"elastic {direction}: {cur} -> {target} worker(s), gang "
+            "intact (zero evictions, restart budget untouched)",
+        )
+        return Result(requeue_after=0.05)
+
+    def _maybe_propose_grow(
+        self, api, job, spec: TpuJobSpec, eff: int
+    ) -> Result | None:
+        """A gang running SHRUNK re-probes for its released capacity:
+        when the missing workers place, offer the gang a grow-back to
+        spec.replicas (same proposal/ack handshake as the shrink — the
+        trainer must reshape its mesh before the pods appear)."""
+        from kubeflow_tpu.native import PlacementError
+
+        # A freshly shrunk gang holds back before probing: the chips it
+        # just released belong to the preemptor first (the
+        # PreemptedBackoff grace, resize-flavored) — an immediate probe
+        # would see them free and win a race against the gang it just
+        # yielded to.
+        since = time.time() - job.status.get("resizedAt", 0)
+        if since < self._grow_retry_seconds:
+            return Result(requeue_after=self._grow_retry_seconds - since)
+        ns, name = job.metadata.namespace, job.metadata.name
+        sched = self._build_scheduler(api, f"{ns}/{name}/grow")
+        if sched is None:
+            return None
+        probe = dataclasses.replace(spec, replicas=spec.replicas - eff)
+        try:
+            self._place(sched, f"{ns}/{name}/grow", probe, count=False)
+        except PlacementError:
+            return Result(requeue_after=self._grow_retry_seconds)
+        deadline = time.time() + self._resize_grace_seconds
+
+        def write() -> None:
+            fresh = api.get(KIND, name, ns).thaw()
+            if fresh.status.get("resize") or fresh.status.get("resizeAck"):
+                return
+            fresh.status["resize"] = {
+                "replicas": spec.replicas,
+                "forJob": "",  # capacity returned, not a preemptor
+                "deadline": deadline,
+            }
+            fresh.status["conditions"] = list(
+                fresh.status.get("conditions", [])
+            ) + [{"type": "ResizeProposed"}]
+            api.update_status(fresh)
+
+        retry_on_conflict(write)
+        api.record_event(
+            job,
+            "ResizeProposed",
+            f"capacity returned: grow back to {spec.replicas} worker(s)",
+        )
+        return Result(requeue_after=self._resize_grace_seconds)
 
     # -- reconcile --------------------------------------------------------
 
@@ -526,6 +933,47 @@ class TpuJobController:
 
         pods = api.list("Pod", ns, label_selector={LABEL_JOB: name})
         by_index = {p.metadata.labels.get(LABEL_WORKER): p for p in pods}
+        eff = effective_replicas(job, spec)
+
+        # -- elastic resize lifecycle (ISSUE 9) ----------------------
+        # A pending proposal suspends gang-shape enforcement (the gang
+        # is mid-handshake; trimming or tearing down now would race the
+        # trainer's step-boundary transition). An acked proposal is
+        # applied here — the gang reshapes without restarting.
+        proposal = job.status.get("resize")
+        if proposal:
+            target = int(proposal.get("replicas", 0))
+            ack = job.status.get("resizeAck")
+            if ack is not None:
+                if int(ack.get("replicas", -1)) == target and target >= 1:
+                    return self._apply_resize(api, job, spec, target, pods)
+                # A stale or mismatched ack: withdraw the handshake.
+                self._clear_resize(api, job)
+                return Result(requeue_after=0.05)
+            remaining = proposal.get("deadline", 0) - time.time()
+            if remaining > 0:
+                return Result(requeue_after=remaining)
+            if not proposal.get("forJob"):
+                # Grow offers expire here; shrink offers expire on the
+                # preemptor's path, which owns the eviction fallback.
+                self._clear_resize(api, job, refused=True)
+                return Result(requeue_after=self._grow_retry_seconds)
+            # An expired shrink offer is normally withdrawn by its
+            # preemptor's next pass — but that preemptor may be gone
+            # (deleted, or placed via other freed capacity and never
+            # preempting again). Give it one extra grace window, then
+            # self-heal: a stale proposal must not suspend gang-shape
+            # enforcement and grow-back forever.
+            if time.time() > proposal.get("deadline", 0) + \
+                    self._resize_grace_seconds:
+                self._clear_resize(
+                    api, job, refused=True,
+                    event=("ResizeExpired",
+                           "shrink offer expired and its preemptor "
+                           "never returned; withdrawing"),
+                )
+                return Result(requeue_after=0.05)
+            return Result(requeue_after=0.5)
 
         if not pods:
             reason = job.status.get("reason")
@@ -559,13 +1007,18 @@ class TpuJobController:
             # schedulable on any pool).
             assignment: list[str] | None = None
             gang_id = f"{ns}/{name}"
+            place_spec = (
+                dataclasses.replace(spec, replicas=eff)
+                if eff != spec.replicas
+                else spec
+            )
             sched = self._build_scheduler(api, gang_id)
             if sched is not None:
                 from kubeflow_tpu.native import PlacementError
 
                 try:
                     assignment, ring_cost = self._place(
-                        sched, gang_id, spec
+                        sched, gang_id, place_spec
                     )
                 except PlacementError as e:
                     # Priority preemption (the PriorityClass analog at
@@ -573,7 +1026,7 @@ class TpuJobController:
                     # gangs from the pool if — and only if — that frees
                     # enough chips for this one. Useless disruption
                     # (preempting without unblocking) is never done.
-                    if self._preempt_for(api, job, spec):
+                    if self._preempt_for(api, job, place_spec):
                         return Result(requeue_after=0.5)
                     # Record the event once per stuck episode, not per
                     # 10s retry — unbounded Event growth otherwise.
@@ -602,8 +1055,14 @@ class TpuJobController:
             incarnation = job.status.get("restarts", 0)
             created = []
             try:
-                for i in range(spec.replicas):
-                    pod = self._desired_pod(job, spec, i, incarnation)
+                for i in range(eff):
+                    # A gang recreated while elastically shrunk comes
+                    # back at its EFFECTIVE size (the capacity it lost
+                    # is still gone); grow-back restores spec.replicas
+                    # when the chips return.
+                    pod = self._desired_pod(
+                        job, spec, i, incarnation, replicas=eff
+                    )
                     if assignment is not None:
                         pod.spec["nodeName"] = assignment[i]
                     api.create(pod)
@@ -632,7 +1091,7 @@ class TpuJobController:
                 self._set_phase(api, job, "Pending")
                 return Result(requeue_after=self._quota_retry_seconds)
             api.record_event(
-                job, "GangCreated", f"created {spec.replicas} workers"
+                job, "GangCreated", f"created {eff} workers"
             )
             if job.status.get("reason") in (
                 "Unschedulable", "Preempted", "PreemptedBackoff",
@@ -647,11 +1106,13 @@ class TpuJobController:
                 api.update_status(fresh)
             return self._set_phase(api, job, "Pending")
 
-        if len(pods) != spec.replicas or set(by_index) != {
-            str(i) for i in range(spec.replicas)
+        if len(pods) != eff or set(by_index) != {
+            str(i) for i in range(eff)
         }:
             # Partial gang (scale change, external delete): all-or-nothing —
-            # tear down and let the next pass recreate.
+            # tear down and let the next pass recreate. The comparison is
+            # against the EFFECTIVE size, so an elastically shrunk gang
+            # running at its acked target is complete, not partial.
             for p in pods:
                 try:
                     api.delete("Pod", p.metadata.name, ns)
@@ -659,7 +1120,7 @@ class TpuJobController:
                     pass
             api.record_event(
                 job, "GangTornDown",
-                f"partial gang ({len(pods)}/{spec.replicas}); recreating",
+                f"partial gang ({len(pods)}/{eff}); recreating",
                 type_="Warning",
             )
             return self._set_phase(api, job, "Pending")
@@ -695,12 +1156,20 @@ class TpuJobController:
             )
             return self._set_phase(api, job, "Failed")
 
-        if counts["succeeded"] == spec.replicas:
+        if counts["succeeded"] == eff:
             api.record_event(job, "JobSucceeded", "all workers succeeded")
             return self._set_phase(api, job, "Succeeded")
 
         if all(p == "Running" for p in phases):
-            return self._set_phase(api, job, "Running", counts=counts)
+            result = self._set_phase(api, job, "Running", counts=counts)
+            if eff < spec.replicas:
+                # Running SHRUNK: keep probing for the released
+                # capacity; when the missing workers place again, offer
+                # the gang a grow-back (same handshake as the shrink).
+                grow = self._maybe_propose_grow(api, job, spec, eff)
+                if grow is not None:
+                    return grow
+            return result
 
         return self._set_phase(api, job, phase or "Pending", counts=counts)
 

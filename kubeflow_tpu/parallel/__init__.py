@@ -13,6 +13,8 @@ from kubeflow_tpu.parallel.mesh import (
     build_hybrid_mesh,
     build_mesh,
     local_mesh_spec,
+    mesh_spec_of,
+    resize_spec,
 )
 from kubeflow_tpu.parallel.sharding import (
     LogicalRules,

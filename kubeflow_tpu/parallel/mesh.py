@@ -169,6 +169,70 @@ def build_hybrid_mesh(
     return Mesh(dev_array, AXES)
 
 
+def mesh_spec_of(mesh: Mesh) -> MeshSpec:
+    """The `MeshSpec` a mesh realizes (axis name -> axis size)."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return MeshSpec(**{a: int(shape.get(a, 1)) for a in AXES})
+
+
+def resize_spec(
+    spec: MeshSpec,
+    new_dp: int,
+    *,
+    n_devices: int | None = None,
+    global_batch: int | None = None,
+) -> MeshSpec:
+    """The elastic-resize target layout: `spec` with its dp axis set to
+    `new_dp`, every other axis unchanged — validated with the divisor
+    math SPELLED OUT.
+
+    A degenerate resize target used to surface as an opaque reshape
+    error deep inside sharding (``cannot reshape array of size N``);
+    the elastic path validates here instead, so the preemption handler
+    can refuse (and fall back to a different target, or to a restart)
+    with an error that names the actual arithmetic:
+
+    - the resized mesh needs ``new_dp * (pp*fsdp*sp*ep*tp)`` devices,
+      which must not exceed what survives the preemption;
+    - the GLOBAL batch is sharded over ``new_dp * fsdp`` batch shards
+      (`BATCH_AXES`), so it must divide evenly — elastic resize keeps
+      the global batch (and therefore the training trajectory) fixed
+      and reshapes only its layout.
+    """
+    if new_dp < 1:
+        raise ValueError(f"resize target dp must be >= 1, got {new_dp}")
+    others = {a: s for a, s in zip(AXES, spec.sizes()) if a != "dp"}
+    if any(s < 1 for s in others.values()):
+        raise ValueError(
+            f"resize requires a fully-resolved spec (no -1 axes): {spec}"
+        )
+    model_axes = math.prod(others.values())
+    need = new_dp * model_axes
+    if n_devices is not None and need > n_devices:
+        factors = " * ".join(f"{a}={s}" for a, s in others.items() if s > 1)
+        raise ValueError(
+            f"resize to dp={new_dp} needs dp={new_dp}"
+            + (f" * {factors}" if factors else "")
+            + f" = {need} devices, but only {n_devices} "
+            f"survive — shrink dp to at most {n_devices // max(1, model_axes)}"
+        )
+    batch_shards = new_dp * spec.fsdp
+    if global_batch is not None and global_batch % batch_shards:
+        divisors = sorted(
+            d for d in range(1, global_batch + 1)
+            if global_batch % (d * spec.fsdp) == 0
+        )
+        raise ValueError(
+            f"resize to dp={new_dp} cannot shard the global batch: "
+            f"{global_batch} examples over dp={new_dp} * fsdp={spec.fsdp} "
+            f"= {batch_shards} batch shards leaves "
+            f"{global_batch % batch_shards} examples over — elastic "
+            f"resize keeps the global batch fixed, so dp must satisfy "
+            f"dp * {spec.fsdp} | {global_batch} (valid dp: {divisors})"
+        )
+    return dataclasses.replace(spec, dp=new_dp)
+
+
 def local_mesh_spec(n_devices: int | None = None, tp: int = 1, sp: int = 1) -> MeshSpec:
     """Convenience: FSDP over everything not claimed by tp/sp."""
     n = n_devices if n_devices is not None else jax.device_count()

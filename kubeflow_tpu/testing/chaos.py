@@ -264,6 +264,23 @@ TRAIN_FAULT_CLASSES = (
     "loss_spike",            # a poison batch the AnomalyGuard must skip
 )
 
+# Elastic-resize fault classes (ISSUE 9): the soak variant where
+# preemption is ABSORBED instead of fatal. A `preempt_shrink` is a real
+# SIGTERM self-delivered at the scheduled position WITH a staged
+# shrink-to-fit target (the scheduler's resize proposal): fit() must
+# reshape the mesh at the boundary and keep training — the process
+# never dies, so steps-lost-per-kill is ~0 instead of a save-interval's
+# worth. `grow_back` is the unprompted return to full dp when capacity
+# comes back. Built with `TrainFaultSchedule(..., elastic=True)`, which
+# swaps the crash/storage classes for resize cycles (the no-death
+# story) while keeping the loss spikes (the guard must compose with
+# resize).
+ELASTIC_FAULT_CLASSES = (
+    "preempt_shrink",
+    "grow_back",
+    "loss_spike",
+)
+
 _PROCESS_CLASSES = ("kill", "sigterm")
 _STORAGE_CLASSES = ("truncate_checkpoint", "corrupt_checkpoint", "corrupt_manifest")
 
@@ -277,12 +294,14 @@ class TrainFault:
     1 = second-newest, ...) — faults stacked on one boundary get
     distinct offsets so each one's verification path is actually
     exercised by the newest-first fallback walk, not masked by a
-    sibling fault on the same step."""
+    sibling fault on the same step. `dp` is the resize target of an
+    elastic fault (preempt_shrink/grow_back; 0 otherwise)."""
 
     cls: str
     at_step: int = 0
     after_crash: int = 0
     offset: int = 0
+    dp: int = 0
 
 
 class TrainFaultSchedule:
@@ -304,6 +323,15 @@ class TrainFaultSchedule:
       steps and the fallback walk meets every one;
     - `faults_per_class` loss spikes at positions the guard's EWMA has
       warmed up for, disjoint from the crash steps.
+
+    ``elastic=True`` builds the RESIZE soak's plan instead (ISSUE 9):
+    `faults_per_class` shrink->grow cycles — each a `preempt_shrink`
+    (real SIGTERM + staged target ``dp_shrunk``) later undone by a
+    `grow_back` to ``dp_full`` — plus the same loss spikes; the crash
+    and storage classes are absent because the whole point is that the
+    process never dies and the checkpoint directory is never the
+    recovery path. Coverage accounting runs over
+    `ELASTIC_FAULT_CLASSES`.
     """
 
     def __init__(
@@ -314,12 +342,31 @@ class TrainFaultSchedule:
         save_interval: int,
         faults_per_class: int = 1,
         guard_warmup: int = 3,
+        elastic: bool = False,
+        dp_full: int = 2,
+        dp_shrunk: int = 1,
     ):
         self.seed = seed
         self.total_steps = total_steps
         self.save_interval = save_interval
+        self.elastic = elastic
+        self._injected: dict[str, int] = {
+            c: 0
+            for c in (
+                ELASTIC_FAULT_CLASSES if elastic else TRAIN_FAULT_CLASSES
+            )
+        }
+        self._lock = threading.Lock()
         rng = random.Random(seed)
 
+        if elastic:
+            self._init_elastic(
+                rng, total_steps, faults_per_class, guard_warmup,
+                dp_full, dp_shrunk,
+            )
+            return
+
+        self.resize_faults: tuple[TrainFault, ...] = ()
         k = faults_per_class
         spacing = 3 * save_interval + 2
         first = spacing
@@ -367,8 +414,59 @@ class TrainFaultSchedule:
         self.plan: tuple[TrainFault, ...] = (
             self.crash_faults + self.storage_faults + self.spike_faults
         )
-        self._injected: dict[str, int] = {c: 0 for c in TRAIN_FAULT_CLASSES}
-        self._lock = threading.Lock()
+
+    def _init_elastic(
+        self, rng, total_steps: int, k: int, guard_warmup: int,
+        dp_full: int, dp_shrunk: int,
+    ) -> None:
+        """The resize-soak plan: k shrink->grow cycles at ascending,
+        spaced positions, plus the usual seeded loss spikes."""
+        if dp_shrunk >= dp_full or dp_shrunk < 1:
+            raise ValueError(
+                f"elastic schedule needs 1 <= dp_shrunk < dp_full, got "
+                f"{dp_shrunk} / {dp_full}"
+            )
+        self.crash_faults = ()
+        self.storage_faults = ()
+        spacing = max(3, self.save_interval)
+        first = max(guard_warmup + 2, spacing)
+        last = total_steps - 2
+        n_events = 2 * k
+        if first + (n_events - 1) * spacing > last:
+            raise ValueError(
+                f"total_steps={total_steps} too small for {k} "
+                f"shrink->grow cycles spaced {spacing}"
+            )
+        slack = last - (first + (n_events - 1) * spacing)
+        offsets = sorted(rng.randint(0, slack) for _ in range(n_events))
+        steps = [first + i * spacing + offsets[i] for i in range(n_events)]
+        self.resize_faults = tuple(
+            TrainFault(
+                "preempt_shrink" if i % 2 == 0 else "grow_back",
+                at_step=s,
+                dp=dp_shrunk if i % 2 == 0 else dp_full,
+            )
+            for i, s in enumerate(steps)
+        )
+        resize_steps = {f.at_step for f in self.resize_faults}
+        candidates = [
+            s for s in range(max(guard_warmup + 2, 3), total_steps - 1)
+            if s not in resize_steps
+        ]
+        spikes = sorted(rng.sample(candidates, k))
+        self.spike_faults = tuple(
+            TrainFault("loss_spike", at_step=s) for s in spikes
+        )
+        self.plan = self.resize_faults + self.spike_faults
+
+    @property
+    def resize_plan(self) -> tuple[dict, ...]:
+        """The resize cycles as the worker's staged-proposal env
+        payload (JSON-ready)."""
+        return tuple(
+            {"at_step": f.at_step, "dp": f.dp, "cls": f.cls}
+            for f in self.resize_faults
+        )
 
     @property
     def spike_steps(self) -> tuple[int, ...]:
@@ -466,6 +564,17 @@ class ResumableWrapper:
 
     def load_state_dict(self, state: dict) -> None:
         self._data.load_state_dict(state)
+
+    def rebind(self, mesh) -> "ResumableWrapper":
+        """The wrapper re-bound to a resized mesh (elastic resize):
+        rebinds the WRAPPED iterable and keeps this wrapper's own fault
+        state — scheduled positions are mesh-independent, so faults
+        staged past the resize still fire exactly once."""
+        import copy
+
+        clone = copy.copy(self)
+        clone._data = self._data.rebind(mesh)
+        return clone
 
     def __getattr__(self, name):
         # `perturb` is OPTIONAL in the protocol: expose it only when

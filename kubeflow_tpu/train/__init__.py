@@ -11,8 +11,11 @@ from kubeflow_tpu.train.data import SyntheticImages, SyntheticTokens
 from kubeflow_tpu.train.checkpoint import Checkpointer, Restored
 from kubeflow_tpu.train.guard import AnomalyGuard, GuardConfig
 from kubeflow_tpu.train.loop import (
+    ElasticResize,
     FitResult,
     Preempted,
+    ResizeEvent,
+    ResizeProposal,
     TrainingDiverged,
     fit,
 )

@@ -70,6 +70,20 @@ class _SyntheticStream:
 
     # -- resumable-data protocol -------------------------------------------
 
+    def rebind(self, mesh: Mesh) -> "_SyntheticStream":
+        """The SAME stream on a different mesh — the data half of the
+        elastic gang resize (docs/resilience.md). Batch content is a
+        pure function of (seed, salt, position) and never of the mesh
+        (the partitionable threefry derives every element's bits from
+        its logical index), so the rebound stream yields bit-identical
+        batches from the transplanted position: the (step -> batch
+        position) identity mapping holds across a resize — zero
+        repeated and zero skipped examples. Only the sharding layout of
+        the yielded batches changes."""
+        clone = type(self)(mesh, **self._ctor)
+        clone.load_state_dict(self.state_dict())
+        return clone
+
     def state_dict(self) -> dict:
         return {"position": self._position, "salt": self._salt}
 
@@ -120,6 +134,13 @@ class SyntheticImages(_SyntheticStream):
             raise ValueError(f"batch_size must be positive, got {batch_size}")
         key = jax.random.PRNGKey(seed)
         self.batch_size = batch_size
+        # Everything `rebind(mesh)` needs to rebuild this stream on a
+        # resized mesh with the identical batch recipe.
+        self._ctor = dict(
+            batch_size=batch_size, image_size=image_size,
+            num_classes=num_classes, channels=channels, seed=seed,
+            dtype=dtype, vary_per_step=vary_per_step,
+        )
 
         def make(pos, salt):
             k = jax.random.fold_in(jax.random.fold_in(key, salt), pos)
@@ -154,6 +175,11 @@ class SyntheticTokens(_SyntheticStream):
         seq_axis = "sp" if "sp" in mesh.axis_names else None
         sharding = NamedSharding(mesh, P(batch_axes(mesh), seq_axis))
         self.batch_size = batch_size
+        self._ctor = dict(
+            batch_size=batch_size, seq_len=seq_len,
+            vocab_size=vocab_size, seed=seed,
+            vary_per_step=vary_per_step,
+        )
 
         def make(pos, salt):
             k = jax.random.fold_in(jax.random.fold_in(key, salt), pos)

@@ -19,6 +19,18 @@ no preemption handling") — the full matrix lives in docs/resilience.md:
   distinct `Preempted` result — the TpuJob operator's gang-restart
   policy composes with it to give checkpoint-restart elasticity with
   zero lost work.
+- **Elastic resize.** A loop built with an `ElasticResize` can ABSORB a
+  preemption instead of dying: when the scheduler has offered a
+  shrink-to-fit target (`controllers/tpujob.py` resize proposals), the
+  loop reshapes the mesh at the step boundary — rebuild the mesh at the
+  new dp, re-shard the live `TrainState` across device sets (no
+  checkpoint round-trip; `restore_latest` into the new topology is the
+  fallback when a host is already gone), transplant the resumable-data
+  state — and keeps training with the SAME global batch, so the
+  trajectory (and the (step -> batch position) identity mapping) is
+  unchanged. Growing back when capacity returns rides the same
+  transition. Steps lost per preemption: ~0, vs a save-interval's worth
+  under gang restart.
 """
 
 from __future__ import annotations
@@ -47,6 +59,79 @@ class TrainingDiverged(RuntimeError):
     different seed/schedule rather than continuing."""
 
 
+@dataclasses.dataclass(frozen=True)
+class ResizeProposal:
+    """One elastic-resize target, honored at the next step boundary.
+
+    `source="live"` re-shards the in-memory TrainState across meshes —
+    the happy path, no checkpoint round-trip. `source="checkpoint"` is
+    the fallback for when part of the old mesh is ALREADY gone (a host
+    died with its shards): restore the newest verified checkpoint into
+    the new topology instead — `Restored` states are shape-polymorphic
+    on dp because checkpoints hold GLOBAL arrays and restore lays them
+    out by the target trainer's NamedShardings."""
+
+    dp: int
+    source: str = "live"
+
+    def __post_init__(self) -> None:
+        if self.source not in ("live", "checkpoint"):
+            raise ValueError(
+                f"ResizeProposal.source must be 'live' or 'checkpoint', "
+                f"got {self.source!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class ResizeEvent:
+    """One completed mesh resize (FitResult.resizes / on_resize)."""
+
+    step: int           # the boundary the transition ran at
+    from_dp: int
+    to_dp: int
+    source: str         # "live" or "checkpoint"
+    seconds: float      # transition wall time
+    # The preemption signal this resize absorbed (the gang reshaped
+    # instead of dying); None for an unprompted resize (grow-back).
+    absorbed_signum: int | None = None
+    # source="checkpoint" only: the step actually restored (the steps
+    # in between are recomputed — they were never durable anywhere).
+    restored_step: int | None = None
+
+
+@dataclasses.dataclass
+class ElasticResize:
+    """fit()'s elastic gang-resize driver (docs/resilience.md).
+
+    - ``mesh_factory(dp)`` builds the target mesh — typically
+      `parallel.mesh.build_mesh`/`build_hybrid_mesh` over the surviving
+      hosts' devices.
+    - ``data_factory(mesh, data)`` rebuilds the training iterable on the
+      new mesh (the streams' ``rebind(mesh)``); fit() then transplants
+      the resumable-data state, so batch content — a pure function of
+      (seed, salt, position), never the mesh — continues the identity
+      (step -> position) mapping: zero repeated or skipped batches.
+    - ``propose(step, preempted)`` is polled at every step boundary.
+      ``preempted=True`` means a SIGTERM/SIGINT arrived: returning a
+      proposal then ABSORBS the signal (the gang shrinks instead of
+      dying — the scheduler's shrink-to-fit ack); returning None lets
+      the normal `Preempted` exit happen. With ``preempted=False`` a
+      proposal drives an unprompted resize (grow-back when capacity
+      returns).
+    - ``on_resize(event)`` observes each completed transition (trace
+      emission, the controller-facing ack).
+    """
+
+    mesh_factory: Callable[[int], Any]
+    data_factory: Callable[[Any, Any], Any]
+    propose: Callable[[int, bool], ResizeProposal | None]
+    on_resize: Callable[[ResizeEvent], None] | None = None
+
+
+def _mesh_dp(trainer: Trainer) -> int:
+    return int(trainer.mesh.shape.get("dp", 1))
+
+
 @dataclasses.dataclass
 class FitResult:
     state: TrainState
@@ -55,6 +140,8 @@ class FitResult:
     resumed_from: int | None
     # Divergence rollbacks taken (guarded runs; 0 otherwise).
     rollbacks: int = 0
+    # Elastic mesh resizes performed (ElasticResize runs; [] otherwise).
+    resizes: list[ResizeEvent] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -90,6 +177,7 @@ def fit(
     profiler: "Profiler | None" = None,
     handle_signals: bool = True,
     max_rollbacks: int = 3,
+    elastic: ElasticResize | None = None,
 ) -> FitResult:
     """Train for `total_steps` global steps, resuming if possible.
 
@@ -97,7 +185,10 @@ def fit(
     handler (e.g. when the caller owns signal disposition); handlers are
     only ever installed on the main thread and are restored on exit.
     `max_rollbacks` bounds divergence rollbacks before the loop gives up
-    and raises `TrainingDiverged`.
+    and raises `TrainingDiverged`. `elastic` enables elastic gang
+    resize: proposals are polled at every step boundary, and a proposal
+    arriving with a preemption signal absorbs it — the mesh reshapes
+    instead of the process dying (see `ElasticResize`).
     """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     guard = trainer.guard
@@ -128,6 +219,7 @@ def fit(
     t_last = time.perf_counter()
     examples = 0
     rollbacks = 0
+    resizes: list[ResizeEvent] = []
     preempt: dict = {"signum": None}
     installed: dict = {}
     if handle_signals:
@@ -313,6 +405,88 @@ def fit(
                     rec["examples_per_sec"],
                 )
                 t_last, examples = now, 0
+            # -- elastic resize (docs/resilience.md) -------------------
+            # Polled at the boundary AFTER save/log so the transition
+            # always starts from a fully-accounted step. A proposal
+            # arriving with a preemption signal absorbs it: the gang
+            # reshapes instead of dying, and the loop keeps training —
+            # the whole point of shrink-to-fit over gang restart.
+            if elastic is not None and not is_last:
+                proposal = elastic.propose(step, preempted)
+                if proposal is not None and proposal.dp != _mesh_dp(trainer):
+                    t0 = time.perf_counter()
+                    from_dp = _mesh_dp(trainer)
+                    at_step = step
+                    new_mesh = elastic.mesh_factory(proposal.dp)
+                    new_trainer = trainer.resize(new_mesh)
+                    restored_step = None
+                    if proposal.source == "checkpoint":
+                        # Part of the old mesh is already gone (a host
+                        # died with its shards): the live state is not
+                        # recoverable — restore the newest verified
+                        # checkpoint INTO the new topology. Checkpoints
+                        # hold global arrays, so the restore is shape-
+                        # polymorphic on dp by construction.
+                        if checkpointer is None:
+                            raise RuntimeError(
+                                "resize with source='checkpoint' needs "
+                                "a checkpointer (the live state went "
+                                "down with the dead host)"
+                            )
+                        restored = checkpointer.restore_latest(
+                            new_trainer.abstract_state()
+                        )
+                        if restored is None:
+                            raise RuntimeError(
+                                f"resize at step {step}: no valid "
+                                "checkpoint to restore into the new "
+                                "topology"
+                            )
+                        state = restored.state
+                        restored_step = step = int(restored.step)
+                        data_state = restored.data_state
+                    else:
+                        # Happy path: re-shard the LIVE state across
+                        # device sets — no checkpoint round-trip, no
+                        # recomputed steps.
+                        state = new_trainer.reshard_state(state)
+                        data_state = _data_state(data)
+                    trainer = new_trainer
+                    data = elastic.data_factory(new_mesh, data)
+                    # Transplant the resumable-data state: content is a
+                    # pure function of (seed, salt, position), never the
+                    # mesh, so the (step -> position) identity mapping
+                    # holds across the resize — zero repeated or
+                    # skipped batches.
+                    _load_data_state(data, data_state)
+                    it = iter(data)
+                    step_fn = trainer.make_train_step()
+                    event = ResizeEvent(
+                        step=at_step,
+                        from_dp=from_dp,
+                        to_dp=proposal.dp,
+                        source=proposal.source,
+                        seconds=time.perf_counter() - t0,
+                        absorbed_signum=(
+                            preempt["signum"] if preempted else None
+                        ),
+                        restored_step=restored_step,
+                    )
+                    resizes.append(event)
+                    log.warning(
+                        "elastic resize at step %d: dp %d -> %d "
+                        "(source=%s, absorbed_signum=%s, %.2fs)",
+                        event.step, event.from_dp, event.to_dp,
+                        event.source, event.absorbed_signum,
+                        event.seconds,
+                    )
+                    if elastic.on_resize is not None:
+                        elastic.on_resize(event)
+                    if preempted:
+                        # Absorbed: the preemption cost a resize, not
+                        # the gang.
+                        preempt["signum"] = None
+                        preempted = False
             if preempted:
                 if checkpointer is not None and not saved:
                     # Emergency save at the boundary: the preemption
@@ -334,6 +508,7 @@ def fit(
                     steps_done=step - start_step,
                     resumed_from=resumed_from,
                     rollbacks=rollbacks,
+                    resizes=resizes,
                     signum=preempt["signum"],
                 )
                 break
@@ -376,4 +551,5 @@ def fit(
         steps_done=total_steps - start_step,
         resumed_from=resumed_from,
         rollbacks=rollbacks,
+        resizes=resizes,
     )

@@ -198,6 +198,13 @@ class _DeviceIter:
         # next() generator would keep draining the stale one.
         self._gen = None
 
+    def rebind(self, mesh) -> "_DeviceIter":
+        """The same dataset on a different mesh (elastic resize): the
+        resumable position lives on the DATASET, which the rebound view
+        shares, so iteration continues at the identical batch — only
+        the device placement of the yielded batches changes."""
+        return _DeviceIter(self._dataset, mesh)
+
     def __next__(self) -> dict:
         if self._gen is None:
             self._gen = iter(self)

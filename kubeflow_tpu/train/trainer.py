@@ -322,6 +322,73 @@ class Trainer:
     def batch_sharding(self, ndim: int = 1) -> NamedSharding:
         return shlib.batch_sharding(self.mesh, ndim)
 
+    # -- elastic resize ----------------------------------------------------
+
+    def resize(self, mesh: Mesh) -> "Trainer":
+        """A new Trainer bound to `mesh` — the trainer half of the
+        elastic gang-resize transition (docs/resilience.md).
+
+        Only the data-parallel axes (dp/fsdp) may change size: the
+        model-parallel axes (pp/sp/ep/tp) define how PARAMETERS are laid
+        out across chips, and reshaping those mid-run is a different
+        (restart-shaped) operation. The divisor math is validated up
+        front (`parallel.mesh.resize_spec`) so a degenerate target
+        fails with the arithmetic spelled out instead of an opaque
+        reshape error deep in sharding."""
+        from kubeflow_tpu.parallel.mesh import mesh_spec_of, resize_spec
+
+        old_spec = mesh_spec_of(self.mesh)
+        new_spec = mesh_spec_of(mesh)
+        for axis in ("pp", "sp", "ep", "tp"):
+            old_n, new_n = getattr(old_spec, axis), getattr(new_spec, axis)
+            if old_n != new_n:
+                raise ValueError(
+                    f"elastic resize reshapes only the data-parallel "
+                    f"axes; {axis} changed {old_n} -> {new_n} — "
+                    f"model-parallel resharding needs a gang restart"
+                )
+        # Spell out the device/batch divisor math for the target dp
+        # (fsdp rides along as part of the batch-shard product).
+        resize_spec(
+            dataclasses.replace(old_spec, fsdp=new_spec.fsdp),
+            new_spec.dp,
+            n_devices=int(mesh.devices.size),
+            global_batch=self.config.batch_size,
+        )
+        return Trainer(
+            self.model,
+            self.config,
+            mesh,
+            rules=self.rules,
+            example_input_shape=self.example_input_shape,
+            input_key=self.input_key,
+            label_key=self.label_key,
+            example_input_dtype=self.example_input_dtype,
+            guard=self.guard,
+        )
+
+    def reshard_state(self, state: TrainState) -> TrainState:
+        """Re-shard a LIVE TrainState onto this trainer's mesh — the
+        happy-path resize needs no checkpoint round-trip. Leaf-wise
+        `jax.device_put` onto the new NamedShardings (jax reshards
+        across device sets, so a state living on the old mesh's devices
+        lands distributed over the new mesh's), rebuilt on THIS
+        trainer's treedef so the static fields (apply_fn, tx) are this
+        trainer's own rather than the old mesh's closures."""
+        shardings = self.state_shardings()
+        src = jax.tree_util.tree_leaves(state)
+        dst = jax.tree_util.tree_leaves(shardings)
+        if len(src) != len(dst):
+            raise ValueError(
+                f"TrainState has {len(src)} leaves but this trainer's "
+                f"state tree has {len(dst)} — resize must keep the "
+                "model/optimizer/guard structure identical"
+            )
+        leaves = [jax.device_put(x, s) for x, s in zip(src, dst)]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(shardings), leaves
+        )
+
     # -- the step ----------------------------------------------------------
 
     def make_train_step(self):

@@ -10,6 +10,16 @@ loss spikes, and appends a JSONL trace (boot, every step with its data
 position, final state summary) that the driver reconstructs the run
 from: final-loss parity, zero repeated/skipped batches, goodput.
 
+ELASTIC mode (ISSUE 9): with KFTPU_ELASTIC_PLAN (a JSON list of staged
+resize proposals) and/or KFTPU_RESIZE_FILE (a live proposal file the
+scheduler-side driver writes), the worker runs `fit()` with an
+`ElasticResize` — a `preempt_shrink` entry self-delivers a REAL SIGTERM
+at its position and the staged shrink target lets fit ABSORB it by
+reshaping the mesh instead of exiting; `grow_back` entries resize
+upward unprompted. Each completed resize is traced (`resize` events)
+and, with KFTPU_ACK_FILE set, acked to the driver — the gang worker's
+half of the controller handshake. KFTPU_DP sets the starting dp.
+
 Exit codes: 0 = completed; 75 = preempted (fit returned `Preempted`);
 killed-by-signal otherwise.
 """
@@ -20,7 +30,9 @@ import sys
 import time
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = ""
+# 8 virtual CPU devices so elastic runs can host dp up to 8; the
+# legacy dp=1 soak keeps using the first device only.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -37,7 +49,9 @@ from kubeflow_tpu.testing.chaos import (  # noqa: E402
 from kubeflow_tpu.testing.tinymodels import TinyMLP  # noqa: E402
 from kubeflow_tpu.train import (  # noqa: E402
     Checkpointer,
+    ElasticResize,
     Preempted,
+    ResizeProposal,
     SyntheticImages,
     TrainConfig,
     Trainer,
@@ -65,6 +79,38 @@ class CrashInjector(ResumableWrapper):
         return batch
 
 
+class SigtermAtSteps(ResumableWrapper):
+    """Self-delivers a REAL SIGTERM at each exact position in
+    `positions` — the preemption signal of a `preempt_shrink` fault.
+    Exact-position matching makes the wrapper rebind-safe: after the
+    resize the stream continues PAST the position, so the signal can
+    never refire from the rebound clone."""
+
+    def __init__(self, data, positions):
+        super().__init__(data)
+        self.positions = frozenset(int(p) for p in positions)
+
+    def transform(self, pos: int, batch):
+        if pos in self.positions:
+            import signal as signal_module
+
+            os.kill(os.getpid(), signal_module.SIGTERM)
+        return batch
+
+
+class DelayData(ResumableWrapper):
+    """Per-batch wall-clock delay (the negotiated e2e paces the worker
+    so the driver's controller round-trips fit between boundaries)."""
+
+    def __init__(self, data, seconds: float):
+        super().__init__(data)
+        self.seconds = seconds
+
+    def transform(self, pos: int, batch):
+        time.sleep(self.seconds)
+        return batch
+
+
 def main() -> int:
     total_steps = int(os.environ["KFTPU_TOTAL_STEPS"])
     save_interval = int(os.environ["KFTPU_SAVE_INTERVAL"])
@@ -76,6 +122,11 @@ def main() -> int:
     crash_signal = os.environ.get("KFTPU_CRASH_SIGNAL")
     incarnation = int(os.environ.get("KFTPU_INCARNATION", "0"))
     trace_path = os.environ["KFTPU_TRACE_FILE"]
+    dp0 = int(os.environ.get("KFTPU_DP", "1"))
+    elastic_plan = json.loads(os.environ.get("KFTPU_ELASTIC_PLAN") or "[]")
+    resize_file = os.environ.get("KFTPU_RESIZE_FILE")
+    ack_file = os.environ.get("KFTPU_ACK_FILE")
+    step_delay = float(os.environ.get("KFTPU_STEP_DELAY") or 0)
 
     trace = open(trace_path, "a")
 
@@ -91,7 +142,7 @@ def main() -> int:
 
     emit("boot")
 
-    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    mesh = build_mesh(MeshSpec(dp=dp0), jax.devices()[:dp0])
     config = TrainConfig(
         batch_size=8,
         learning_rate=0.05,
@@ -128,6 +179,85 @@ def main() -> int:
             else signal_module.SIGTERM
         )
         data = CrashInjector(data, int(crash_step), signum)
+    shrink_steps = [
+        int(e["at_step"]) for e in elastic_plan
+        if e.get("cls") == "preempt_shrink"
+    ]
+    if shrink_steps:
+        # The preemption signal of every staged shrink is REAL: the
+        # process SIGTERMs itself at the scheduled position and fit()
+        # must absorb it by resizing at the boundary.
+        data = SigtermAtSteps(data, shrink_steps)
+    if step_delay:
+        data = DelayData(data, step_delay)
+
+    # fit() swaps its data iterable on every resize; the trace must
+    # read positions from whatever stack is CURRENT, not the boot one.
+    current = {"data": data}
+
+    elastic = None
+    if elastic_plan or resize_file:
+        # A fault at position p is delivered while FETCHING p's batch
+        # (the crash-injector convention), so its signal is honored —
+        # and its staged proposal consulted — at the boundary after
+        # step p+1.
+        staged = {int(e["at_step"]) + 1: e for e in elastic_plan}
+
+        def propose(step: int, preempted: bool):
+            entry = staged.get(step)
+            if entry is not None:
+                return ResizeProposal(
+                    dp=int(entry["dp"]),
+                    source=entry.get("source", "live"),
+                )
+            if resize_file and os.path.exists(resize_file):
+                # Negotiated mode: the scheduler-side driver stages the
+                # live proposal (the TpuJob status.resize analog).
+                try:
+                    with open(resize_file) as f:
+                        j = json.load(f)
+                except (OSError, ValueError):
+                    return None
+                if j.get("dp"):
+                    return ResizeProposal(
+                        dp=int(j["dp"]), source=j.get("source", "live")
+                    )
+            return None
+
+        def on_resize(event) -> None:
+            emit(
+                "resize",
+                step=event.step,
+                from_dp=event.from_dp,
+                to_dp=event.to_dp,
+                source=event.source,
+                absorbed_signum=event.absorbed_signum,
+                restored_step=event.restored_step,
+                seconds=event.seconds,
+            )
+            if ack_file:
+                # The gang worker's ack half of the handshake, durably
+                # visible to the driver (atomic rename).
+                tmp = ack_file + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"dp": event.to_dp, "step": event.step}, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, ack_file)
+
+        def data_factory(new_mesh, d):
+            rebound = d.rebind(new_mesh)
+            current["data"] = rebound
+            return rebound
+
+        elastic = ElasticResize(
+            mesh_factory=lambda dp: build_mesh(
+                MeshSpec(dp=dp), jax.devices()[:dp]
+            ),
+            data_factory=data_factory,
+            propose=propose,
+            on_resize=on_resize,
+        )
 
     ckpt = Checkpointer(
         os.environ["KFTPU_CKPT_DIR"],
@@ -139,7 +269,7 @@ def main() -> int:
         emit(
             "step",
             step=step,
-            position=data.state_dict()["position"],
+            position=current["data"].state_dict()["position"],
             loss=rec["loss"],
             skips=rec["guard_skipped_total"],
         )
@@ -147,6 +277,7 @@ def main() -> int:
     result = fit(
         trainer, data, total_steps=total_steps,
         checkpointer=ckpt, log_every=1, on_metrics=on_metrics,
+        elastic=elastic,
     )
     ckpt.close()
 
@@ -163,11 +294,12 @@ def main() -> int:
     emit(
         "done",
         step=int(result.state.step),
-        position=data.state_dict()["position"],
+        position=current["data"].state_dict()["position"],
         final_loss=result.history[-1]["loss"],
         params_l1=params_l1,
         skips=guard.skipped_total(result.state.guard),
         resumed_from=result.resumed_from,
+        resizes=len(result.resizes),
     )
     print(f"DONE step={int(result.state.step)} l1={params_l1:.6f}", flush=True)
     return 0

@@ -217,6 +217,29 @@ def test_resilience_soak_is_slow_marked_with_seeded_nightly_entry():
     assert "resilience soak seed=" in bench
 
 
+def test_elastic_resize_soak_is_slow_marked_with_seeded_nightly_entry():
+    """The elastic-resize soak (ISSUE 9) follows the same convention as
+    the kill-and-resume and failover soaks: tier-1 runs the small
+    fixed-seed shrink->grow cycle, the dense nightly variant is
+    `slow`-marked, and `bench.py --workload resilience` drives it with
+    a printed seed (publishing the `resilience_*_elastic` rows) so any
+    failure reproduces from one integer."""
+    soak = (
+        REPO / "tests" / "e2e" / "test_train_resilience_e2e.py"
+    ).read_text()
+    assert "def test_resilience_soak_elastic_resize" in soak
+    nightly = soak.split("def test_resilience_soak_elastic_nightly")
+    assert len(nightly) == 2
+    assert nightly[0].rstrip().endswith("@pytest.mark.slow")
+    assert "KFTPU_RESILIENCE_SEED" in soak
+    bench = (REPO / "bench.py").read_text()
+    assert "test_resilience_soak_elastic_nightly" in bench
+    assert "resilience_goodput_elastic" in bench
+    assert "resilience_steps_lost_per_kill_elastic" in bench
+    # The seed is printed up front (the repro contract).
+    assert "resilience soak seed=" in bench
+
+
 def test_failover_soak_is_slow_marked_with_seeded_nightly_entry():
     """The apiserver-failover soak follows the same convention as the
     chaos and resilience soaks: the kill-cycle nightly is `slow`-marked

@@ -1,0 +1,162 @@
+"""The re-shard mapping in isolation (ISSUE 9 satellite).
+
+The elastic soak's zero-repeated/skipped-batches guarantee reduces to
+one data-layer invariant: batch CONTENT is a pure function of
+(seed, salt, position) and never of the mesh. These tests prove it
+independent of the e2e — `state_dict` saved on a dp=4 stream, loaded at
+dp=2 and dp=8 (and via `rebind`), must continue the identical
+per-position sequence, with `vary_per_step` on and off.
+"""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import MeshSpec, build_mesh
+from kubeflow_tpu.train import SyntheticImages, SyntheticTokens
+
+
+def _mesh(dp, devices):
+    return build_mesh(MeshSpec(dp=dp), devices[:dp])
+
+
+def _take(stream, n):
+    it = iter(stream)
+    return [next(it) for _ in range(n)]
+
+
+def _assert_batches_equal(a, b, msg=""):
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[k]), np.asarray(b[k]), err_msg=f"{msg} field {k}"
+        )
+
+
+@pytest.mark.parametrize("new_dp", [2, 8])
+def test_images_state_saved_at_dp4_loads_at_other_dp(devices, new_dp):
+    """Positions 0..9 consumed at dp=4; a fresh stream on a new mesh
+    loading that state continues at position 10 with BIT-identical
+    content — the (step -> position) identity mapping holds across the
+    resize."""
+    kwargs = dict(
+        batch_size=8, image_size=8, num_classes=10, seed=7,
+        vary_per_step=True,
+    )
+    ref = SyntheticImages(_mesh(4, devices), **kwargs)
+    consumed = _take(ref, 14)  # the full reference sequence 0..13
+
+    src = SyntheticImages(_mesh(4, devices), **kwargs)
+    _take(src, 10)
+    state = src.state_dict()
+    assert state == {"position": 10, "salt": 0}
+
+    dst = SyntheticImages(_mesh(new_dp, devices), **kwargs)
+    dst.load_state_dict(state)
+    cont = _take(dst, 4)
+    for i, batch in enumerate(cont):
+        _assert_batches_equal(
+            batch, consumed[10 + i], f"dp=4->{new_dp} position {10 + i}"
+        )
+    assert dst.state_dict()["position"] == 14
+
+
+@pytest.mark.parametrize("new_dp", [2, 8])
+def test_tokens_state_saved_at_dp4_loads_at_other_dp(devices, new_dp):
+    kwargs = dict(batch_size=8, seq_len=16, vocab_size=64, seed=5,
+                  vary_per_step=True)
+    ref = SyntheticTokens(_mesh(4, devices), **kwargs)
+    consumed = _take(ref, 8)
+
+    src = SyntheticTokens(_mesh(4, devices), **kwargs)
+    _take(src, 6)
+    dst = SyntheticTokens(_mesh(new_dp, devices), **kwargs)
+    dst.load_state_dict(src.state_dict())
+    for i, batch in enumerate(_take(dst, 2)):
+        _assert_batches_equal(
+            batch, consumed[6 + i], f"dp=4->{new_dp} position {6 + i}"
+        )
+
+
+def test_rebind_transplants_position_and_salt(devices):
+    stream = SyntheticImages(
+        _mesh(4, devices), batch_size=8, image_size=8, num_classes=10,
+        seed=7, vary_per_step=True,
+    )
+    _take(stream, 5)
+    stream.perturb(3)
+    clone = stream.rebind(_mesh(2, devices))
+    assert clone.state_dict() == {"position": 5, "salt": 3}
+    # The rebound stream and the original (same salt) agree on every
+    # future position.
+    a = _take(stream, 3)
+    b = _take(clone, 3)
+    for x, y in zip(a, b):
+        _assert_batches_equal(x, y, "rebind continuation")
+
+
+def test_rebind_lays_batches_out_on_the_new_mesh(devices):
+    stream = SyntheticImages(
+        _mesh(4, devices), batch_size=8, image_size=8, num_classes=10,
+        seed=7, vary_per_step=True,
+    )
+    clone = stream.rebind(_mesh(2, devices))
+    batch = _take(clone, 1)[0]
+    assert set(batch["image"].sharding.device_set) <= set(devices[:2])
+
+
+def test_fixed_stream_reshards_with_bookkeeping_intact(devices):
+    """vary_per_step=False: every position yields the identical cached
+    batch, so the mapping contract is pure bookkeeping — position
+    carries over and the batch is the same one, laid out on the new
+    mesh. perturb stays shadowed to None through the rebind (fit()'s
+    rollback precondition must keep refusing)."""
+    kwargs = dict(
+        batch_size=8, image_size=8, num_classes=10, seed=7,
+        vary_per_step=False,
+    )
+    src = SyntheticImages(_mesh(4, devices), **kwargs)
+    first = _take(src, 3)
+    assert src.perturb is None
+
+    dst = SyntheticImages(_mesh(2, devices), **kwargs)
+    dst.load_state_dict(src.state_dict())
+    assert dst.state_dict()["position"] == 3
+    _assert_batches_equal(_take(dst, 1)[0], first[0], "fixed stream")
+
+    clone = src.rebind(_mesh(8, devices))
+    assert clone.perturb is None
+    assert clone.state_dict()["position"] == 3
+    _assert_batches_equal(_take(clone, 1)[0], first[0], "fixed rebind")
+
+
+def test_wrapped_streams_rebind_through_the_wrapper(devices):
+    """ResumableWrapper.rebind rebinds the inner stream and keeps the
+    wrapper's fault state: a spike staged past the resize still fires,
+    one staged before it never refires."""
+    from kubeflow_tpu.testing.chaos import SpikedData
+
+    kwargs = dict(
+        batch_size=8, image_size=8, num_classes=10, seed=7,
+        vary_per_step=True,
+    )
+    plain = SyntheticImages(_mesh(4, devices), **kwargs)
+    plain_batches = _take(plain, 8)
+
+    wrapped = SpikedData(
+        SyntheticImages(_mesh(4, devices), **kwargs), positions=(2, 6),
+        scale=1e3,
+    )
+    before = _take(wrapped, 4)  # spike at position 2 fired
+    clone = wrapped.rebind(_mesh(2, devices))
+    after = _take(clone, 4)  # positions 4..7; spike at 6 must fire
+    np.testing.assert_allclose(
+        np.asarray(after[2]["image"]),
+        np.asarray(plain_batches[6]["image"]) * 1e3,
+        err_msg="staged spike lost across rebind",
+    )
+    _assert_batches_equal(after[0], plain_batches[4], "unspiked position")
+    # And the pre-resize spike stayed where it was.
+    np.testing.assert_allclose(
+        np.asarray(before[2]["image"]),
+        np.asarray(plain_batches[2]["image"]) * 1e3,
+    )

@@ -1,0 +1,347 @@
+"""Elastic gang resize at the scheduler layer (ISSUE 9).
+
+The negotiation the reference (and PR 5's restart-shaped resilience)
+never had: instead of evicting a whole lower-priority gang, the
+controller OFFERS it a shrink-to-fit target (`status.resize`), the gang
+worker acks by reshaping its mesh (`status.resizeAck`, via
+`ack_resize`), and the controller trims the released pods with the gang
+intact — phase, restart budget and incarnation untouched, ZERO
+evictions recorded. When capacity returns, the same handshake grows the
+gang back. A gang that never acks falls back to the rigid eviction
+path, and rigid gangs (elasticMinReplicas=0) keep the historical
+all-or-nothing semantics exactly.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import make_tpujob
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.tpujob import KIND, TpuJobSpec
+from kubeflow_tpu.controllers.tpujob import (
+    LABEL_INCARNATION,
+    LABEL_JOB,
+    LABEL_WORKER,
+    TpuJobController,
+    ack_resize,
+)
+from kubeflow_tpu.testing import FakeApiServer
+
+
+def _cluster(api, nodes=2, chips=4, pool="4x4"):
+    for i in range(nodes):
+        node = new_resource(
+            "Node", f"n{i}", "",
+            spec={"pool": pool, "chips": chips, "x": i, "y": 0},
+        )
+        node.status = {"ready": True}
+        api.create(node)
+
+
+def _world(nodes=2, **ctl_kwargs):
+    api = FakeApiServer()
+    _cluster(api, nodes=nodes)
+    ctl = TpuJobController(api, **ctl_kwargs)
+    return api, ctl
+
+
+def _pods(api, name, ns="default"):
+    return sorted(
+        api.list("Pod", ns, label_selector={LABEL_JOB: name}),
+        key=lambda p: int(p.metadata.labels[LABEL_WORKER]),
+    )
+
+
+def _run(ctl, passes=8):
+    for _ in range(passes):
+        ctl.controller.run_until_idle()
+
+
+def _job(name, *, priority=0, replicas=2, chips=4, elastic_min=0):
+    return make_tpujob(
+        name, replicas=replicas, tpu_chips_per_worker=chips,
+        topology="4x4", command=("true",), priority=priority,
+        elastic_min_replicas=elastic_min,
+    )
+
+
+def _event_reasons(api, ns="default"):
+    return {e.spec["reason"] for e in api.list("Event", ns)}
+
+
+def _mark_running(api, name, ns="default"):
+    for p in _pods(api, name, ns):
+        fresh = p.thaw()
+        fresh.status["phase"] = "Running"
+        api.update_status(fresh)
+
+
+def test_elastic_spec_field_roundtrip_and_validation():
+    spec = TpuJobSpec(replicas=4, elastic_min_replicas=2)
+    assert TpuJobSpec.from_dict(spec.to_dict()) == spec
+    with pytest.raises(ValueError, match="elastic_min_replicas"):
+        TpuJobSpec(replicas=2, elastic_min_replicas=3).validate()
+    with pytest.raises(ValueError, match="elastic_min_replicas"):
+        TpuJobSpec(replicas=2, elastic_min_replicas=-1).validate()
+
+
+def test_shrink_offer_written_instead_of_eviction():
+    """A higher-priority gang arriving over an ELASTIC victim writes a
+    shrink proposal — and touches nothing until it is acked."""
+    api, ctl = _world(nodes=2)  # 8 chips
+    api.create(_job("batch", elastic_min=1))  # 2 workers x 4 chips
+    _run(ctl)
+    assert len(_pods(api, "batch")) == 2
+
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+
+    batch = api.get(KIND, "batch")
+    proposal = batch.status.get("resize")
+    assert proposal is not None, batch.status
+    assert proposal["replicas"] == 1
+    assert proposal["forJob"] == "default/urgent"
+    # Nothing was evicted while the offer is pending.
+    assert len(_pods(api, "batch")) == 2
+    assert len(_pods(api, "urgent")) == 0
+    reasons = _event_reasons(api)
+    assert "ResizeProposed" in reasons
+    assert "ResizeRequested" in reasons
+    assert "Preempted" not in reasons
+
+
+def test_acked_shrink_reshapes_gang_with_zero_evictions():
+    """The full negotiation: offer -> ack -> pods trimmed, preemptor
+    placed — victim phase/restarts/incarnation untouched, zero
+    evictions in the accounting."""
+    api, ctl = _world(nodes=2)
+    api.create(_job("batch", elastic_min=1))
+    _run(ctl)
+    _mark_running(api, "batch")
+    _run(ctl)
+    incarnation_before = {
+        p.metadata.name: p.metadata.labels[LABEL_INCARNATION]
+        for p in _pods(api, "batch")
+    }
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+
+    assert ack_resize(api, "batch") == 1
+    _run(ctl)
+    time.sleep(0.6)  # the preemptor's placement retry is requeue-timed
+    _run(ctl)
+
+    batch = api.get(KIND, "batch")
+    pods = _pods(api, "batch")
+    assert [p.metadata.labels[LABEL_WORKER] for p in pods] == ["0"]
+    # The surviving pod is the ORIGINAL pod, same incarnation: the gang
+    # reshaped, it did not restart.
+    assert (
+        pods[0].metadata.labels[LABEL_INCARNATION]
+        == incarnation_before[pods[0].metadata.name]
+    )
+    assert batch.status.get("elasticReplicas") == 1
+    assert batch.status.get("restarts", 0) == 0
+    assert batch.status.get("phase") == "Running"
+    assert "resize" not in batch.status and "resizeAck" not in batch.status
+    assert len(_pods(api, "urgent")) == 1
+    reasons = _event_reasons(api)
+    assert "Resized" in reasons
+    # Zero evictions: none of the eviction-path markers fired.
+    assert "Preempted" not in reasons
+    assert "PreemptedLowerPriority" not in reasons
+    assert "GangTornDown" not in reasons
+    assert ctl.gang_restarts.value(job="default/batch") == 0
+    assert ctl.elastic_resizes.value(
+        job="default/batch", direction="shrink"
+    ) == 1
+
+
+def test_unacked_offer_expires_and_falls_back_to_eviction():
+    """A gang that never acks within the grace window gets the rigid
+    treatment: the offer is withdrawn and the eviction path runs."""
+    api, ctl = _world(nodes=2, resize_grace_seconds=0.15)
+    api.create(_job("batch", elastic_min=1))
+    _run(ctl)
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    assert api.get(KIND, "batch").status.get("resize") is not None
+
+    time.sleep(0.3)  # let the offer expire unacked
+    _run(ctl)
+    time.sleep(0.2)
+    _run(ctl)
+    time.sleep(0.6)  # PreemptedBackoff elapses before urgent re-places
+    _run(ctl)
+
+    reasons = _event_reasons(api)
+    assert "ResizeExpired" in reasons
+    assert "Preempted" in reasons  # the fallback actually evicted
+    assert len(_pods(api, "urgent")) == 1
+    batch = api.get(KIND, "batch")
+    assert batch.status.get("phase") == "Pending"
+
+
+def test_rigid_gang_keeps_historical_eviction_semantics():
+    """elasticMinReplicas=0 (the default): no offer, straight to the
+    historical whole-gang eviction."""
+    api, ctl = _world(nodes=2)
+    api.create(_job("batch"))  # rigid
+    _run(ctl)
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    time.sleep(0.6)
+    _run(ctl)
+
+    reasons = _event_reasons(api)
+    assert "ResizeProposed" not in reasons
+    assert "Preempted" in reasons
+    assert len(_pods(api, "urgent")) == 1
+
+
+def test_grow_back_when_capacity_returns():
+    """After the preemptor finishes, the shrunk gang is offered a
+    grow-back; the ack restores it to spec.replicas with the SAME
+    incarnation — the gang never restarted through the whole cycle."""
+    api, ctl = _world(nodes=2, grow_retry_seconds=0.2)
+    api.create(_job("batch", elastic_min=1))
+    _run(ctl)
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    ack_resize(api, "batch")
+    _run(ctl)
+    time.sleep(0.6)  # the preemptor's placement retry is requeue-timed
+    _run(ctl)
+    assert len(_pods(api, "batch")) == 1
+    assert len(_pods(api, "urgent")) == 1  # first claim on freed chips
+
+    # Mark the survivor Running so the gang is healthy, then free the
+    # capacity.
+    _mark_running(api, "batch")
+    api.delete(KIND, "urgent")
+    for p in _pods(api, "urgent"):
+        try:
+            api.delete("Pod", p.metadata.name, "default")
+        except Exception:
+            pass
+    time.sleep(0.3)  # past the post-resize grow backoff
+    _run(ctl)
+
+    batch = api.get(KIND, "batch")
+    proposal = batch.status.get("resize")
+    assert proposal is not None, batch.status
+    assert proposal["replicas"] == 2
+    assert proposal["forJob"] == ""  # capacity, not a preemptor
+
+    assert ack_resize(api, "batch") == 2
+    _run(ctl)
+    time.sleep(0.1)
+    _run(ctl)
+
+    batch = api.get(KIND, "batch")
+    pods = _pods(api, "batch")
+    assert [p.metadata.labels[LABEL_WORKER] for p in pods] == ["0", "1"]
+    assert "elasticReplicas" not in batch.status
+    assert batch.status.get("restarts", 0) == 0
+    # Same incarnation end to end: shrink AND grow without a restart.
+    assert {
+        p.metadata.labels[LABEL_INCARNATION] for p in pods
+    } == {"0"}
+    assert ctl.elastic_resizes.value(
+        job="default/batch", direction="grow"
+    ) == 1
+    # The re-created worker's coordination env reflects the full size.
+    env = {
+        e["name"]: e["value"]
+        for e in pods[1].spec["containers"][0]["env"]
+    }
+    assert env["TPUJOB_NUM_PROCESSES"] == "2"
+
+
+def test_shrunk_gang_is_complete_not_partial():
+    """A gang running at its acked elastic size is COMPLETE: the
+    partial-gang teardown must not fire on it."""
+    api, ctl = _world(nodes=2)
+    api.create(_job("batch", elastic_min=1))
+    _run(ctl)
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    ack_resize(api, "batch")
+    _run(ctl)
+    time.sleep(0.1)
+    _run(ctl, passes=12)
+    assert "GangTornDown" not in _event_reasons(api)
+    assert len(_pods(api, "batch")) == 1
+
+
+def test_stale_shrink_offer_self_heals_when_preemptor_vanishes():
+    """An expired shrink offer whose preemptor is GONE (deleted before
+    ever evicting) must not park the victim mid-handshake forever: the
+    victim's own reconcile withdraws it one grace window past the
+    deadline and normal gang-shape enforcement resumes."""
+    api, ctl = _world(nodes=2, resize_grace_seconds=0.15)
+    api.create(_job("batch", elastic_min=1))
+    _run(ctl)
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    assert api.get(KIND, "batch").status.get("resize") is not None
+
+    api.delete(KIND, "urgent")  # the preemptor never comes back
+    time.sleep(0.4)  # past deadline + one extra grace window
+    _run(ctl)
+    time.sleep(0.2)
+    _run(ctl)
+
+    batch = api.get(KIND, "batch")
+    assert "resize" not in batch.status  # self-healed
+    assert len(_pods(api, "batch")) == 2  # gang untouched throughout
+    assert "Preempted" not in _event_reasons(api)
+
+
+def test_ack_past_deadline_is_refused():
+    """A late ack races the withdrawal — ack_resize treats an expired
+    offer as never made."""
+    api, ctl = _world(nodes=2, resize_grace_seconds=0.1)
+    api.create(_job("batch", elastic_min=1))
+    _run(ctl)
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    assert api.get(KIND, "batch").status.get("resize") is not None
+    time.sleep(0.2)  # past the deadline
+    assert ack_resize(api, "batch") is None
+    assert "resizeAck" not in api.get(KIND, "batch").status
+
+
+def test_shrink_targets_stay_slice_aligned():
+    """A multi-slice gang sheds WHOLE slices: the offered target must
+    satisfy target % num_slices == 0 even when a smaller shrink would
+    free enough chips."""
+    api, ctl = _world(nodes=4)  # 16 chips
+    api.create(_job("batch", replicas=4, elastic_min=1))
+    batch = api.get(KIND, "batch").thaw()
+    batch.spec["tpu"]["numSlices"] = 2
+    api.update(batch)
+    _run(ctl)
+    assert len(_pods(api, "batch")) == 4
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    proposal = api.get(KIND, "batch").status.get("resize")
+    assert proposal is not None
+    # One worker's chips would suffice (target 3), but 3 % 2 != 0 —
+    # the aligned offer sheds a whole slice instead.
+    assert proposal["replicas"] == 2
+
+
+def test_offer_targets_smallest_sufficient_shrink():
+    """A 4-worker elastic gang sheds exactly the workers the preemptor
+    needs, not everything down to its floor."""
+    api, ctl = _world(nodes=4)  # 16 chips
+    api.create(_job("batch", replicas=4, elastic_min=1))
+    _run(ctl)
+    assert len(_pods(api, "batch")) == 4
+    api.create(_job("urgent", priority=10, replicas=1))
+    _run(ctl)
+    proposal = api.get(KIND, "batch").status.get("resize")
+    assert proposal is not None
+    assert proposal["replicas"] == 3  # one worker's chips suffice

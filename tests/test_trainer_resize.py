@@ -1,0 +1,303 @@
+"""Elastic gang resize at the trainer/loop layer (ISSUE 9).
+
+The transition `fit()` runs at a step boundary when the scheduler's
+shrink-to-fit proposal is acked: rebuild the mesh at the new dp
+(`parallel.mesh.resize_spec` spells out the divisor math), re-shard the
+LIVE TrainState across device sets (`Trainer.reshard_state` — no
+checkpoint round-trip), or restore the newest verified checkpoint INTO
+the new topology when a host is already gone (`Restored` states are
+shape-polymorphic on dp because checkpoints hold global arrays). The
+parity tests pin the invariant the e2e soak depends on: the global
+batch — and therefore the training trajectory — is unchanged by any
+sequence of resizes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel import (
+    MeshSpec,
+    build_mesh,
+    mesh_spec_of,
+    resize_spec,
+)
+from kubeflow_tpu.testing.tinymodels import TinyMLP
+from kubeflow_tpu.train import (
+    Checkpointer,
+    ElasticResize,
+    ResizeProposal,
+    SyntheticImages,
+    TrainConfig,
+    Trainer,
+    fit,
+)
+
+CFG = TrainConfig(
+    batch_size=8, learning_rate=0.05, warmup_steps=2, total_steps=24,
+    fsdp_params=False, weight_decay=0.0,
+)
+
+
+def _trainer(dp, devices):
+    mesh = build_mesh(MeshSpec(dp=dp), devices[:dp])
+    return mesh, Trainer(
+        TinyMLP(), CFG, mesh, example_input_shape=(2, 8, 8, 3)
+    )
+
+
+def _l1(state):
+    return sum(
+        float(jnp.sum(jnp.abs(p)))
+        for p in jax.tree_util.tree_leaves(state.params)
+    )
+
+
+def _elastic(plan, devices):
+    """An ElasticResize that applies `plan` (step -> ResizeProposal)."""
+    return ElasticResize(
+        mesh_factory=lambda dp: build_mesh(
+            MeshSpec(dp=dp), devices[:dp]
+        ),
+        data_factory=lambda mesh, data: data.rebind(mesh),
+        propose=lambda step, preempted: plan.get(step),
+    )
+
+
+# -- resize_spec: the divisor math, spelled out -----------------------------
+
+
+def test_resize_spec_device_error_names_the_arithmetic():
+    with pytest.raises(ValueError) as e:
+        resize_spec(MeshSpec(dp=2, tp=2), 5, n_devices=8)
+    msg = str(e.value)
+    assert "dp=5 * tp=2 = 10 devices" in msg
+    assert "only 8 survive" in msg
+    assert "at most 4" in msg
+
+
+def test_resize_spec_batch_error_names_the_arithmetic():
+    with pytest.raises(ValueError) as e:
+        resize_spec(MeshSpec(dp=4), 3, n_devices=8, global_batch=8)
+    msg = str(e.value)
+    assert "8 examples over dp=3" in msg
+    assert "leaves 2 examples over" in msg
+    assert "valid dp: [1, 2, 4, 8]" in msg
+
+
+def test_resize_spec_fsdp_counts_into_batch_shards():
+    with pytest.raises(ValueError, match=r"dp=2 \* fsdp=2"):
+        resize_spec(MeshSpec(dp=4, fsdp=2), 2, global_batch=6)
+    # 8 % (2*2) == 0: fine.
+    spec = resize_spec(MeshSpec(dp=4, fsdp=2), 2, global_batch=8)
+    assert spec == MeshSpec(dp=2, fsdp=2)
+
+
+def test_resize_spec_rejects_degenerate_targets():
+    with pytest.raises(ValueError, match="dp must be >= 1"):
+        resize_spec(MeshSpec(dp=2), 0)
+    with pytest.raises(ValueError, match="fully-resolved"):
+        resize_spec(MeshSpec(dp=2, fsdp=-1), 1)
+
+
+def test_mesh_spec_of_roundtrip(devices):
+    spec = MeshSpec(dp=2, fsdp=2, tp=2)
+    assert mesh_spec_of(build_mesh(spec, devices)) == spec
+
+
+# -- Trainer.resize / reshard_state -----------------------------------------
+
+
+def test_trainer_resize_rejects_model_parallel_change(devices):
+    _, t = _trainer(1, devices)
+    tp_mesh = build_mesh(MeshSpec(dp=1, tp=2), devices[:2])
+    with pytest.raises(ValueError, match="model-parallel"):
+        t.resize(tp_mesh)
+
+
+def test_trainer_resize_rejects_bad_batch_divisor(devices):
+    _, t = _trainer(1, devices)
+    bad = build_mesh(MeshSpec(dp=3), devices[:3])
+    with pytest.raises(ValueError, match="valid dp"):
+        t.resize(bad)
+
+
+def test_reshard_state_preserves_values_across_device_sets(devices):
+    _, t2 = _trainer(2, devices)
+    state = t2.init_state(jax.random.PRNGKey(0))
+    t1 = t2.resize(build_mesh(MeshSpec(dp=1), devices[:1]))
+    resharded = t1.reshard_state(state)
+    # Bit-identical values, new mesh's devices.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state),
+        jax.tree_util.tree_leaves(resharded),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert set(b.sharding.device_set) <= set(devices[:1])
+    # The rebuilt TrainState carries the NEW trainer's static fields.
+    assert resharded.tx is t1.tx
+
+
+def test_fit_elastic_shrink_grow_parity(devices):
+    """A shrink->grow cycle mid-run reaches the SAME final params/loss
+    as the uninterrupted fixed-dp run: the global batch (and so the
+    trajectory) is invariant to the mesh layout."""
+    _, base_t = _trainer(2, devices)
+    base_data = SyntheticImages(
+        base_t.mesh, 8, image_size=8, num_classes=10, seed=3,
+        vary_per_step=True,
+    )
+    base = fit(base_t, base_data, total_steps=24, log_every=100)
+
+    _, t = _trainer(2, devices)
+    data = SyntheticImages(
+        t.mesh, 8, image_size=8, num_classes=10, seed=3,
+        vary_per_step=True,
+    )
+    plan = {
+        8: ResizeProposal(dp=1),
+        16: ResizeProposal(dp=4),
+        20: ResizeProposal(dp=2),
+    }
+    res = fit(
+        t, data, total_steps=24, log_every=100,
+        elastic=_elastic(plan, devices),
+    )
+    assert [(e.from_dp, e.to_dp) for e in res.resizes] == [
+        (2, 1), (1, 4), (4, 2)
+    ]
+    assert all(e.source == "live" for e in res.resizes)
+    np.testing.assert_allclose(_l1(res.state), _l1(base.state), rtol=1e-6)
+    np.testing.assert_allclose(
+        res.history[-1]["loss"], base.history[-1]["loss"], rtol=1e-5
+    )
+    # Zero repeated/skipped batches: position advanced exactly once per
+    # step across every transition.
+    assert data.state_dict()["position"] != 24  # original stream swapped
+    # fit() swapped streams; the LAST stream's position is authoritative
+    # but not reachable here — the e2e asserts the full mapping. What we
+    # can pin: steps_done is exact.
+    assert res.steps_done == 24
+
+
+def test_fit_elastic_checkpoint_fallback_restores_into_new_topology(
+    devices, tmp_path
+):
+    """source='checkpoint': the live state is gone with a dead host —
+    the resize restores the newest VERIFIED checkpoint into the new
+    dp's shardings and replays the few steps since, landing on the
+    identical final state."""
+    _, base_t = _trainer(2, devices)
+    base_data = SyntheticImages(
+        base_t.mesh, 8, image_size=8, num_classes=10, seed=3,
+        vary_per_step=True,
+    )
+    base = fit(base_t, base_data, total_steps=24, log_every=100)
+
+    _, t = _trainer(2, devices)
+    data = SyntheticImages(
+        t.mesh, 8, image_size=8, num_classes=10, seed=3,
+        vary_per_step=True,
+    )
+    ckpt = Checkpointer(tmp_path / "ckpt", save_interval_steps=4)
+    plan = {
+        10: ResizeProposal(dp=1, source="checkpoint"),
+        18: ResizeProposal(dp=2),
+    }
+    res = fit(
+        t, data, total_steps=24, checkpointer=ckpt, log_every=100,
+        elastic=_elastic(plan, devices),
+    )
+    ckpt.close()
+    shrink = res.resizes[0]
+    assert shrink.source == "checkpoint"
+    # The newest save before step 10 was step 8: two steps replayed.
+    assert shrink.restored_step == 8
+    np.testing.assert_allclose(_l1(res.state), _l1(base.state), rtol=1e-6)
+
+
+def test_fit_elastic_checkpoint_fallback_requires_checkpointer(devices):
+    _, t = _trainer(2, devices)
+    data = SyntheticImages(
+        t.mesh, 8, image_size=8, num_classes=10, seed=3,
+        vary_per_step=True,
+    )
+    plan = {4: ResizeProposal(dp=1, source="checkpoint")}
+    with pytest.raises(RuntimeError, match="needs a checkpointer"):
+        fit(
+            t, data, total_steps=8, log_every=100,
+            elastic=_elastic(plan, devices),
+        )
+
+
+def test_restored_checkpoint_is_dp_polymorphic(devices, tmp_path):
+    """The PR 5 claim, proven: a checkpoint saved at dp=4 restores
+    bit-identically onto dp=2 and dp=1 trainers' abstract states —
+    checkpoints hold GLOBAL arrays, the target shardings only say how
+    to lay them out."""
+    _, t4 = _trainer(4, devices)
+    data = SyntheticImages(
+        t4.mesh, 8, image_size=8, num_classes=10, seed=3,
+        vary_per_step=True,
+    )
+    ckpt = Checkpointer(tmp_path / "ckpt", save_interval_steps=4)
+    result = fit(t4, data, total_steps=8, checkpointer=ckpt, log_every=100)
+    ckpt.close()
+
+    for dp in (1, 2, 8):
+        _, t = _trainer(dp, devices)
+        ro = Checkpointer(tmp_path / "ckpt", read_only=True)
+        restored = ro.restore_latest(t.abstract_state())
+        ro.close()
+        assert restored is not None
+        assert restored.step == 8
+        assert restored.data_state == {"position": 8, "salt": 0}
+        for a, b in zip(
+            jax.tree_util.tree_leaves(result.state),
+            jax.tree_util.tree_leaves(restored.state),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for leaf in jax.tree_util.tree_leaves(restored.state):
+            assert set(leaf.sharding.device_set) <= set(devices[:dp])
+
+
+def test_fit_resize_ignores_same_dp_proposal(devices):
+    """A proposal matching the current dp is a no-op (the negotiated
+    mode leaves the last proposal file in place)."""
+    _, t = _trainer(2, devices)
+    data = SyntheticImages(
+        t.mesh, 8, image_size=8, num_classes=10, seed=3,
+        vary_per_step=True,
+    )
+    res = fit(
+        t, data, total_steps=6, log_every=100,
+        elastic=_elastic(
+            {s: ResizeProposal(dp=2) for s in range(1, 6)}, devices
+        ),
+    )
+    assert res.resizes == []
+
+
+def test_guard_state_survives_resize(devices):
+    """The AnomalyGuard's counters ride inside TrainState, so a resize
+    carries them across meshes like any other state leaf."""
+    from kubeflow_tpu.train.guard import AnomalyGuard, GuardConfig
+
+    guard = AnomalyGuard(GuardConfig(warmup_steps=2))
+    mesh = build_mesh(MeshSpec(dp=2), devices[:2])
+    t = Trainer(
+        TinyMLP(), CFG, mesh, example_input_shape=(2, 8, 8, 3),
+        guard=guard,
+    )
+    data = SyntheticImages(
+        mesh, 8, image_size=8, num_classes=10, seed=3, vary_per_step=True
+    )
+    res = fit(
+        t, data, total_steps=12, log_every=100,
+        elastic=_elastic({6: ResizeProposal(dp=1)}, devices),
+    )
+    assert len(res.resizes) == 1
+    assert guard.skipped_total(res.state.guard) == 0
+    assert res.history[-1]["guard_skipped_total"] == 0
