@@ -199,6 +199,42 @@ def main() -> None:
     parser.add_argument("--warmup-steps", type=int, default=5)
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument(
+        "--serving-clients", type=int, default=2000,
+        help="serving only: concurrent closed-loop clients for the "
+        "data-plane phases (steady latency, overload, chaos, roll)",
+    )
+    parser.add_argument(
+        "--serving-requests", type=int, default=6000,
+        help="serving only: total requests per data-plane phase "
+        "(split across --serving-clients)",
+    )
+    parser.add_argument(
+        "--serving-replicas", type=int, default=3,
+        help="serving only: replica fleet size behind the router",
+    )
+    parser.add_argument(
+        "--serving-slo-ms", type=float, default=1500.0,
+        help="serving only: end-to-end latency SLO (incl. bounded 429 "
+        "retries) a request must meet to count toward "
+        "serving_goodput_under_overload",
+    )
+    parser.add_argument(
+        "--serving-chaos",
+        choices=("processes", "local", "off"),
+        default="processes",
+        help="serving only: replica-kill chaos variant — processes = "
+        "SIGKILL a real model-server subprocess mid-load (the honest "
+        "variant, default), local = hard-kill an in-process replica's "
+        "queue (CI-cheap, same router contract), off = skip",
+    )
+    parser.add_argument(
+        "--serving-dataplane-only",
+        action="store_true",
+        help="serving only: skip the single-server engine phases and "
+        "run just the multi-replica data-plane bench (the smoke test's "
+        "mode)",
+    )
+    parser.add_argument(
         "--cp-watchers", type=int, default=50,
         help="controlplane only: streaming watch connections held "
         "against the facade during the fan-out phase",
@@ -347,7 +383,15 @@ def bench_serving(args) -> None:
       force one XLA compile per novel batch size (a compile storm on
       live traffic); buckets cap that at log2(max).
     The reference deferred serving perf outright (docs_dev/tf_serving.md:69).
+
+    The multi-replica DATA-PLANE phases (ISSUE 11) run after the engine
+    phases (or alone with --serving-dataplane-only): steady-state
+    p50/p99 under thousands of concurrent clients, goodput at ~2x
+    capacity, a replica-kill chaos variant gating zero dropped
+    acknowledged requests, and a drain-based checkpoint roll under load.
     """
+    if args.serving_dataplane_only:
+        return _bench_serving_dataplane(args)
     import numpy as np
 
     from kubeflow_tpu.models.resnet import resnet50, tiny_resnet
@@ -647,6 +691,503 @@ def bench_serving(args) -> None:
         f"p99={co_off_p99:.1f}ms {co_off_rps:.0f} req/s",
         file=sys.stderr,
     )
+    _bench_serving_dataplane(args)
+
+
+def _bench_serving_dataplane(args) -> None:
+    """Multi-replica serving data plane (ISSUE 11): ServingDeployment CR
+    -> controller -> replica fleet behind the drain-aware router, driven
+    by thousands of concurrent closed-loop clients. Four phases:
+
+    1. STEADY latency: every client in flight at once, fleet provisioned
+       with 2x headroom — serving_p50/p99_latency_ms.
+    2. OVERLOAD goodput: a deliberately under-provisioned fleet (~2x
+       offered concurrency vs capacity) with bounded client retries on
+       the router's honest Overloaded/Retry-After shed —
+       serving_goodput_under_overload = in-SLO completed / offered.
+    3. ROLL under load: bump spec.modelVersion on the CR and let the
+       threaded controller drain-swap-readmit one replica at a time —
+       serving_checkpoint_roll_seconds, gated on ZERO request failures.
+    4. CHAOS: a seeded ReplicaKillSchedule SIGKILLs a replica (a real
+       model-server subprocess, or an in-process hard queue kill with
+       --serving-chaos local) mid-load; the run hard-fails unless
+       acked == completed and failed == 0 — zero dropped ACKNOWLEDGED
+       requests (shed-before-ack is the 429 path, not a drop).
+
+    Same repro contract as the other soaks: the kill schedule's seed is
+    printed up front and on failure, and --chaos-seed replays it."""
+    import random
+    import threading
+
+    import numpy as np
+
+    from kubeflow_tpu.api import serving as serving_api
+    from kubeflow_tpu.controllers.runtime import ControllerManager
+    from kubeflow_tpu.controllers.serving import ServingDeploymentController
+    from kubeflow_tpu.models.resnet import tiny_resnet
+    from kubeflow_tpu.serving import (
+        LocalReplica,
+        LocalReplicaRuntime,
+        Overloaded,
+        Router,
+        Servable,
+    )
+    from kubeflow_tpu.serving.batching import BatchingConfig
+    from kubeflow_tpu.testing import FakeApiServer
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    clients = max(1, args.serving_clients)
+    n_replicas = max(1, args.serving_replicas)
+    per_client = max(1, args.serving_requests // clients)
+    slo_s = args.serving_slo_ms / 1000.0
+    seed = (
+        args.chaos_seed
+        if args.chaos_seed is not None
+        else random.randrange(2**31)
+    )
+    print(
+        f"# serving dataplane seed={seed} clients={clients} "
+        f"requests/client={per_client} replicas={n_replicas} "
+        f"chaos={args.serving_chaos}",
+        file=sys.stderr,
+    )
+
+    # The model under test is deliberately tiny and CPU-pinned: the data
+    # plane (queueing, routing, draining) is what's measured, and on a
+    # tunneled chip every execution would pay the ~100ms dispatch RTT
+    # that the engine phases above already characterize.
+    cpu = jax.devices("cpu")[0]
+    tiny = tiny_resnet(num_classes=10)
+    tiny_vars = jax.jit(tiny.init)(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32)
+    )
+
+    def factory(rspec: dict):
+        return Servable.from_module(
+            rspec.get("model", "demo"), tiny, tiny_vars,
+            version=int(rspec.get("modelVersion") or 1),
+            max_batch=int(rspec.get("maxBatch", 32)),
+            warmup_example=np.zeros((32, 32, 3), np.float32),
+            device=cpu,
+            train=False,
+        )
+
+    # -- fleet via the CR path: ServingDeployment -> controller -> router
+    metrics = MetricsRegistry()
+    router = Router(metrics, dispatch_timeout_s=120.0)
+    runtime = LocalReplicaRuntime(router, factory, metrics)
+    api = FakeApiServer()
+    controller = ServingDeploymentController(
+        api, runtime=runtime, metrics=metrics, resync_seconds=0.05
+    )
+    # 2x headroom: steady/roll/chaos phases must never shed (a shed
+    # during chaos would hide a dropped acked request behind a 429).
+    max_pending = max(64, (2 * clients + n_replicas - 1) // n_replicas)
+    api.create(
+        serving_api.make_serving_deployment(
+            "bench",
+            replicas=n_replicas,
+            max_batch=32,
+            batch_timeout_ms=2.0,
+            max_pending=max_pending,
+            model_version=1,
+        )
+    )
+    controller.controller.run_until_idle()
+    if len(router.ready_names()) != n_replicas:
+        raise SystemExit(
+            f"serving bench: fleet failed to come up "
+            f"({router.ready_names()} ready, want {n_replicas})"
+        )
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 32, 32, 3).astype(np.float32)
+    lock = threading.Lock()
+
+    def run_clients(n, fn):
+        threads = [
+            threading.Thread(target=fn, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    # -- phase 1: steady-state latency, every client in flight at once
+    lat: list[float] = []
+
+    def steady_client(_i):
+        local = []
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            router.predict(x)
+            local.append(time.perf_counter() - t0)
+        with lock:
+            lat.extend(local)
+
+    steady_wall = run_clients(clients, steady_client)
+    lat.sort()
+    p50_ms = lat[len(lat) // 2] * 1000
+    p99_ms = lat[int(len(lat) * 0.99)] * 1000
+    steady_rps = len(lat) / steady_wall
+
+    # -- phase 2: goodput under ~2x overload, separate small fleet so
+    # the main fleet's zero-shed accounting stays clean
+    ov_metrics = MetricsRegistry()
+    ov_router = Router(ov_metrics)
+    ov_cap = max(1, clients // (2 * n_replicas))  # sum ~= clients/2
+    for i in range(n_replicas):
+        ov_router.add(
+            LocalReplica(
+                f"ov-{i}",
+                factory({"model": "demo", "maxBatch": 32}),
+                BatchingConfig(
+                    max_batch=32, timeout_ms=2.0, max_pending=ov_cap
+                ),
+                ov_metrics,
+            )
+        )
+    good = [0]
+
+    def overload_client(_i):
+        g = 0
+        for _ in range(per_client):
+            t0 = time.perf_counter()
+            ok = False
+            for _attempt in range(3):  # bounded retries on honest 429s
+                try:
+                    ov_router.predict(x)
+                    ok = True
+                    break
+                except Overloaded as e:
+                    time.sleep(min(e.retry_after, 0.25))
+            if ok and time.perf_counter() - t0 <= slo_s:
+                g += 1
+        with lock:
+            good[0] += g
+
+    overload_wall = run_clients(clients, overload_client)
+    offered = clients * per_client
+    goodput = good[0] / offered
+    shed = int(ov_router.shed_total.value())
+    for name in ov_router.replica_names():
+        replica = ov_router.replica(name)
+        ov_router.remove(name)
+        replica.close()
+
+    # -- phase 3: drain-based checkpoint roll under load (CR version
+    # bump -> threaded controller -> one-replica-at-a-time drain/swap)
+    failed_before_roll = router.failed_total.value()
+    mgr = ControllerManager()
+    mgr.add(controller.controller)
+    mgr.start()
+    stop_load = threading.Event()
+
+    def roll_load(_i):
+        while not stop_load.is_set():
+            try:
+                router.predict(x)
+            except Overloaded as e:
+                time.sleep(min(e.retry_after, 0.1))
+
+    roll_clients = min(clients, 256)
+    load_threads = [
+        threading.Thread(target=roll_load, args=(i,), daemon=True)
+        for i in range(roll_clients)
+    ]
+    for t in load_threads:
+        t.start()
+    dep = api.get(serving_api.KIND, "bench", "default").thaw()
+    spec = dict(dep.spec)
+    spec["modelVersion"] = 2
+    dep.spec = spec
+    api.update(dep)
+    t0 = time.perf_counter()
+    deadline = t0 + 120.0
+    names = [serving_api.replica_name("bench", i) for i in range(n_replicas)]
+    while time.perf_counter() < deadline:
+        versions = [
+            (runtime.stats(n) or {}).get("version") for n in names
+        ]
+        if all(v == 2 for v in versions):
+            break
+        time.sleep(0.02)
+    roll_seconds = time.perf_counter() - t0
+    stop_load.set()
+    for t in load_threads:
+        t.join()
+    mgr.stop()
+    versions = [(runtime.stats(n) or {}).get("version") for n in names]
+    if not all(v == 2 for v in versions):
+        raise SystemExit(
+            f"serving bench: checkpoint roll did not converge "
+            f"(versions={versions})"
+        )
+    roll_failures = int(
+        router.failed_total.value() - failed_before_roll
+    )
+    if roll_failures:
+        raise SystemExit(
+            f"serving bench: {roll_failures} requests FAILED during the "
+            f"drain-based roll — a roll must be zero-downtime"
+        )
+
+    # -- phase 4: replica-kill chaos — zero dropped acked requests
+    chaos_row = None
+    if args.serving_chaos != "off":
+        chaos_row = _serving_chaos_phase(
+            args, seed, clients, per_client, x, factory,
+            main_router=router, max_pending=max_pending,
+        )
+
+    # -- rows
+    rows = [
+        (
+            "serving_p50_latency_ms",
+            round(p50_ms, 1),
+            f"ms p50, {clients} concurrent batch-1 clients over "
+            f"{n_replicas} continuous-batching replicas (lower is "
+            "better)",
+            _published_baseline("serving_p50_latency_ms"),
+        ),
+        (
+            "serving_p99_latency_ms",
+            round(p99_ms, 1),
+            f"ms p99, same steady phase (lower is better)",
+            _published_baseline("serving_p99_latency_ms"),
+        ),
+        (
+            "serving_goodput_under_overload",
+            round(goodput, 4),
+            f"in-SLO completed / offered at ~2x capacity with bounded "
+            f"retries, SLO {args.serving_slo_ms:.0f}ms (higher is "
+            "better)",
+            _published_baseline("serving_goodput_under_overload"),
+        ),
+        (
+            "serving_checkpoint_roll_seconds",
+            round(roll_seconds, 2),
+            f"full-fleet drain-based model roll under load, zero "
+            f"failures (lower is better)",
+            _published_baseline("serving_checkpoint_roll_seconds"),
+        ),
+    ]
+    for metric, value, unit, base in rows:
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": unit,
+                    "vs_baseline": (
+                        round(value / base, 4) if base else None
+                    ),
+                }
+            )
+        )
+    if chaos_row is not None:
+        print(json.dumps(chaos_row))
+    print(
+        f"# serving dataplane: steady {steady_rps:.0f} req/s "
+        f"p50={p50_ms:.1f}ms p99={p99_ms:.1f}ms; overload goodput "
+        f"{goodput:.3f} ({good[0]}/{offered} in SLO, {shed} shed, "
+        f"{overload_wall:.1f}s); roll {roll_seconds:.2f}s "
+        f"(0 failures); seed={seed}",
+        file=sys.stderr,
+    )
+
+
+def _serving_chaos_phase(
+    args, seed, clients, per_client, x, factory, *, main_router,
+    max_pending,
+):
+    """Kill a replica mid-load and prove the ack contract: every
+    acknowledged request completes (failed == 0) — the deaths convert
+    into idempotent retries on survivors, never into drops. Returns the
+    serving_chaos_acked_requests row, or raises SystemExit with the
+    repro seed on violation."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import threading
+
+    from kubeflow_tpu.serving import HttpReplica, Overloaded, Router
+    from kubeflow_tpu.testing.chaos import ReplicaKillSchedule
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    n_replicas = max(1, args.serving_replicas)
+    sched = ReplicaKillSchedule(seed, kills=1, replicas=n_replicas)
+    procs: list = []
+
+    if args.serving_chaos == "processes":
+        # Real model-server subprocesses; the kill is an actual SIGKILL.
+        def free_port() -> int:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        ports = []
+        for i in range(n_replicas):
+            port = free_port()
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "kubeflow_tpu.serving",
+                        "--host", "127.0.0.1", "--port", str(port),
+                        "--max-batch", "32", "--batch-timeout-ms", "2",
+                    ],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            )
+            ports.append(port)
+        # Readiness: the demo model answers its status endpoint.
+        import http.client as _http
+
+        deadline = time.monotonic() + 180.0
+        for port in ports:
+            while True:
+                try:
+                    conn = _http.HTTPConnection(
+                        "127.0.0.1", port, timeout=2.0
+                    )
+                    conn.request("GET", "/v1/models/demo")
+                    ok = conn.getresponse().status == 200
+                    conn.close()
+                    if ok:
+                        break
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    for p in procs:
+                        p.kill()
+                    raise SystemExit(
+                        "serving bench: model-server subprocess on "
+                        f":{port} never became ready"
+                    )
+                time.sleep(0.2)
+        ch_metrics = MetricsRegistry()
+        ch_router = Router(ch_metrics, dispatch_timeout_s=120.0)
+        for i, port in enumerate(ports):
+            ch_router.add(
+                HttpReplica(
+                    f"proc-{i}", f"127.0.0.1:{port}", "demo",
+                    capacity=max_pending,
+                )
+            )
+
+        def kill_victim(name: str) -> None:
+            idx = int(name.rsplit("-", 1)[1])
+            os.kill(procs[idx].pid, signal.SIGKILL)
+            procs[idx].wait()
+    else:
+        # Local variant: the in-process hard kill fails in-flight
+        # callers exactly the way a SIGKILL resets connections.
+        ch_router = main_router
+
+        def kill_victim(name: str) -> None:
+            ch_router.replica(name).kill()
+
+    acked0 = ch_router.acked_total.value()
+    completed0 = ch_router.completed_total.value()
+    failed0 = ch_router.failed_total.value()
+    total = clients * per_client
+    done = [0]
+    lock = threading.Lock()
+
+    def chaos_client(_i):
+        for _ in range(per_client):
+            while True:
+                try:
+                    ch_router.predict(x)
+                    break
+                except Overloaded as e:
+                    time.sleep(min(e.retry_after, 0.1))
+            with lock:
+                done[0] += 1
+
+    threads = [
+        threading.Thread(target=chaos_client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    finished = threading.Event()
+
+    def monitor():
+        while not finished.is_set() and not sched.exhausted:
+            with lock:
+                frac = done[0] / total
+            kill = sched.due(frac)
+            if kill is not None:
+                ready = ch_router.ready_names()
+                if not ready:
+                    continue
+                victim = ready[kill.victim % len(ready)]
+                print(
+                    f"# chaos: SIGKILL replica {victim} at "
+                    f"{frac:.0%} of load",
+                    file=sys.stderr,
+                )
+                kill_victim(victim)
+                sched.mark_injected(kill)
+            time.sleep(0.002)
+
+    mon = threading.Thread(target=monitor, daemon=True)
+    mon.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    finished.set()
+    mon.join()
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+            p.wait()
+
+    acked = int(ch_router.acked_total.value() - acked0)
+    completed = int(ch_router.completed_total.value() - completed0)
+    failed = int(ch_router.failed_total.value() - failed0)
+    retried = int(ch_router.retried_total.value())
+    coverage = sched.coverage()
+    if failed != 0 or acked != completed:
+        print(
+            f"# serving chaos FAILED: acked={acked} completed="
+            f"{completed} failed={failed} (seed {seed}) — reproduce "
+            f"the exact kill schedule with:\n"
+            f"#   python bench.py --workload serving "
+            f"--serving-dataplane-only --chaos-seed {seed}",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    if not sched.exhausted:
+        raise SystemExit(
+            f"serving chaos: kill plan not exhausted "
+            f"(coverage={coverage}) — the run proved nothing"
+        )
+    print(
+        f"# chaos[{args.serving_chaos}]: {acked} acked == {completed} "
+        f"completed, 0 failed, {retried} dispatches retried across "
+        f"replica death (coverage={coverage})",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "serving_chaos_acked_requests",
+        "value": acked,
+        "unit": (
+            f"acked requests, {args.serving_chaos} replica kill "
+            f"mid-load, zero dropped (failed={failed}, "
+            f"retried={retried})"
+        ),
+        "vs_baseline": None,  # a gate (failed==0), not a ratio
+    }
 
 
 def bench_chaos(args) -> None:

@@ -1,0 +1,227 @@
+"""ServingDeployment: the platform's online-serving CRD.
+
+The serving-side analog of ``TpuJob``: one CR declares a fleet of model
+replicas (each a `Servable` behind a continuous `BatchingQueue`) that the
+serving controller reconciles into N replica workers behind the
+drain-aware router (docs/serving.md). Differences from TF-Serving's
+deployment shape are deliberate (docs/parity.md): replica config is
+pushed through the watch machinery via owned ``ServingReplica`` objects
+instead of a sidecar re-polling a filesystem model-config, and checkpoint
+rolls are coordinated by the controller draining one replica at a time
+rather than loading two versions side-by-side in every worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+from kubeflow_tpu.api.objects import Resource, new_resource
+
+KIND = "ServingDeployment"
+# Owned per-replica object: the config-push channel (controller writes
+# spec, replica worker watches it and stamps status.ready / queue stats).
+REPLICA_KIND = "ServingReplica"
+
+LABEL_DEPLOYMENT = "serving.kubeflow-tpu.dev/deployment"
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleSpec:
+    """Queue-signal-driven target-replica policy.
+
+    The controller computes ``targetReplicas`` from the fleet's aggregate
+    queue depth (the `BatchingQueue` gauges are the input signal) and
+    surfaces it through status; replica count then converges to it.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    # Desired steady-state queued requests per replica. Depth above this
+    # scales out; an idle fleet settles back to min_replicas.
+    target_queue_depth: int = 32
+
+    def validate(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"autoscale.minReplicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"autoscale.maxReplicas ({self.max_replicas}) must be >= "
+                f"minReplicas ({self.min_replicas})"
+            )
+        if self.target_queue_depth < 1:
+            raise ValueError(
+                f"autoscale.targetQueueDepth must be >= 1, got "
+                f"{self.target_queue_depth}"
+            )
+
+    def target(self, total_queue_depth: int) -> int:
+        """Desired replica count for an observed fleet-wide queue depth."""
+        want = math.ceil(total_queue_depth / self.target_queue_depth)
+        return max(self.min_replicas, min(self.max_replicas, want))
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingDeploymentSpec:
+    """Typed view over a ServingDeployment's spec dict."""
+
+    model: str = "model"
+    replicas: int = 1
+    max_batch: int = 64
+    batch_timeout_ms: float = 5.0
+    max_pending: int = 1024
+    # Continuous batching (ISSUE 11): late-admit compatible arrivals into
+    # the in-flight flush window. Off = the original cut-and-wait cycle
+    # (kept selectable so the bench can publish the delta honestly).
+    continuous: bool = True
+    # Where replica workers restore the model from. Empty = the replica
+    # runtime's built-in demo model (dev/bench shape).
+    checkpoint_dir: str = ""
+    # Desired live model version (the checkpoint step). 0 = whatever the
+    # replica loaded; a bump triggers a one-replica-at-a-time drain-based
+    # roll (zero downtime — the rest of the fleet keeps admitting).
+    model_version: int = 0
+    autoscale: AutoscaleSpec | None = None
+
+    def validate(self) -> None:
+        if not self.model:
+            raise ValueError("model name must be non-empty")
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.max_batch < 1:
+            raise ValueError(f"maxBatch must be >= 1, got {self.max_batch}")
+        if self.batch_timeout_ms < 0:
+            raise ValueError("batching.timeoutMs must be >= 0")
+        if self.max_pending < 1:
+            raise ValueError("batching.maxPending must be >= 1")
+        if self.model_version < 0:
+            raise ValueError("modelVersion must be >= 0")
+        if self.autoscale is not None:
+            self.autoscale.validate()
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "model": self.model,
+            "replicas": self.replicas,
+            "maxBatch": self.max_batch,
+            "batching": {
+                "timeoutMs": self.batch_timeout_ms,
+                "maxPending": self.max_pending,
+                "continuous": self.continuous,
+            },
+            "checkpointDir": self.checkpoint_dir,
+            "modelVersion": self.model_version,
+            "autoscale": (
+                {
+                    "minReplicas": self.autoscale.min_replicas,
+                    "maxReplicas": self.autoscale.max_replicas,
+                    "targetQueueDepth": self.autoscale.target_queue_depth,
+                }
+                if self.autoscale is not None
+                else None
+            ),
+        }
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ServingDeploymentSpec":
+        # Strict field validation (same contract as TpuJobSpec): a typo'd
+        # field silently dropped would leave e.g. a fleet that never
+        # autoscales, with nothing pointing at the cause.
+        unknown = set(d) - KNOWN_FIELDS
+        if unknown:
+            raise ValueError(
+                f"unknown ServingDeployment spec field(s) {sorted(unknown)}; "
+                f"known: {sorted(KNOWN_FIELDS)}"
+            )
+        batching = d.get("batching") or {}
+        if not isinstance(batching, dict):
+            raise ValueError(
+                f"spec.batching must be a mapping "
+                f"(timeoutMs/maxPending/continuous), got {batching!r}"
+            )
+        unknown_b = set(batching) - KNOWN_BATCHING_FIELDS
+        if unknown_b:
+            raise ValueError(
+                f"unknown spec.batching field(s) {sorted(unknown_b)}; "
+                f"known: {sorted(KNOWN_BATCHING_FIELDS)}"
+            )
+        autoscale_d = d.get("autoscale")
+        autoscale = None
+        if autoscale_d is not None:
+            if not isinstance(autoscale_d, dict):
+                raise ValueError(
+                    f"spec.autoscale must be a mapping, got {autoscale_d!r}"
+                )
+            unknown_a = set(autoscale_d) - KNOWN_AUTOSCALE_FIELDS
+            if unknown_a:
+                raise ValueError(
+                    f"unknown spec.autoscale field(s) {sorted(unknown_a)}; "
+                    f"known: {sorted(KNOWN_AUTOSCALE_FIELDS)}"
+                )
+            autoscale = AutoscaleSpec(
+                min_replicas=int(autoscale_d.get("minReplicas", 1)),
+                max_replicas=int(autoscale_d.get("maxReplicas", 1)),
+                target_queue_depth=int(
+                    autoscale_d.get("targetQueueDepth", 32)
+                ),
+            )
+        spec = cls(
+            model=d.get("model", "model"),
+            replicas=int(d.get("replicas", 1)),
+            max_batch=int(d.get("maxBatch", 64)),
+            batch_timeout_ms=float(batching.get("timeoutMs", 5.0)),
+            max_pending=int(batching.get("maxPending", 1024)),
+            continuous=bool(batching.get("continuous", True)),
+            checkpoint_dir=d.get("checkpointDir", ""),
+            model_version=int(d.get("modelVersion", 0)),
+            autoscale=autoscale,
+        )
+        spec.validate()
+        return spec
+
+
+# Derived from the serializer so the allowlists can never drift from what
+# to_dict emits (same rationale as tpujob.py).
+KNOWN_FIELDS = frozenset(ServingDeploymentSpec().to_dict())
+KNOWN_BATCHING_FIELDS = frozenset(
+    ServingDeploymentSpec().to_dict()["batching"]
+)
+KNOWN_AUTOSCALE_FIELDS = frozenset(("minReplicas", "maxReplicas",
+                                    "targetQueueDepth"))
+
+
+def replica_name(deployment: str, index: int) -> str:
+    return f"{deployment}-replica-{index}"
+
+
+def replica_spec(spec: ServingDeploymentSpec) -> dict[str, Any]:
+    """The per-replica config the controller pushes through the owned
+    ServingReplica object (the PR 2 watch machinery is the transport:
+    the replica worker watches its own object and reacts to spec
+    changes — model rolls, batching re-tunes — without re-listing)."""
+    return {
+        "model": spec.model,
+        "maxBatch": spec.max_batch,
+        "batching": {
+            "timeoutMs": spec.batch_timeout_ms,
+            "maxPending": spec.max_pending,
+            "continuous": spec.continuous,
+        },
+        "checkpointDir": spec.checkpoint_dir,
+        "modelVersion": spec.model_version,
+    }
+
+
+def make_serving_deployment(
+    name: str, namespace: str = "default", **spec_kwargs
+) -> Resource:
+    autoscale = spec_kwargs.pop("autoscale", None)
+    if isinstance(autoscale, dict):
+        autoscale = AutoscaleSpec(**autoscale)
+    spec = ServingDeploymentSpec(autoscale=autoscale, **spec_kwargs)
+    spec.validate()
+    return new_resource(KIND, name, namespace, spec=spec.to_dict())

@@ -352,6 +352,139 @@ def _build_serving_batch() -> Program:
     return Program(hlo=compiled_hlo(sv._jitted, sv.variables, x))
 
 
+def _build_serving_batch_continuous() -> Program:
+    """The continuous-batching flush step (ISSUE 11): late admission
+    actually engages (a request arriving after the cut rides the group
+    that is about to execute), turning it off restores cut-and-wait, the
+    executed bucket program still carries zero collectives, and the
+    flush path performs no host sync (no block_until_ready/device_get —
+    a sync in the scheduler loop would serialize every flush against
+    device completion)."""
+    import ast as ast_mod
+    import pathlib
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.serving import batching as batching_mod
+    from kubeflow_tpu.serving.batching import BatchingConfig, BatchingQueue
+    from kubeflow_tpu.serving.servable import Servable
+    from kubeflow_tpu.testing.hlo import compiled_hlo
+    from kubeflow_tpu.testing.tinymodels import TinyMLP
+
+    def drive(continuous: bool) -> list[tuple[int, int]]:
+        """Choreograph a flush: group X (width 2) blocks mid-execution
+        while a second width-3 request arrives; with continuous batching
+        it must ride group Y's execution in the SAME flush window.
+        Returns (signature_width, batch_rows) per servable call."""
+        gate = threading.Event()
+        x_running = threading.Event()
+        calls: list[tuple[int, int]] = []
+
+        class _Probe:
+            name = "contract-continuous"
+            version = 1
+
+            def predict(self, batch):
+                arr = np.asarray(batch)
+                calls.append((arr.shape[1], arr.shape[0]))
+                if arr.shape[1] == 2:
+                    x_running.set()
+                    gate.wait(10)
+                return arr
+
+        queue = BatchingQueue(
+            _Probe(),
+            BatchingConfig(
+                max_batch=2, timeout_ms=2000.0, continuous=continuous
+            ),
+        )
+
+        def wait_for_depth(n: int) -> None:
+            deadline = time.monotonic() + 10
+            while queue.stats()["queue_depth"] != n:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("batching choreography stalled")
+                time.sleep(0.001)
+
+        threads = []
+
+        def submit(width: int) -> None:
+            t = threading.Thread(
+                target=queue.predict,
+                args=(np.zeros((1, width), np.float32),),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+
+        submit(2)            # x1 — pending first, so group X runs first
+        wait_for_depth(1)
+        submit(3)            # y1 — fills max_batch, cuts the flush
+        if not x_running.wait(10):
+            raise TimeoutError("group X never started executing")
+        submit(3)            # y2 — arrives AFTER the cut
+        wait_for_depth(1)    # ... and sits pending
+        gate.set()           # group Y executes next: late-admits y2?
+        for t in threads:
+            t.join(timeout=10)
+        queue.close()
+        return calls
+
+    continuous_calls = drive(continuous=True)
+    cutwait_calls = drive(continuous=False)
+
+    # AST scan of the flush path: every scheduler-side function must be
+    # present (a rename would silently exempt it) and free of host sync.
+    flush_fns = {
+        "_take_batch", "_cut_locked", "_admit_late",
+        "_record_wait_locked", "_loop", "_run_group",
+    }
+    tree = ast_mod.parse(
+        pathlib.Path(batching_mod.__file__).read_text()
+    )
+    found: set = set()
+    syncs: list[str] = []
+    for node in ast_mod.walk(tree):
+        if (
+            isinstance(node, ast_mod.FunctionDef)
+            and node.name in flush_fns
+        ):
+            found.add(node.name)
+            for sub in ast_mod.walk(node):
+                if isinstance(sub, ast_mod.Attribute) and sub.attr in (
+                    "block_until_ready", "device_get", "device_put",
+                ):
+                    syncs.append(f"{node.name}: .{sub.attr}")
+                if isinstance(sub, ast_mod.Name) and sub.id == "jax":
+                    syncs.append(f"{node.name}: jax")
+
+    # The program the flush executes — one servable bucket at the merged
+    # window size; the wire contract is unchanged by late admission.
+    model = TinyMLP()
+    x = jnp.zeros((4, 8, 8, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    sv = Servable(
+        name="contract", apply_fn=model.apply, variables=variables,
+        max_batch=4,
+    )
+    return Program(
+        hlo=compiled_hlo(sv._jitted, sv.variables, x),
+        meta={
+            # y1+y2 merged into one width-3 execution of 2 rows.
+            "continuous_admitted": (3, 2) in continuous_calls,
+            # Off restores cut-and-wait: y2 runs in its own later flush.
+            "cut_and_wait_no_late": (3, 2) not in cutwait_calls
+            and cutwait_calls.count((3, 1)) == 2,
+            "no_host_sync_in_flush": not syncs and found == flush_fns,
+            "host_syncs": syncs,
+        },
+    )
+
+
 # -- the table --------------------------------------------------------------
 
 CONTRACTS: tuple[ProgramContract, ...] = (
@@ -418,6 +551,20 @@ CONTRACTS: tuple[ProgramContract, ...] = (
         forbid_collectives=(
             "all-gather", "reduce-scatter", "all-reduce",
             "collective-permute", "all-to-all",
+        ),
+    ),
+    ProgramContract(
+        name="serving-batch-continuous",
+        description="continuous-batching flush: late admission "
+        "engages, zero collectives, no host sync in the flush path",
+        build=_build_serving_batch_continuous,
+        forbid_collectives=(
+            "all-gather", "reduce-scatter", "all-reduce",
+            "collective-permute", "all-to-all",
+        ),
+        meta_true=(
+            "continuous_admitted", "cut_and_wait_no_late",
+            "no_host_sync_in_flush",
         ),
     ),
 )

@@ -727,6 +727,7 @@ class EndpointListClients(Rule):
         "kubeflow_tpu/controllers/__main__.py",
         "kubeflow_tpu/controllers/webhook.py",
         "kubeflow_tpu/deploy/worker.py",
+        "kubeflow_tpu/serving/__main__.py",
         "kubeflow_tpu/sidecar/__main__.py",
     )
 

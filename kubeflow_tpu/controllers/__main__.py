@@ -31,6 +31,7 @@ from kubeflow_tpu.controllers.nodehealth import NodeHealthController
 from kubeflow_tpu.controllers.notebook import NotebookController
 from kubeflow_tpu.controllers.profile import ProfileController
 from kubeflow_tpu.controllers.runtime import ControllerManager
+from kubeflow_tpu.controllers.serving import ServingDeploymentController
 from kubeflow_tpu.controllers.study import StudyController
 from kubeflow_tpu.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controllers.tpujob import TpuJobController
@@ -45,6 +46,7 @@ CONTROLLERS = {
     "study": StudyController,
     "workflow": WorkflowController,
     "cronworkflow": CronWorkflowController,
+    "serving": ServingDeploymentController,
 }
 
 

@@ -1,0 +1,380 @@
+"""Serving controller: reconciles a ServingDeployment into replica workers.
+
+The serving-side sibling of the TpuJob operator: one CR declares the
+fleet (`api/serving.py`), this controller materializes it —
+
+- one owned ``ServingReplica`` object per replica index. The replica
+  object is the **config-push channel** (the PR 2 watch machinery is the
+  transport): the controller writes the rendered per-replica spec
+  (model, batching knobs, modelVersion), replica workers watch their own
+  object and react — no re-list, no config files. In-process fleets
+  (`LocalReplicaRuntime`) are driven directly through the runtime.
+- per-replica readiness and queue stats are aggregated into CR status
+  (``status.replicas[*].ready``, ``readyReplicas``), so `kubectl get`
+  answers "is the model up" the way it does for a Deployment.
+- the fleet-wide queue depth (the `BatchingQueue` gauges, via
+  `Router.stats`) feeds ``spec.autoscale`` → ``status.targetReplicas``,
+  and replica count converges to the target.
+- a ``spec.modelVersion`` bump triggers a drain-based checkpoint roll,
+  ONE replica at a time and only while the rest of the fleet is ready —
+  zero-downtime hot swap (docs/serving.md; the bench's roll row measures
+  it under thousands of concurrent clients).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.api import serving as serving_api
+from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
+from kubeflow_tpu.controllers.runtime import (
+    Controller,
+    Key,
+    Result,
+    retry_on_conflict,
+)
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer, NotFound
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+def default_runtime(metrics: MetricsRegistry | None = None):
+    """In-process replica fleet serving the demo model — the
+    single-binary dev shape (`python -m kubeflow_tpu.controllers`).
+    Production replicas are separate processes
+    (`python -m kubeflow_tpu.serving --apiserver ...`); tests and the
+    bench inject their own factory."""
+    from kubeflow_tpu.serving.replica import LocalReplicaRuntime
+    from kubeflow_tpu.serving.router import Router
+
+    def factory(rspec: dict):
+        # jax lands only when a replica is actually materialized — a
+        # manager that never sees a ServingDeployment stays light.
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.models.resnet import tiny_resnet
+        from kubeflow_tpu.serving.servable import Servable
+
+        module = tiny_resnet(num_classes=10)
+        variables = jax.jit(module.init)(
+            jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32)
+        )
+        return Servable.from_module(
+            rspec.get("model", "demo"),
+            module,
+            variables,
+            version=int(rspec.get("modelVersion") or 1),
+            max_batch=int(rspec.get("maxBatch", 64)),
+            train=False,
+        )
+
+    return LocalReplicaRuntime(Router(metrics), factory, metrics)
+
+
+class ServingDeploymentController:
+    """Reconciler + the runtime that hosts/drives the actual replicas."""
+
+    def __init__(
+        self,
+        api: FakeApiServer,
+        runtime=None,
+        metrics: MetricsRegistry | None = None,
+        resync_seconds: float = 1.0,
+    ):
+        self.api = api
+        metrics = metrics or MetricsRegistry()
+        self.runtime = (
+            runtime if runtime is not None else default_runtime(metrics)
+        )
+        self.resync_seconds = resync_seconds
+        self.ready_replicas = metrics.gauge(
+            "serving_ready_replicas",
+            "replicas ready to admit traffic",
+            ("deployment",),
+        )
+        self.rolls_total = metrics.counter(
+            "serving_rolls_total",
+            "drain-based model version rolls completed",
+            ("deployment",),
+        )
+        self.controller = Controller(
+            api,
+            serving_api.KIND,
+            self.reconcile,
+            owns=(serving_api.REPLICA_KIND,),
+            name="serving-controller",
+            metrics=metrics,
+        )
+
+    # -- replica materialization ------------------------------------------
+
+    def _ensure_replica_resource(
+        self, api, dep: Resource, rname: str, rspec: dict
+    ) -> None:
+        try:
+            existing = api.get(
+                serving_api.REPLICA_KIND, rname, dep.metadata.namespace
+            )
+        except NotFound:
+            replica = new_resource(
+                serving_api.REPLICA_KIND,
+                rname,
+                dep.metadata.namespace,
+                spec=rspec,
+                labels={serving_api.LABEL_DEPLOYMENT: dep.metadata.name},
+            )
+            replica.metadata.owner_references = [owner_ref(dep)]
+            api.create(replica)
+            return
+        if existing.spec != rspec:
+            # Config push: the spec change rides the watch stream to the
+            # replica worker (model roll, batching re-tune).
+            fresh = existing.thaw()
+            fresh.spec = dict(rspec)
+            api.update(fresh)
+
+    def _stamp_replica_status(self, api, ns: str, rname: str, stats: dict):
+        def write():
+            try:
+                fresh = api.get(serving_api.REPLICA_KIND, rname, ns).thaw()
+            except NotFound:
+                return
+            new_status = dict(fresh.status)
+            new_status.update(
+                {
+                    "ready": bool(stats.get("ready")),
+                    "version": int(stats.get("version") or 0),
+                    "queueDepth": int(stats.get("queue_depth") or 0),
+                    "inflight": int(stats.get("inflight") or 0),
+                    "queueWaitMs": stats.get("queue_wait_ms", 0.0),
+                }
+            )
+            if new_status != fresh.status:
+                fresh.status = new_status
+                api.update_status(fresh)
+
+        retry_on_conflict(write)
+
+    def _teardown(self, api, ns: str, name: str) -> None:
+        for replica in api.list(
+            serving_api.REPLICA_KIND,
+            ns,
+            label_selector={serving_api.LABEL_DEPLOYMENT: name},
+        ):
+            self._stop_replica(api, ns, replica.metadata.name)
+        # The apiserver's owner-reference cascade may have deleted the
+        # replica objects with the deployment — the runtime replicas
+        # behind them still need stopping.
+        names = getattr(self.runtime, "names", None)
+        if names is not None:
+            prefix = serving_api.replica_name(name, 0)[: -len("0")]
+            for rname in list(names()):
+                if rname.startswith(prefix):
+                    self._stop_replica(api, ns, rname)
+
+    def _stop_replica(self, api, ns: str, rname: str) -> None:
+        stop = getattr(self.runtime, "stop", None)
+        if stop is not None:
+            stop(rname)
+        try:
+            api.delete(serving_api.REPLICA_KIND, rname, ns)
+        except NotFound:
+            pass
+
+    # -- reconcile --------------------------------------------------------
+
+    def reconcile(self, api: FakeApiServer, key: Key) -> Result:
+        ns, name = key
+        try:
+            dep = api.get(serving_api.KIND, name, ns)
+        except NotFound:
+            self._teardown(api, ns, name)
+            return Result()
+        try:
+            spec = serving_api.ServingDeploymentSpec.from_dict(dep.spec)
+        except Exception as e:
+            # Client-writable spec: a parse failure is terminal, not a
+            # crash-loop.
+            api.record_event(dep, "InvalidSpec", str(e), type_="Warning")
+            return self._update_status(
+                api, dep, phase="Failed", reason=str(e)
+            )
+
+        rspec = serving_api.replica_spec(spec)
+
+        # Autoscale on the observed fleet queue signal (queued + already
+        # executing — both represent demand a bigger fleet would absorb).
+        existing = api.list(
+            serving_api.REPLICA_KIND,
+            ns,
+            label_selector={serving_api.LABEL_DEPLOYMENT: name},
+        )
+        total_depth = 0
+        for replica in existing:
+            stats = self._runtime_stats(replica.metadata.name)
+            if stats is None:
+                stats = replica.status  # process replica self-report
+                total_depth += int(stats.get("queueDepth") or 0)
+                total_depth += int(stats.get("inflight") or 0)
+            else:
+                total_depth += int(stats.get("queue_depth") or 0)
+                total_depth += int(stats.get("inflight") or 0)
+        if spec.autoscale is not None:
+            target = spec.autoscale.target(total_depth)
+        else:
+            target = spec.replicas
+
+        desired = [
+            serving_api.replica_name(name, i) for i in range(target)
+        ]
+
+        # Scale down from the top index so names stay dense; stop drains
+        # first (in-flight completes), then the object goes away.
+        for replica in existing:
+            if replica.metadata.name not in desired:
+                self._stop_replica(api, ns, replica.metadata.name)
+                api.record_event(
+                    dep, "ScaledDown",
+                    f"stopped replica {replica.metadata.name}",
+                )
+
+        for rname in desired:
+            self._ensure_replica_resource(api, dep, rname, rspec)
+            ensure = getattr(self.runtime, "ensure", None)
+            if ensure is not None:
+                ensure(rname, rspec)
+
+        # Drain-based checkpoint roll, one replica at a time, and only
+        # while EVERY other replica is ready — the fleet keeps admitting
+        # during the whole roll (zero downtime).
+        if spec.model_version > 0:
+            self._roll_outdated(api, dep, spec, desired, rspec)
+
+        # Status: per-replica readiness (stamped onto the replica objects
+        # too — the kubectl surface) aggregated onto the deployment.
+        rows = []
+        ready_count = 0
+        for rname in desired:
+            stats = self._runtime_stats(rname)
+            if stats is not None:
+                self._stamp_replica_status(api, ns, rname, stats)
+                row = {
+                    "name": rname,
+                    "ready": bool(stats.get("ready")),
+                    "version": int(stats.get("version") or 0),
+                    "queueDepth": int(stats.get("queue_depth") or 0),
+                    "inflight": int(stats.get("inflight") or 0),
+                }
+            else:
+                # Process replica: its worker stamps the replica object;
+                # we read it back.
+                try:
+                    robj = api.get(serving_api.REPLICA_KIND, rname, ns)
+                    status = robj.status
+                except NotFound:
+                    status = {}
+                row = {
+                    "name": rname,
+                    "ready": bool(status.get("ready")),
+                    "version": int(status.get("version") or 0),
+                    "queueDepth": int(status.get("queueDepth") or 0),
+                    "inflight": int(status.get("inflight") or 0),
+                }
+            if row["ready"]:
+                ready_count += 1
+            rows.append(row)
+
+        self.ready_replicas.set(ready_count, deployment=name)
+        phase = "Available" if ready_count >= target else "Progressing"
+        if ready_count == 0 and target > 0 and existing:
+            phase = "Degraded"
+        result = self._update_status(
+            api, dep,
+            phase=phase,
+            replicas=rows,
+            ready=ready_count,
+            target=target,
+            queue_depth=total_depth,
+        )
+        if spec.autoscale is not None or ready_count < target:
+            return Result(requeue_after=self.resync_seconds)
+        return result
+
+    def _runtime_stats(self, rname: str) -> dict | None:
+        stats_fn = getattr(self.runtime, "stats", None)
+        if stats_fn is None:
+            return None
+        return stats_fn(rname)
+
+    def _roll_outdated(
+        self, api, dep: Resource, spec, desired: list[str], rspec: dict
+    ) -> None:
+        roll = getattr(self.runtime, "roll", None)
+        if roll is None:
+            return
+        for rname in desired:
+            stats = self._runtime_stats(rname)
+            if stats is None:
+                continue
+            if int(stats.get("version") or 0) == spec.model_version:
+                continue
+            others_ready = all(
+                (self._runtime_stats(o) or {}).get("ready")
+                for o in desired
+                if o != rname
+            )
+            if not others_ready and len(desired) > 1:
+                # Never take a second replica out while one is already
+                # down — that is how a roll becomes an outage.
+                return
+            seconds = roll(rname, rspec)
+            self.rolls_total.inc(deployment=dep.metadata.name)
+            api.record_event(
+                dep, "ReplicaRolled",
+                f"{rname} -> version {spec.model_version} "
+                f"({seconds:.3f}s out of rotation)",
+            )
+
+    # -- status -----------------------------------------------------------
+
+    def _update_status(
+        self,
+        api,
+        dep: Resource,
+        *,
+        phase: str,
+        replicas=None,
+        ready: int | None = None,
+        target: int | None = None,
+        queue_depth: int | None = None,
+        reason: str | None = None,
+    ) -> Result:
+        def write():
+            try:
+                fresh = api.get(
+                    serving_api.KIND,
+                    dep.metadata.name,
+                    dep.metadata.namespace,
+                ).thaw()
+            except NotFound:
+                return
+            new_status = dict(fresh.status)
+            new_status["phase"] = phase
+            if replicas is not None:
+                new_status["replicas"] = replicas
+            if ready is not None:
+                new_status["readyReplicas"] = ready
+            if target is not None:
+                new_status["targetReplicas"] = target
+            if queue_depth is not None:
+                new_status["queueDepth"] = queue_depth
+            if reason is not None:
+                new_status["reason"] = reason
+            if new_status != fresh.status:
+                fresh.status = new_status
+                api.update_status(fresh)
+
+        retry_on_conflict(write)
+        return Result()

@@ -12,13 +12,33 @@ hot path is a single jitted apply on device.
 """
 
 from kubeflow_tpu.serving.batching import BatchingConfig, BatchingQueue
+from kubeflow_tpu.serving.replica import (
+    HttpReplica,
+    LocalReplica,
+    LocalReplicaRuntime,
+)
+from kubeflow_tpu.serving.router import (
+    NoReadyReplicas,
+    Overloaded,
+    ReplicaGone,
+    ReplicaOverloaded,
+    Router,
+)
 from kubeflow_tpu.serving.servable import Servable
 from kubeflow_tpu.serving.server import ModelRepository, ModelServerApp
 
 __all__ = [
     "BatchingConfig",
     "BatchingQueue",
+    "HttpReplica",
+    "LocalReplica",
+    "LocalReplicaRuntime",
     "ModelRepository",
     "ModelServerApp",
+    "NoReadyReplicas",
+    "Overloaded",
+    "ReplicaGone",
+    "ReplicaOverloaded",
+    "Router",
     "Servable",
 ]

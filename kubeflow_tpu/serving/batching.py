@@ -19,6 +19,22 @@ our servables:
   padding handles the ragged tail); each caller gets exactly its rows
   back, and a failed execution propagates only to the callers of its
   own group — a malformed-shape request can't fail innocent neighbors.
+
+**Continuous batching** (`BatchingConfig.continuous`, default on): when a
+flush is already cut, each signature group *late-admits* compatible
+requests that arrived after the cut, up to `max_batch`, immediately
+before it executes. Under load the cut-and-wait cycle makes a request
+that misses a cut wait out the ENTIRE in-flight execution plus its own
+timeout window; late admission rides it into the window that's about to
+run, which is where the p50 win under sustained concurrency comes from
+(docs/serving.md). The admission happens on the scheduler thread, under
+the queue lock, on host memory only — no device sync is added to the
+flush path (enforced by the `serving-batch-continuous` lint contract).
+
+The queue also exports its autoscaling input signal: queue-depth and
+in-flight-batch gauges through `MetricsRegistry`, and a `stats()`
+snapshot the serving controller aggregates into ServingDeployment
+status (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -43,6 +59,11 @@ class BatchingConfig:
     # (TF-Serving's max_enqueued_batches) instead of growing the queue
     # unboundedly under overload.
     max_pending: int = 1024
+    # Continuous batching: late-admit compatible arrivals into the
+    # in-flight flush window (see module docstring). Off restores the
+    # original cut-and-wait cycle — kept selectable so the bench can
+    # publish the delta honestly.
+    continuous: bool = True
 
 
 class _Entry:
@@ -56,8 +77,13 @@ class _Entry:
         self.arrived = time.monotonic()
 
 
+def _signature(instances: np.ndarray) -> tuple:
+    return (instances.shape[1:], instances.dtype.str)
+
+
 class QueueFull(RuntimeError):
-    """Backpressure signal (callers map it to HTTP 429/503)."""
+    """Backpressure signal (the server boundary maps it to HTTP 429 with
+    a Retry-After header — `serving/server.py`)."""
 
 
 class QueueClosed(RuntimeError):
@@ -90,9 +116,28 @@ class BatchingQueue:
             "requests rejected by backpressure",
             ("model",),
         )
+        self.late_admitted_total = metrics.counter(
+            "serving_batch_late_admitted_total",
+            "requests admitted into an already-cut flush window",
+            ("model",),
+        )
+        # The autoscaler's input signal (ServingDeployment status rides
+        # on the same numbers via stats()).
+        self.queue_depth = metrics.gauge(
+            "serving_queue_depth",
+            "instances waiting in the batching queue",
+            ("model",),
+        )
+        self.inflight_batches = metrics.gauge(
+            "serving_inflight_batches",
+            "accelerator batches currently executing",
+            ("model",),
+        )
         self._cv = threading.Condition()
         self._pending: list[_Entry] = []
         self._pending_count = 0
+        self._inflight: list[_Entry] = []
+        self._wait_ewma_ms = 0.0
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop,
@@ -125,11 +170,27 @@ class BatchingQueue:
                 )
             self._pending.append(entry)
             self._pending_count += batch.shape[0]
+            self.queue_depth.set(
+                self._pending_count, model=self.servable.name
+            )
             self._cv.notify_all()
         entry.event.wait()
         if entry.error is not None:
             raise entry.error
         return entry.result
+
+    def stats(self) -> dict:
+        """Snapshot of the autoscaling signal: queued instances, instances
+        executing right now, and an EWMA of the queue wait (ms)."""
+        with self._cv:
+            return {
+                "queue_depth": self._pending_count,
+                "inflight": sum(
+                    e.instances.shape[0] for e in self._inflight
+                ),
+                "queue_wait_ms": round(self._wait_ewma_ms, 3),
+                "closed": self._closed,
+            }
 
     def close(self) -> None:
         """Flush and stop; in-flight callers complete, later ones error."""
@@ -137,6 +198,27 @@ class BatchingQueue:
             self._closed = True
             self._cv.notify_all()
         self._thread.join(timeout=30)
+
+    def kill(self) -> None:
+        """Hard stop (chaos / replica-death simulation): unlike close(),
+        nothing drains — pending AND in-flight callers fail immediately
+        with QueueClosed, the way a SIGKILLed replica's open connections
+        reset. The router treats that as replica death and retries
+        idempotent requests elsewhere (`serving/router.py`)."""
+        with self._cv:
+            self._closed = True
+            pending, self._pending = self._pending, []
+            self._pending_count = 0
+            self.queue_depth.set(0, model=self.servable.name)
+            inflight = list(self._inflight)
+            self._cv.notify_all()
+        err = QueueClosed(
+            f"batching queue for {self.servable.name!r} was killed"
+        )
+        for entry in pending + inflight:
+            if not entry.event.is_set():
+                entry.error = err
+                entry.event.set()
 
     # -- scheduler ---------------------------------------------------------
 
@@ -178,7 +260,53 @@ class BatchingQueue:
             if count >= self.config.max_batch:
                 break
         self._pending_count -= count
+        self.queue_depth.set(self._pending_count, model=self.servable.name)
+        self._record_wait_locked(take)
+        # Becomes in-flight the instant it leaves pending, under the same
+        # lock — a kill() racing the cut must find every caller in one of
+        # the two lists or it would strand them on an unset event.
+        self._inflight = list(take)
         return take
+
+    def _record_wait_locked(self, entries: list[_Entry]) -> None:
+        now = time.monotonic()
+        for e in entries:
+            wait_ms = (now - e.arrived) * 1000.0
+            self._wait_ewma_ms += 0.2 * (wait_ms - self._wait_ewma_ms)
+
+    def _admit_late(self, key: tuple, count: int) -> list[_Entry]:
+        """Continuous batching: pull compatible pending entries into the
+        group that is ABOUT to execute, up to max_batch. Host-side list
+        surgery under the queue lock only — the flush path gains no
+        device work or sync (serving-batch-continuous lint contract)."""
+        with self._cv:
+            taken: list[_Entry] = []
+            kept: list[_Entry] = []
+            for e in self._pending:
+                n = e.instances.shape[0]
+                if (
+                    count + n <= self.config.max_batch
+                    and _signature(e.instances) == key
+                ):
+                    taken.append(e)
+                    count += n
+                else:
+                    kept.append(e)
+            if taken:
+                self._pending = kept
+                admitted = sum(e.instances.shape[0] for e in taken)
+                self._pending_count -= admitted
+                self.queue_depth.set(
+                    self._pending_count, model=self.servable.name
+                )
+                self.late_admitted_total.inc(
+                    len(taken), model=self.servable.name
+                )
+                self._record_wait_locked(taken)
+                # kill() must cover late admissions too — they are
+                # in-flight the moment they leave pending.
+                self._inflight.extend(taken)
+            return taken
 
     def _loop(self) -> None:
         while True:
@@ -192,11 +320,12 @@ class BatchingQueue:
             # requests sharing the flush.
             groups: dict = {}
             for entry in entries:
-                key = (entry.instances.shape[1:], entry.instances.dtype.str)
-                groups.setdefault(key, []).append(entry)
+                groups.setdefault(_signature(entry.instances), []).append(
+                    entry
+                )
             try:
-                for group in groups.values():
-                    self._run_group(group)
+                for key, group in groups.items():
+                    self._run_group(key, group)
             except BaseException as e:
                 # An interrupt/exit is taking this scheduler thread
                 # down: close the queue and unblock EVERY caller that
@@ -206,19 +335,31 @@ class BatchingQueue:
                 # predict() parked on an event nobody will set.
                 self._abort(entries, e)
                 raise
+            finally:
+                with self._cv:
+                    self._inflight = []
+                    self.inflight_batches.set(0, model=self.servable.name)
 
     def _abort(self, entries: list[_Entry], e: BaseException) -> None:
         with self._cv:
             self._closed = True  # later predict() gets QueueClosed
             pending, self._pending = self._pending, []
             self._pending_count = 0
+            self.queue_depth.set(0, model=self.servable.name)
+            inflight, self._inflight = self._inflight, []
             self._cv.notify_all()
-        for entry in entries + pending:
+        for entry in entries + inflight + pending:
             if not entry.event.is_set():
                 entry.error = e
                 entry.event.set()
 
-    def _run_group(self, group: list[_Entry]) -> None:
+    def _run_group(self, key: tuple, group: list[_Entry]) -> None:
+        if self.config.continuous:
+            late = self._admit_late(
+                key, sum(e.instances.shape[0] for e in group)
+            )
+            group = group + late
+        self.inflight_batches.set(1, model=self.servable.name)
         try:
             merged = np.concatenate([e.instances for e in group], axis=0)
             out = self.servable.predict(merged)

@@ -1,0 +1,256 @@
+"""Replica adapters and the replica runtime behind the serving router.
+
+The router (`serving/router.py`) speaks one small surface — ``name``,
+``capacity``, ``predict()``, optional ``stats()`` — and two exception
+contracts (`ReplicaGone`, `ReplicaOverloaded`). Two adapters implement
+it:
+
+- `LocalReplica`: a Servable behind its own continuous `BatchingQueue`
+  in this process. The single-binary dev/bench shape, and the unit the
+  chaos tests hard-kill (`kill()` fails in-flight callers exactly the
+  way a SIGKILLed process resets its connections).
+- `HttpReplica`: a model-server process reached over HTTP
+  (`serving/__main__.py`); connection failures and 5xx map to
+  `ReplicaGone`, 429 maps to `ReplicaOverloaded` with the server's own
+  Retry-After hint.
+
+`LocalReplicaRuntime` is the materialization backend the serving
+controller drives (`controllers/serving.py`): ensure/stop/roll replicas
+against a router, reporting per-replica readiness and queue stats for
+the ServingDeployment status.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+
+import numpy as np
+
+from kubeflow_tpu.serving.batching import (
+    BatchingConfig,
+    BatchingQueue,
+    QueueClosed,
+    QueueFull,
+)
+from kubeflow_tpu.serving.router import (
+    ReplicaGone,
+    ReplicaOverloaded,
+    Router,
+)
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+class LocalReplica:
+    """One servable behind one continuous batching queue, in-process."""
+
+    def __init__(
+        self,
+        name: str,
+        servable,
+        config: BatchingConfig | None = None,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.name = name
+        self._config = config or BatchingConfig()
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._dead = False
+        self._queue = BatchingQueue(servable, self._config, metrics)
+
+    @property
+    def capacity(self) -> int:
+        return self._config.max_pending
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._queue.servable.version
+
+    @property
+    def ready(self) -> bool:
+        with self._lock:
+            return not self._dead and not self._queue.stats()["closed"]
+
+    def predict(self, instances) -> np.ndarray:
+        with self._lock:
+            dead, queue = self._dead, self._queue
+        if dead:
+            raise ReplicaGone(f"replica {self.name!r} is dead")
+        try:
+            return queue.predict(instances)
+        except QueueFull as e:
+            raise ReplicaOverloaded(str(e)) from e
+        except QueueClosed as e:
+            # Killed or torn down mid-request — to the caller that is
+            # indistinguishable from process death.
+            raise ReplicaGone(str(e)) from e
+
+    def stats(self) -> dict:
+        with self._lock:
+            queue = self._queue
+        return {
+            "ready": self.ready,
+            "version": queue.servable.version,
+            **queue.stats(),
+        }
+
+    def swap(self, servable) -> None:
+        """Replace the model (checkpoint roll). The caller must have
+        quiesced this replica first (`Router.roll` drains before calling
+        swap); the old queue closes after the new one is taking over, so
+        a racing direct caller errors with QueueClosed → retry."""
+        with self._lock:
+            old, self._queue = self._queue, BatchingQueue(
+                servable, self._config, self._metrics
+            )
+        old.close()
+
+    def kill(self) -> None:
+        """Chaos: die the way SIGKILL dies — in-flight and queued callers
+        all fail immediately with ReplicaGone (via QueueClosed)."""
+        with self._lock:
+            self._dead = True
+            queue = self._queue
+        queue.kill()
+
+    def close(self) -> None:
+        with self._lock:
+            queue = self._queue
+        queue.close()
+
+
+class HttpReplica:
+    """A model-server process (`python -m kubeflow_tpu.serving`) behind
+    the router. One connection per request: the chaos variant SIGKILLs
+    the process mid-load, and a pooled half-dead keepalive socket would
+    blur the death signal the router's retry path depends on."""
+
+    def __init__(
+        self,
+        name: str,
+        address: str,
+        model: str,
+        *,
+        capacity: int = 256,
+        timeout: float = 30.0,
+    ):
+        self.name = name
+        host, _, port = address.rpartition(":")
+        self._host, self._port = host, int(port)
+        self._model = model
+        self.capacity = capacity
+        self._timeout = timeout
+
+    def predict(self, instances) -> np.ndarray:
+        body = json.dumps(
+            {"instances": np.asarray(instances).tolist()}
+        ).encode()
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self._timeout
+        )
+        try:
+            conn.request(
+                "POST",
+                f"/v1/models/{self._model}:predict",
+                body,
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            status = resp.status
+            retry_after = resp.getheader("Retry-After")
+        except (OSError, http.client.HTTPException) as e:
+            raise ReplicaGone(
+                f"replica {self.name!r} unreachable: {e}"
+            ) from e
+        finally:
+            conn.close()
+        if status == 429:
+            raise ReplicaOverloaded(
+                f"replica {self.name!r} shed the request",
+                retry_after=float(retry_after or 0.05),
+            )
+        if status >= 500:
+            raise ReplicaGone(
+                f"replica {self.name!r} failed: HTTP {status}"
+            )
+        if status != 200:
+            raise RuntimeError(
+                f"replica {self.name!r} rejected the request: "
+                f"HTTP {status}: {data[:200]!r}"
+            )
+        return np.asarray(json.loads(data)["predictions"])
+
+    def stats(self) -> dict:
+        return {"ready": True}
+
+
+class LocalReplicaRuntime:
+    """In-process replica fleet the serving controller materializes into.
+
+    ``servable_factory(rspec)`` builds a Servable from a rendered replica
+    spec (`api/serving.replica_spec`) — from a checkpoint dir in the real
+    deployment, from a toy module in tests/bench.
+    """
+
+    def __init__(
+        self,
+        router: Router,
+        servable_factory,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.router = router
+        self._factory = servable_factory
+        self._metrics = metrics
+
+    @staticmethod
+    def _config(rspec: dict) -> BatchingConfig:
+        batching = rspec.get("batching") or {}
+        return BatchingConfig(
+            max_batch=int(rspec.get("maxBatch", 64)),
+            timeout_ms=float(batching.get("timeoutMs", 5.0)),
+            max_pending=int(batching.get("maxPending", 1024)),
+            continuous=bool(batching.get("continuous", True)),
+        )
+
+    def names(self) -> list[str]:
+        return self.router.replica_names()
+
+    def ensure(self, name: str, rspec: dict) -> None:
+        """Idempotent: bring the named replica up if it isn't already."""
+        if self.router.replica(name) is not None:
+            return
+        servable = self._factory(rspec)
+        self.router.add(
+            LocalReplica(
+                name, servable, self._config(rspec), self._metrics
+            )
+        )
+
+    def stop(self, name: str) -> None:
+        """Scale-down teardown: drain first so in-flight work completes,
+        then take the replica out of the fleet."""
+        replica = self.router.replica(name)
+        if replica is None:
+            return
+        self.router.drain(name)
+        self.router.remove(name)
+        replica.close()
+
+    def roll(self, name: str, rspec: dict) -> float:
+        """Drain-based hot swap to the spec's model version; returns the
+        seconds the replica was out of rotation."""
+        replica = self.router.replica(name)
+        if replica is None:
+            raise KeyError(f"unknown replica {name!r}")
+        return self.router.roll(
+            name, lambda: replica.swap(self._factory(rspec))
+        )
+
+    def stats(self, name: str) -> dict | None:
+        replica = self.router.replica(name)
+        if replica is None:
+            return None
+        return replica.stats()

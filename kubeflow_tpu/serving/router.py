@@ -1,0 +1,343 @@
+"""Drain-aware request router over a fleet of serving replicas.
+
+TF-Serving deployments put an external L7 balancer in front of N model
+servers and rely on it for spread/retry; we route natively so the router
+can see the batching queues it feeds (docs/parity.md carries the
+deviation). Contracts:
+
+- **Spread**: `predict()` dispatches to the admitting replica with the
+  fewest outstanding requests (least-loaded, not round-robin — replica
+  service times diverge the moment one is draining or cold).
+- **Retry on replica death**: a replica that dies mid-request
+  (`ReplicaGone` — connection reset, SIGKILL, hard queue kill) is marked
+  dead and the request retries on a survivor, *if* the caller declared it
+  idempotent. Inference is idempotent by default; double execution is
+  safe, a dropped acknowledged request is not.
+- **Load shedding**: when fleet-wide outstanding requests reach the
+  admitting replicas' aggregate queue capacity, `predict()` raises
+  `Overloaded` carrying `retry_after` — the server boundary turns that
+  into an honest HTTP 429 + `Retry-After` *before* queues grow
+  unboundedly, instead of letting every queue time out at once.
+- **Drain** (`drain()` / `roll()`): stop admitting to one replica, let
+  its in-flight work finish, swap the model, re-admit. A checkpoint roll
+  is therefore zero-downtime: the rest of the fleet keeps admitting the
+  whole time. A replica killed *mid-drain* fails its in-flight requests
+  with `ReplicaGone`, which re-enter `predict()`'s retry path on another
+  replica — the drain completes either way.
+
+Acknowledgement accounting: a request is *acknowledged* once it passes
+admission (i.e. it was not shed). The router's terminal accounting keeps
+`acked == completed + failed`; the serving bench's chaos variant asserts
+`failed == 0` while survivors exist — zero dropped acknowledged requests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+class RouterError(RuntimeError):
+    pass
+
+
+class NoReadyReplicas(RouterError):
+    """No live replica exists at all (distinct from Overloaded: there is
+    nobody to wait for, so retrying without operator action is futile)."""
+
+
+class Overloaded(RouterError):
+    """Load shed: the fleet is at capacity. `retry_after` (seconds) is
+    the honest backoff hint the HTTP boundary forwards as Retry-After."""
+
+    def __init__(self, msg: str, retry_after: float):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class ReplicaGone(RuntimeError):
+    """The replica died or was torn down mid-request (connection reset,
+    SIGKILL, queue hard-kill). Raised by replica adapters; the router
+    converts it into mark-dead + retry-on-survivor."""
+
+
+class ReplicaOverloaded(RuntimeError):
+    """One replica refused the request (its queue is full); the router
+    tries another — only a fleet-wide refusal becomes `Overloaded`."""
+
+    def __init__(self, msg: str, retry_after: float = 0.05):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class _Slot:
+    __slots__ = ("replica", "admitting", "dead", "outstanding")
+
+    def __init__(self, replica):
+        self.replica = replica
+        self.admitting = True
+        self.dead = False
+        self.outstanding = 0
+
+
+class Router:
+    """Thread-safe fan-out of `predict()` across ready replicas.
+
+    Replicas are any objects with ``name``, ``capacity`` (max queued
+    requests it will hold — backpressure budget), and
+    ``predict(instances)`` raising `ReplicaGone` / `ReplicaOverloaded`
+    per the contracts above (`serving/replica.py` provides the local and
+    HTTP adapters).
+    """
+
+    def __init__(
+        self,
+        metrics: MetricsRegistry | None = None,
+        *,
+        max_attempts: int = 4,
+        retry_after_s: float = 0.25,
+        dispatch_timeout_s: float = 30.0,
+    ):
+        self._cv = threading.Condition()
+        self._slots: dict[str, _Slot] = {}
+        self.max_attempts = max_attempts
+        self.retry_after_s = retry_after_s
+        self.dispatch_timeout_s = dispatch_timeout_s
+        metrics = metrics or MetricsRegistry()
+        self.acked_total = metrics.counter(
+            "serving_router_acked_total",
+            "requests admitted past load shedding",
+        )
+        self.completed_total = metrics.counter(
+            "serving_router_completed_total",
+            "acknowledged requests that returned a result",
+        )
+        self.failed_total = metrics.counter(
+            "serving_router_failed_total",
+            "acknowledged requests the router could not complete",
+        )
+        self.shed_total = metrics.counter(
+            "serving_router_shed_total",
+            "requests shed at admission (HTTP 429 at the boundary)",
+        )
+        self.retried_total = metrics.counter(
+            "serving_router_retried_total",
+            "dispatches retried on another replica after replica death",
+        )
+        self.outstanding_gauge = metrics.gauge(
+            "serving_router_outstanding",
+            "requests currently dispatched to replicas",
+        )
+
+    # -- fleet membership --------------------------------------------------
+
+    def add(self, replica) -> None:
+        with self._cv:
+            self._slots[replica.name] = _Slot(replica)
+            self._cv.notify_all()
+
+    def remove(self, name: str) -> None:
+        with self._cv:
+            self._slots.pop(name, None)
+            self._cv.notify_all()
+
+    def replica(self, name: str):
+        with self._cv:
+            slot = self._slots.get(name)
+            return slot.replica if slot is not None else None
+
+    def replica_names(self) -> list[str]:
+        with self._cv:
+            return sorted(self._slots)
+
+    def ready_names(self) -> list[str]:
+        with self._cv:
+            return sorted(
+                name
+                for name, s in self._slots.items()
+                if s.admitting and not s.dead
+            )
+
+    def stats(self) -> dict:
+        """Aggregate autoscaling signal: fleet-wide outstanding plus each
+        replica's own queue stats (the controller folds this into
+        ServingDeployment status)."""
+        with self._cv:
+            slots = list(self._slots.items())
+        per_replica = {}
+        for name, slot in slots:
+            stats_fn = getattr(slot.replica, "stats", None)
+            try:
+                rstats = stats_fn() if stats_fn else {}
+            except Exception:
+                rstats = {}
+            per_replica[name] = {
+                "admitting": slot.admitting,
+                "dead": slot.dead,
+                "outstanding": slot.outstanding,
+                **rstats,
+            }
+        return {
+            "outstanding": sum(s.outstanding for _, s in slots),
+            "replicas": per_replica,
+        }
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _admit_locked(self, tried: set) -> "_Slot | None":
+        """Admission + selection under the lock. Raises NoReadyReplicas /
+        Overloaded; returns None when every eligible replica was already
+        tried this request (caller decides whether to wait and re-spread)."""
+        alive = [
+            s for s in self._slots.values() if not s.dead and s.admitting
+        ]
+        if not any(not s.dead for s in self._slots.values()):
+            raise NoReadyReplicas("no live serving replicas")
+        if not alive:
+            # Everything live is draining; momentary — ask for a retry.
+            raise Overloaded(
+                "all replicas draining", retry_after=self.retry_after_s
+            )
+        capacity = sum(max(int(s.replica.capacity), 1) for s in alive)
+        outstanding = sum(s.outstanding for s in self._slots.values())
+        if outstanding >= capacity:
+            raise Overloaded(
+                f"fleet at capacity ({outstanding} outstanding >= "
+                f"{capacity} queue slots)",
+                retry_after=self.retry_after_s,
+            )
+        candidates = [s for s in alive if s.replica.name not in tried]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda s: s.outstanding)
+
+    def _finish_locked(self, slot: _Slot) -> None:
+        slot.outstanding -= 1
+        self.outstanding_gauge.dec()
+        self._cv.notify_all()
+
+    def predict(self, instances, *, idempotent: bool = True):
+        """Route one request. Raises `Overloaded` (shed — never acked),
+        `NoReadyReplicas`, or the model error from the replica that
+        served it. An acknowledged idempotent request survives replica
+        death as long as one replica remains."""
+        deadline = time.monotonic() + self.dispatch_timeout_s
+        tried: set = set()
+        acked = False
+        attempts = 0
+        while True:
+            with self._cv:
+                try:
+                    slot = self._admit_locked(tried)
+                except Overloaded:
+                    if not acked:
+                        self.shed_total.inc()
+                    else:
+                        self.failed_total.inc()
+                    raise
+                except NoReadyReplicas:
+                    if acked:
+                        self.failed_total.inc()
+                    raise
+                if slot is None:
+                    # Tried every admitting replica this pass (each one
+                    # refused or died). Back off briefly and re-spread —
+                    # admission said there IS capacity.
+                    if time.monotonic() >= deadline:
+                        if acked:
+                            self.failed_total.inc()
+                        else:
+                            self.shed_total.inc()
+                        raise Overloaded(
+                            "every replica refused within the dispatch "
+                            "deadline",
+                            retry_after=self.retry_after_s,
+                        )
+                    tried = set()
+                    self._cv.wait(0.005)
+                    continue
+                if not acked:
+                    acked = True
+                    self.acked_total.inc()
+                slot.outstanding += 1
+                self.outstanding_gauge.inc()
+                name = slot.replica.name
+                replica = slot.replica
+            try:
+                result = replica.predict(instances)
+            except ReplicaGone:
+                with self._cv:
+                    slot.dead = True
+                    slot.admitting = False
+                    self._finish_locked(slot)
+                attempts += 1
+                if not idempotent or attempts >= self.max_attempts:
+                    self.failed_total.inc()
+                    raise
+                self.retried_total.inc()
+                tried.add(name)
+                continue
+            except ReplicaOverloaded:
+                # The replica's own queue beat our accounting (races with
+                # direct callers); not a death — try a sibling.
+                with self._cv:
+                    self._finish_locked(slot)
+                tried.add(name)
+                continue
+            except BaseException:
+                # Model/input error: the replica executed and failed the
+                # request on its merits — propagate, don't retry.
+                with self._cv:
+                    self._finish_locked(slot)
+                self.failed_total.inc()
+                raise
+            with self._cv:
+                self._finish_locked(slot)
+            self.completed_total.inc()
+            return result
+
+    # -- drain / roll ------------------------------------------------------
+
+    def drain(self, name: str, timeout: float = 30.0) -> bool:
+        """Stop admitting to `name` and wait for its in-flight requests
+        to finish (complete OR fail over to a sibling — a kill mid-drain
+        converts the remainder into retries, see module docstring).
+        Returns True once outstanding hits zero within `timeout`."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            slot = self._slots.get(name)
+            if slot is None:
+                return True
+            slot.admitting = False
+            while slot.outstanding > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def admit(self, name: str) -> None:
+        """Re-admit a drained (or replaced) replica. The caller vouches
+        that the replica behind the slot is healthy again."""
+        with self._cv:
+            slot = self._slots.get(name)
+            if slot is None:
+                raise KeyError(f"unknown replica {name!r}")
+            slot.admitting = True
+            slot.dead = False
+            self._cv.notify_all()
+
+    def roll(self, name: str, swap_fn, timeout: float = 30.0) -> float:
+        """Zero-downtime hot swap: drain → swap_fn() → re-admit. Returns
+        the wall seconds the replica was out of rotation. swap_fn runs
+        with the replica fully quiesced (no in-flight work)."""
+        start = time.monotonic()
+        if not self.drain(name, timeout=timeout):
+            raise TimeoutError(
+                f"replica {name!r} did not drain within {timeout}s"
+            )
+        swap_fn()
+        self.admit(name)
+        return time.monotonic() - start

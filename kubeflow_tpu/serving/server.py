@@ -204,10 +204,15 @@ class ModelServerApp(App):
         except HttpError:
             raise
         except QueueFull as e:
-            # Backpressure (TF-Serving's max_enqueued_batches): tell the
-            # client to retry rather than queueing unboundedly.
+            # Backpressure (TF-Serving's max_enqueued_batches): an honest
+            # 429 WITH Retry-After at the boundary — every caller used to
+            # re-derive the backoff hint itself. A full queue clears at
+            # flush cadence, so the hint is one flush window, floored at
+            # 1s (Retry-After is integer seconds on the wire).
             self.request_count.inc(model=name, outcome="overload")
-            raise HttpError(429, str(e)) from None
+            raise HttpError(
+                429, str(e), headers=[("Retry-After", self._retry_after())]
+            ) from None
         except Exception as e:
             import jax
 
@@ -225,6 +230,10 @@ class ModelServerApp(App):
             raise HttpError(400, f"bad instances: {e}") from None
         self.request_count.inc(model=name, outcome="ok")
         return json_response({"predictions": predictions.tolist()})
+
+    def _retry_after(self) -> str:
+        timeout_ms = getattr(self._batching, "timeout_ms", 0.0) or 0.0
+        return str(max(1, -(-int(timeout_ms) // 1000)))
 
     def _predictor(self, model):
         """model.predict, or its batching queue when batching is on.

@@ -34,10 +34,19 @@ log = logging.getLogger(__name__)
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(
+        self,
+        status: int,
+        message: str,
+        headers: list[tuple[str, str]] | None = None,
+    ):
         super().__init__(message)
         self.status = status
         self.message = message
+        # Extra response headers the error must carry to be honest —
+        # e.g. 429 + Retry-After (the serving boundary's backpressure
+        # contract, serving/server.py).
+        self.headers = list(headers or [])
 
 
 class Request:
@@ -137,8 +146,12 @@ def encode_json(payload: Any) -> bytes:
     return json.dumps(payload, separators=(",", ":")).encode()
 
 
-def json_response(payload: Any, status: int = 200) -> Response:
-    return Response(encode_json(payload), status=status)
+def json_response(
+    payload: Any,
+    status: int = 200,
+    headers: list[tuple[str, str]] | None = None,
+) -> Response:
+    return Response(encode_json(payload), status=status, headers=headers)
 
 
 def success_response(field: str | None = None, value: Any = None) -> Response:
@@ -150,9 +163,15 @@ def success_response(field: str | None = None, value: Any = None) -> Response:
     return json_response(body)
 
 
-def error_response(status: int, message: str) -> Response:
+def error_response(
+    status: int,
+    message: str,
+    headers: list[tuple[str, str]] | None = None,
+) -> Response:
     return json_response(
-        {"success": False, "status": status, "log": message}, status=status
+        {"success": False, "status": status, "log": message},
+        status=status,
+        headers=headers,
     )
 
 
@@ -262,7 +281,7 @@ class App:
         try:
             return self._dispatch(req)
         except HttpError as e:
-            return error_response(e.status, e.message)
+            return error_response(e.status, e.message, headers=e.headers)
         except storage.NotFound as e:
             return error_response(404, str(e))
         except storage.AlreadyExists as e:

@@ -473,3 +473,40 @@ def test_wsgi_skips_auto_content_length_when_framed():
             conn.close()
     finally:
         server.shutdown()
+
+
+def test_replica_kill_schedule_reproducible_and_coverage_honest():
+    """The serving chaos plan (ISSUE 11) shares the seeded-plan
+    contract: same seed → identical plan; kills fire only when the load
+    fraction passes their trigger; coverage counts landed kills only."""
+    from kubeflow_tpu.testing.chaos import ReplicaKill, ReplicaKillSchedule
+
+    a = ReplicaKillSchedule(97, kills=3, replicas=4)
+    b = ReplicaKillSchedule(97, kills=3, replicas=4)
+    assert a.plan == b.plan
+    assert len(a.plan) == 3
+    fractions = [k.at_fraction for k in a.plan]
+    assert fractions == sorted(fractions)
+    assert all(0.2 <= f <= 0.7 for f in fractions)
+    assert all(0 <= k.victim < 4 for k in a.plan)
+    assert ReplicaKillSchedule(98, kills=3, replicas=4).plan != a.plan
+
+    # Nothing fires before its trigger point.
+    assert a.due(0.0) is None
+    first = a.due(a.plan[0].at_fraction + 0.01)
+    assert first == a.plan[0]
+    # At most one kill per poll, and coverage counts only landed kills.
+    assert a.coverage() == {"replica_kill": 0}
+    a.mark_injected(first)
+    assert a.coverage() == {"replica_kill": 1}
+    assert not a.exhausted
+    assert a.due(1.0) == a.plan[1]
+    assert a.due(1.0) == a.plan[2]
+    assert a.due(1.0) is None
+    assert a.exhausted
+
+    targeted = ReplicaKillSchedule.from_plan(
+        [ReplicaKill("replica_kill", 0.5, 1)]
+    )
+    assert targeted.due(0.4) is None
+    assert targeted.due(0.6).victim == 1

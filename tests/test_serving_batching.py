@@ -305,6 +305,207 @@ def test_reload_swaps_queue_to_current_generation():
         app.close_batchers()
 
 
+class GatedServable(CountingServable):
+    """Blocks executions of a chosen signature until released — the
+    choreography hook for deterministic continuous-batching tests."""
+
+    def __init__(self, gate_width):
+        super().__init__()
+        self.gate = threading.Event()
+        self.gate_width = gate_width
+        self.shapes: list[tuple] = []
+
+    def predict(self, instances):
+        batch = np.asarray(instances)
+        with self._lock:
+            self.shapes.append(batch.shape)
+        if batch.shape[1] == self.gate_width:
+            self.gate.wait(10)
+        return batch * 2.0
+
+
+def _drive_continuous(continuous: bool):
+    """Two-signature choreography: a gated width-2 group executes while
+    a width-3 request arrives AFTER the cut — under continuous batching
+    the width-3 group about to run admits it late (one (2, 3) call);
+    under cut-and-wait it waits for its own flush (two (1, 3) calls)."""
+    model = GatedServable(gate_width=2)
+    queue = BatchingQueue(
+        model,
+        BatchingConfig(
+            max_batch=2, timeout_ms=2000.0, continuous=continuous
+        ),
+    )
+    try:
+        results, errors = [None] * 3, [None] * 3
+
+        def call(i, x):
+            try:
+                results[i] = queue.predict(x)
+            except BaseException as e:  # pragma: no cover - diagnostics
+                errors[i] = e
+
+        t_x = threading.Thread(target=call, args=(0, np.ones((1, 2))))
+        t_x.start()
+        deadline = time.monotonic() + 5
+        while queue._pending_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t_y1 = threading.Thread(target=call, args=(1, np.ones((1, 3))))
+        t_y1.start()  # rows hit max_batch → cut {x, y1}
+        while (
+            not any(s[1] == 2 for s in model.shapes)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        # The flush is executing (width-2 gated); y2 arrives post-cut.
+        t_y2 = threading.Thread(target=call, args=(2, np.ones((1, 3))))
+        t_y2.start()
+        while queue._pending_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        model.gate.set()
+        for t in (t_x, t_y1, t_y2):
+            t.join(timeout=10)
+        assert errors == [None] * 3, errors
+        for r in results:
+            assert r is not None
+        return model.shapes
+    finally:
+        model.gate.set()
+        queue.close()
+
+
+def test_continuous_batching_admits_late_arrival():
+    shapes = _drive_continuous(continuous=True)
+    # y1 + late-admitted y2 merged into one width-3 execution.
+    assert (2, 3) in shapes, shapes
+
+
+def test_cut_and_wait_mode_never_admits_late():
+    shapes = _drive_continuous(continuous=False)
+    assert (2, 3) not in shapes, shapes
+    assert shapes.count((1, 3)) == 2, shapes
+
+
+def test_queue_gauges_scrape_through_registry():
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    metrics = MetricsRegistry()
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=4, timeout_ms=5.0), metrics
+    )
+    try:
+        queue.predict(np.ones((1, 2)))
+        text = metrics.expose_text()
+        assert "serving_queue_depth" in text
+        assert "serving_inflight_batches" in text
+        assert "serving_batch_late_admitted_total" in text
+        stats = queue.stats()
+        assert stats["queue_depth"] == 0 and stats["inflight"] == 0
+        assert stats["queue_wait_ms"] >= 0.0
+    finally:
+        queue.close()
+
+
+def test_kill_fails_inflight_and_queued_callers():
+    """`kill()` is the SIGKILL analog: in-flight and queued callers all
+    fail immediately with QueueClosed (→ ReplicaGone at the router), no
+    caller is left waiting on an event that never fires."""
+    from kubeflow_tpu.serving.batching import QueueClosed
+
+    model = GatedServable(gate_width=2)
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=1, timeout_ms=1000.0)
+    )
+    try:
+        _, errors = [None] * 3, [None] * 3
+        done = [None] * 3
+
+        def call(i):
+            try:
+                done[i] = queue.predict(np.ones((1, 2)))
+            except BaseException as e:
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while not model.shapes and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        queue.kill()
+        for t in threads:
+            t.join(timeout=10)
+        assert all(isinstance(e, QueueClosed) for e in errors), errors
+        with pytest.raises(QueueClosed):
+            queue.predict(np.ones((1, 2)))
+    finally:
+        model.gate.set()
+        queue.close()
+
+
+def test_queue_full_maps_to_429_with_retry_after():
+    """Boundary regression (ISSUE 11 satellite): backpressure surfaces
+    as an honest HTTP 429 carrying Retry-After, not a 500."""
+    gate = threading.Event()
+    executing = threading.Event()
+
+    class SlowServable(CountingServable):
+        def predict(self, instances):
+            executing.set()
+            gate.wait(10)
+            return super().predict(instances)
+
+    model = SlowServable()
+    app = ModelServerApp(
+        ModelRepository([model]),
+        batching=BatchingConfig(
+            max_batch=1, timeout_ms=3000.0, max_pending=1
+        ),
+    )
+    client = TestClient(app)
+    try:
+        def fill():
+            client.post(
+                "/v1/models/ident:predict", {"instances": [[1.0]]}
+            )
+
+        # Sequenced fill so the slot accounting is deterministic: the
+        # first request must be CUT into execution (pending back to 0)
+        # before the second is posted, or the second eats the QueueFull
+        # the probe below is asserting on.
+        threads = [threading.Thread(target=fill) for _ in range(2)]
+        threads[0].start()
+        assert executing.wait(10)
+        threads[1].start()
+        queue = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            queue = next(iter(app._batchers.values()), None)
+            if queue is not None and queue._pending_count >= 1:
+                break
+            time.sleep(0.01)
+        assert queue is not None and queue._pending_count >= 1
+
+        resp = client.post(
+            "/v1/models/ident:predict", {"instances": [[1.0]]}
+        )
+        assert resp.status == 429, resp.body
+        headers = dict(resp.headers)
+        # Integer seconds, >= 1 (ceil of the flush window) per RFC 7231.
+        assert int(headers["Retry-After"]) >= 1
+        assert "full" in resp.json()["log"]
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        gate.set()
+        app.close_batchers()
+
+
 def test_unload_prunes_stale_queue():
     """An unloaded version's queue must not pin its weights + scheduler
     thread forever — the next predict prunes it."""

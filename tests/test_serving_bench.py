@@ -1,0 +1,62 @@
+"""CI smoke for `bench.py --workload serving --serving-dataplane-only`
+(ISSUE 11): the multi-replica data-plane bench must run end-to-end at
+tiny scale — steady latency, overload goodput, the drain-based roll, and
+the replica-kill chaos gate — and every headline row must resolve a real
+vs_baseline ratio against BASELINE.json's published serving_* entries."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_serving_dataplane_bench_smoke_rows_resolve_baseline():
+    result = subprocess.run(
+        [
+            sys.executable, "bench.py", "--workload", "serving",
+            "--serving-dataplane-only",
+            "--serving-clients", "32",
+            "--serving-requests", "64",
+            "--serving-replicas", "2",
+            "--serving-chaos", "local",
+            "--chaos-seed", "3",
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=280,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    metrics = [
+        json.loads(line)
+        for line in result.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert metrics, f"no metric lines in:\n{result.stdout}"
+    by_name = {}
+    for m in metrics:
+        # The driver's parse contract — same shape as every other bench.
+        assert set(m) == {"metric", "value", "unit", "vs_baseline"}, m
+        assert isinstance(m["value"], (int, float)) and m["value"] > 0, m
+        by_name[m["metric"]] = m
+
+    # Every headline row resolves a ratio vs the published baseline.
+    for name in (
+        "serving_p50_latency_ms",
+        "serving_p99_latency_ms",
+        "serving_goodput_under_overload",
+        "serving_checkpoint_roll_seconds",
+    ):
+        assert name in by_name, (name, sorted(by_name))
+        assert by_name[name]["vs_baseline"] is not None, by_name[name]
+
+    # The chaos gate ran (nonzero exit would have tripped above) and
+    # published its acked-request count; it is a gate, not a ratio.
+    chaos = by_name["serving_chaos_acked_requests"]
+    assert chaos["value"] == 64
+    assert "failed=0" in chaos["unit"]
+    assert "coverage={'replica_kill': 1}" in result.stderr

@@ -1,0 +1,385 @@
+"""ServingDeployment reconciliation (`controllers/serving.py`) and the
+replica worker loop (`serving/__main__.py`).
+
+The CR declares the fleet; the controller materializes one owned
+ServingReplica object per index (the config-push channel — replica
+workers watch their own object, PR 2 machinery), aggregates per-replica
+readiness into status, converges replica count to the autoscale target,
+and runs a drain-based one-at-a-time roll on a modelVersion bump. All
+tests drive `run_until_idle()` against a scripted runtime so convergence
+is deterministic.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api import serving as serving_api
+from kubeflow_tpu.controllers.serving import ServingDeploymentController
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.fake_apiserver import NotFound
+
+
+class FakeRuntime:
+    """Scripted materialization backend: every replica is a dict."""
+
+    def __init__(self):
+        self.replicas: dict[str, dict] = {}
+        self.rolls: list[str] = []
+        self.stopped: list[str] = []
+
+    def names(self):
+        return list(self.replicas)
+
+    def ensure(self, name, rspec):
+        self.replicas.setdefault(
+            name,
+            {
+                "ready": True,
+                "version": int(rspec.get("modelVersion") or 1),
+                "queue_depth": 0,
+                "inflight": 0,
+                "queue_wait_ms": 0.0,
+            },
+        )
+
+    def stop(self, name):
+        self.replicas.pop(name, None)
+        self.stopped.append(name)
+
+    def roll(self, name, rspec):
+        self.replicas[name]["version"] = int(rspec["modelVersion"])
+        self.rolls.append(name)
+        return 0.01
+
+    def stats(self, name):
+        return self.replicas.get(name)
+
+
+@pytest.fixture()
+def harness():
+    api = FakeApiServer()
+    runtime = FakeRuntime()
+    controller = ServingDeploymentController(api, runtime=runtime)
+    return api, runtime, controller
+
+
+def converge(controller):
+    controller.controller.run_until_idle()
+
+
+def dep_status(api, name="fleet"):
+    return api.get(serving_api.KIND, name, "default").status
+
+
+def test_create_materializes_replicas_and_status(harness):
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment("fleet", replicas=3)
+    )
+    converge(controller)
+
+    names = [serving_api.replica_name("fleet", i) for i in range(3)]
+    assert sorted(runtime.replicas) == names
+    for rname in names:
+        robj = api.get(serving_api.REPLICA_KIND, rname, "default")
+        assert (
+            robj.metadata.labels[serving_api.LABEL_DEPLOYMENT] == "fleet"
+        )
+        assert robj.metadata.owner_references[0]["name"] == "fleet"
+        assert robj.spec["batching"]["continuous"] is True
+        assert robj.status["ready"] is True  # stamped back for kubectl
+    status = dep_status(api)
+    assert status["phase"] == "Available"
+    assert status["readyReplicas"] == 3
+    assert [r["name"] for r in status["replicas"]] == names
+
+
+def test_scale_down_stops_and_deletes(harness):
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment("fleet", replicas=3)
+    )
+    converge(controller)
+
+    dep = api.get(serving_api.KIND, "fleet", "default").thaw()
+    spec = dict(dep.spec)
+    spec["replicas"] = 1
+    dep.spec = spec
+    api.update(dep)
+    converge(controller)
+
+    assert sorted(runtime.replicas) == [
+        serving_api.replica_name("fleet", 0)
+    ]
+    assert len(runtime.stopped) == 2
+    with pytest.raises(NotFound):
+        api.get(
+            serving_api.REPLICA_KIND,
+            serving_api.replica_name("fleet", 2),
+            "default",
+        )
+    assert dep_status(api)["readyReplicas"] == 1
+
+
+def test_autoscale_tracks_queue_depth(harness):
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment(
+            "fleet",
+            replicas=1,
+            autoscale={
+                "min_replicas": 1,
+                "max_replicas": 4,
+                "target_queue_depth": 10,
+            },
+        )
+    )
+    converge(controller)
+    assert len(runtime.replicas) == 1
+
+    # Queue pressure: 25 queued+executing over target 10 → 3 replicas.
+    r0 = serving_api.replica_name("fleet", 0)
+    runtime.replicas[r0]["queue_depth"] = 20
+    runtime.replicas[r0]["inflight"] = 5
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    assert len(runtime.replicas) == 3
+    assert dep_status(api)["targetReplicas"] == 3
+
+    # Pressure gone → back to min (never below it).
+    runtime.replicas[r0]["queue_depth"] = 0
+    runtime.replicas[r0]["inflight"] = 0
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    assert len(runtime.replicas) == 1
+    assert dep_status(api)["targetReplicas"] == 1
+
+
+def test_model_version_bump_rolls_each_replica(harness):
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment(
+            "fleet", replicas=3, model_version=1
+        )
+    )
+    converge(controller)
+
+    dep = api.get(serving_api.KIND, "fleet", "default").thaw()
+    spec = dict(dep.spec)
+    spec["modelVersion"] = 2
+    dep.spec = spec
+    api.update(dep)
+    converge(controller)
+
+    assert len(runtime.rolls) == 3
+    assert all(
+        r["version"] == 2 for r in runtime.replicas.values()
+    )
+    # The config push rode the replica objects too.
+    robj = api.get(
+        serving_api.REPLICA_KIND,
+        serving_api.replica_name("fleet", 0),
+        "default",
+    )
+    assert robj.spec["modelVersion"] == 2
+
+
+def test_roll_defers_while_a_sibling_is_down(harness):
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment(
+            "fleet", replicas=2, model_version=1
+        )
+    )
+    converge(controller)
+
+    # One replica is already not ready: taking another out for the roll
+    # would be an outage, so the roll must wait.
+    r1 = serving_api.replica_name("fleet", 1)
+    runtime.replicas[r1]["ready"] = False
+    dep = api.get(serving_api.KIND, "fleet", "default").thaw()
+    spec = dict(dep.spec)
+    spec["modelVersion"] = 2
+    dep.spec = spec
+    api.update(dep)
+    converge(controller)
+    assert runtime.rolls == []
+
+    runtime.replicas[r1]["ready"] = True
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    assert len(runtime.rolls) == 2
+
+
+def test_invalid_spec_is_terminal_failed(harness):
+    api, runtime, controller = harness
+    dep = serving_api.make_serving_deployment("fleet", replicas=1)
+    spec = dict(dep.spec)
+    spec["replicas"] = -2
+    dep.spec = spec
+    api.create(dep)
+    converge(controller)
+
+    status = dep_status(api)
+    assert status["phase"] == "Failed"
+    assert "replicas" in status["reason"]
+    assert runtime.replicas == {}
+
+
+def test_delete_tears_down_fleet(harness):
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment("fleet", replicas=2)
+    )
+    converge(controller)
+    assert len(runtime.replicas) == 2
+
+    api.delete(serving_api.KIND, "fleet", "default")
+    converge(controller)
+    assert runtime.replicas == {}
+    assert api.list(serving_api.REPLICA_KIND, "default") == []
+
+
+def test_config_push_updates_replica_spec(harness):
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment(
+            "fleet", replicas=1, batch_timeout_ms=5.0
+        )
+    )
+    converge(controller)
+
+    dep = api.get(serving_api.KIND, "fleet", "default").thaw()
+    spec = dict(dep.spec)
+    spec["batching"] = {**spec["batching"], "timeoutMs": 9.0}
+    dep.spec = spec
+    api.update(dep)
+    converge(controller)
+
+    robj = api.get(
+        serving_api.REPLICA_KIND,
+        serving_api.replica_name("fleet", 0),
+        "default",
+    )
+    assert robj.spec["batching"]["timeoutMs"] == 9.0
+
+
+# -- the replica worker loop (`python -m kubeflow_tpu.serving`) -------------
+
+
+class FakeServable:
+    def __init__(self, name, version):
+        self.name = name
+        self.version = version
+
+
+class FakeRepository:
+    def __init__(self):
+        self.models: dict[str, FakeServable] = {}
+        self.loads = 0
+
+    def get(self, name):
+        return self.models[name]
+
+    def load(self, servable):
+        self.models[servable.name] = servable
+        self.loads += 1
+
+
+def build_servable(rspec):
+    return FakeServable(
+        rspec.get("model", "demo"), int(rspec.get("modelVersion") or 1)
+    )
+
+
+def make_replica_object(api, version=1):
+    from kubeflow_tpu.api.objects import new_resource
+
+    api.create(
+        new_resource(
+            serving_api.REPLICA_KIND,
+            "r0",
+            "default",
+            spec={"model": "demo", "modelVersion": version},
+        )
+    )
+
+
+def test_sync_replica_once_loads_and_stamps_status():
+    from kubeflow_tpu.serving.__main__ import sync_replica_once
+
+    api = FakeApiServer()
+    make_replica_object(api, version=3)
+    repo = FakeRepository()
+
+    live = sync_replica_once(
+        api, "r0", "default", repo,
+        build_servable=build_servable,
+        endpoint="127.0.0.1:9999",
+        queue_stats=lambda: {"queue_depth": 7, "inflight": 2},
+    )
+    assert live == 3
+    assert repo.loads == 1
+    status = api.get(serving_api.REPLICA_KIND, "r0", "default").status
+    assert status["ready"] is True
+    assert status["version"] == 3
+    assert status["endpoint"] == "127.0.0.1:9999"
+    assert status["queueDepth"] == 7 and status["inflight"] == 2
+
+    # Idempotent: a second sync at the same version does not reload.
+    sync_replica_once(
+        api, "r0", "default", repo, build_servable=build_servable
+    )
+    assert repo.loads == 1
+
+
+def test_sync_replica_once_none_when_object_gone():
+    from kubeflow_tpu.serving.__main__ import sync_replica_once
+
+    api = FakeApiServer()
+    repo = FakeRepository()
+    assert (
+        sync_replica_once(
+            api, "r0", "default", repo, build_servable=build_servable
+        )
+        is None
+    )
+
+
+def test_run_replica_hot_swaps_on_config_push_and_exits_on_delete():
+    from kubeflow_tpu.serving.__main__ import run_replica
+
+    api = FakeApiServer()
+    make_replica_object(api, version=1)
+    repo = FakeRepository()
+    t = threading.Thread(
+        target=run_replica,
+        args=(api, "r0", "default", repo),
+        kwargs={"build_servable": build_servable, "heartbeat_s": 0.05},
+        daemon=True,
+    )
+    t.start()
+
+    deadline = time.monotonic() + 5
+    while repo.loads == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert repo.models["demo"].version == 1
+
+    # The controller bumps modelVersion on the replica object; the
+    # worker's watch reacts — the hot-swap config push, no polling.
+    robj = api.get(serving_api.REPLICA_KIND, "r0", "default").thaw()
+    robj.spec = {**robj.spec, "modelVersion": 2}
+    api.update(robj)
+    deadline = time.monotonic() + 5
+    while (
+        repo.models["demo"].version != 2 and time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
+    assert repo.models["demo"].version == 2
+
+    # Deployment deleted → object gone → the worker loop returns.
+    api.delete(serving_api.REPLICA_KIND, "r0", "default")
+    t.join(timeout=5)
+    assert not t.is_alive()

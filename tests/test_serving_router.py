@@ -1,0 +1,283 @@
+"""Drain-aware router contracts (`serving/router.py`).
+
+The router replaces TF-Serving's external L7 balancer (docs/parity.md
+carries the deviation): spread by least-outstanding, idempotent retry on
+replica death, honest load shedding with Retry-After, and the drain /
+roll choreography a zero-downtime checkpoint swap rides on. The chaos
+bench gates `acked == completed + failed, failed == 0`; these tests pin
+the same accounting at unit scale, including every arm of the drain
+matrix (in-flight completes, no new admissions, re-admit after swap,
+kill-mid-drain falls back to a survivor).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.serving.router import (
+    NoReadyReplicas,
+    Overloaded,
+    ReplicaGone,
+    ReplicaOverloaded,
+    Router,
+)
+
+
+class FakeReplica:
+    """Scriptable replica: gate to hold requests in flight, kill to make
+    every (current and future) call die with ReplicaGone, fail_once to
+    script a single scripted exception."""
+
+    def __init__(self, name, capacity=8):
+        self.name = name
+        self.capacity = capacity
+        self.calls = 0
+        self.gate = None
+        self.fail_once = None
+        self._killed = threading.Event()
+        self._lock = threading.Lock()
+
+    def kill(self):
+        self._killed.set()
+        if self.gate is not None:
+            self.gate.set()
+
+    def predict(self, x):
+        with self._lock:
+            self.calls += 1
+            fail, self.fail_once = self.fail_once, None
+        if fail is not None:
+            raise fail
+        if self.gate is not None:
+            self.gate.wait(10)
+        if self._killed.is_set():
+            raise ReplicaGone(f"{self.name} killed")
+        return ("ok", self.name, x)
+
+    def stats(self):
+        return {"ready": not self._killed.is_set()}
+
+
+def make_fleet(n=2, capacity=8):
+    router = Router()
+    replicas = [FakeReplica(f"r{i}", capacity) for i in range(n)]
+    for r in replicas:
+        router.add(r)
+    return router, replicas
+
+
+def counts(router):
+    return {
+        "acked": router.acked_total.value(),
+        "completed": router.completed_total.value(),
+        "failed": router.failed_total.value(),
+        "shed": router.shed_total.value(),
+    }
+
+
+def test_spread_prefers_least_outstanding():
+    router, (a, b) = make_fleet(2)
+    a.gate = threading.Event()  # first request parks on a replica...
+
+    t = threading.Thread(target=router.predict, args=(1,))
+    t.start()
+    deadline = time.monotonic() + 5
+    while a.calls + b.calls == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    busy, idle = (a, b) if a.calls else (b, a)
+
+    idle.gate = None
+    # ...so the next one lands on the idle sibling, not round-robin luck.
+    _, served_by, _ = router.predict(2)
+    assert served_by == idle.name
+    busy.gate.set()
+    t.join(timeout=5)
+
+
+def test_retry_on_replica_death_idempotent():
+    router, (a, b) = make_fleet(2)
+    a.fail_once = ReplicaGone("connection reset")
+    b.fail_once = None
+
+    results = {router.predict(i)[1] for i in range(4)}
+    # Whichever replica died, everything completed on the survivor.
+    assert results  # no exception escaped
+    c = counts(router)
+    assert c["acked"] == 4 and c["completed"] == 4
+    assert c["failed"] == 0
+    assert router.retried_total.value() == 1
+    # The dead replica is out of the ready set.
+    assert len(router.ready_names()) == 1
+
+
+def test_non_idempotent_death_fails_fast():
+    router, (a, b) = make_fleet(2)
+    a.fail_once = ReplicaGone("reset")
+    b.fail_once = ReplicaGone("reset")
+    with pytest.raises(ReplicaGone):
+        router.predict(1, idempotent=False)
+    c = counts(router)
+    assert c["failed"] == 1 and c["completed"] == 0
+    assert c["acked"] == 1  # acked, then honestly accounted as failed
+
+
+def test_model_error_propagates_without_retry():
+    router, (a, b) = make_fleet(2)
+    a.fail_once = ValueError("bad input shape")
+    b.fail_once = ValueError("bad input shape")
+    with pytest.raises(ValueError):
+        router.predict(1)
+    # Exactly one replica executed: a request failing on its merits must
+    # not burn the fleet retrying it.
+    assert a.calls + b.calls == 1
+    assert counts(router)["failed"] == 1
+
+
+def test_no_replicas_raises_no_ready():
+    router = Router()
+    with pytest.raises(NoReadyReplicas):
+        router.predict(1)
+
+
+def test_shed_with_retry_after_when_at_capacity():
+    router, (a, b) = make_fleet(2, capacity=1)
+    a.gate = threading.Event()
+    b.gate = threading.Event()
+    threads = [
+        threading.Thread(target=router.predict, args=(i,))
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while a.calls + b.calls < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+
+    with pytest.raises(Overloaded) as exc:
+        router.predict(99)
+    assert exc.value.retry_after > 0
+    c = counts(router)
+    assert c["shed"] == 1
+    assert c["acked"] == 2  # the shed request was never acknowledged
+    a.gate.set()
+    b.gate.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert counts(router)["completed"] == 2
+
+
+def test_replica_overloaded_tries_sibling():
+    router, (a, b) = make_fleet(2)
+    a.fail_once = ReplicaOverloaded("queue full")
+    b.fail_once = ReplicaOverloaded("queue full")
+    # One of them refuses; the other (whose fail already fired or not)
+    # may refuse too — but a second pass succeeds within the deadline.
+    assert router.predict(1)[0] == "ok"
+    assert counts(router)["failed"] == 0
+
+
+# -- the drain matrix -------------------------------------------------------
+
+
+def test_drain_waits_for_inflight_then_blocks_admission():
+    router, (a, b) = make_fleet(2)
+    a.gate = threading.Event()
+    t = threading.Thread(target=router.predict, args=(1,))
+    t.start()
+    deadline = time.monotonic() + 5
+    while a.calls + b.calls == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    busy, other = (a, b) if a.calls else (b, a)
+
+    drained = []
+    dt = threading.Thread(
+        target=lambda: drained.append(router.drain(busy.name, timeout=10))
+    )
+    dt.start()
+    time.sleep(0.05)
+    assert not drained  # in-flight work pins the drain
+
+    # No new admissions to the draining replica: traffic flows to the
+    # sibling the whole time.
+    before = busy.calls
+    for i in range(3):
+        assert router.predict(i)[1] == other.name
+    assert busy.calls == before
+
+    busy.gate.set()  # in-flight request completes...
+    dt.join(timeout=5)
+    assert drained == [True]  # ...and the drain observes it
+    assert counts(router)["failed"] == 0
+
+
+def test_roll_swaps_quiesced_and_readmits():
+    router, (a, b) = make_fleet(2)
+    a.gate = threading.Event()
+    t = threading.Thread(target=router.predict, args=(1,))
+    t.start()
+    deadline = time.monotonic() + 5
+    while a.calls + b.calls == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    busy = a if a.calls else b
+
+    quiesced = []
+
+    def swap():
+        # Router.roll's contract: swap_fn runs with zero in-flight work.
+        quiesced.append(router.stats()["replicas"][busy.name]["outstanding"])
+
+    threading.Timer(0.05, busy.gate.set).start()
+    out_of_rotation = router.roll(busy.name, swap, timeout=10)
+    t.join(timeout=5)
+    assert quiesced == [0]
+    assert out_of_rotation >= 0.0
+    # Re-admitted: the rolled replica serves traffic again.
+    assert busy.name in router.ready_names()
+    busy.gate = None
+    served = {router.predict(i)[1] for i in range(8)}
+    assert busy.name in served
+
+
+def test_kill_mid_drain_falls_back_to_survivor():
+    router, (a, b) = make_fleet(2)
+    a.gate = threading.Event()
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(router.predict(1))
+    )
+    t.start()
+    deadline = time.monotonic() + 5
+    while a.calls + b.calls == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    busy, other = (a, b) if a.calls else (b, a)
+    other.gate = None
+
+    drained = []
+    dt = threading.Thread(
+        target=lambda: drained.append(router.drain(busy.name, timeout=10))
+    )
+    dt.start()
+    time.sleep(0.05)
+    busy.kill()  # SIGKILL mid-drain: in-flight dies with ReplicaGone
+
+    t.join(timeout=5)
+    dt.join(timeout=5)
+    # The in-flight request failed over to the survivor — acked work is
+    # never dropped — and the drain still completed.
+    assert results and results[0][1] == other.name
+    assert drained == [True]
+    c = counts(router)
+    assert c["acked"] == c["completed"] == 1
+    assert c["failed"] == 0
+    assert router.retried_total.value() == 1
+
+
+def test_all_draining_is_overloaded_not_dead():
+    router, (a, b) = make_fleet(2)
+    router.drain(a.name, timeout=1)
+    router.drain(b.name, timeout=1)
+    with pytest.raises(Overloaded):
+        router.predict(1)
+    router.admit(a.name)
+    assert router.predict(1)[0] == "ok"
