@@ -63,7 +63,7 @@ def main() -> None:
         "--workload",
         choices=(
             "all", "resnet", "lm", "serving", "study", "chaos",
-            "controlplane", "attention", "pipeline", "resilience",
+            "controlplane", "attention", "pipeline", "resilience", "rl",
         ),
         default="all",
         help="all (default) = resnet then lm, so the driver artifact "
@@ -87,7 +87,13 @@ def main() -> None:
         "SIGTERM, checkpoint/manifest corruption, loss spikes) — "
         "reports goodput, steps lost per kill and recovery time, and "
         "prints the seed so any failure reproduces with "
-        "KFTPU_RESILIENCE_SEED=<seed>",
+        "KFTPU_RESILIENCE_SEED=<seed>; rl = the Podracer-style "
+        "actor-learner workload: an in-proc loop (actors through the "
+        "serving stack, guarded fit() learner, checkpoint-roll weight "
+        "publication) plus the seeded chaos-gated StudyJob soak — "
+        "reports studies/hour, learner throughput under actor traffic, "
+        "actor steps/sec and publish->actor latency; reproduces with "
+        "KFTPU_RL_SEED=<seed>",
     )
     parser.add_argument(
         "--chaos-seed",
@@ -235,6 +241,16 @@ def main() -> None:
         "mode)",
     )
     parser.add_argument(
+        "--rl-steps", type=int, default=48,
+        help="rl only: learner steps for the in-proc actor-learner "
+        "phase (the soak phase sizes itself)",
+    )
+    parser.add_argument(
+        "--rl-publish-every", type=int, default=12,
+        help="rl only: learner steps between weight publications in "
+        "the in-proc phase (also the checkpoint save interval)",
+    )
+    parser.add_argument(
         "--cp-watchers", type=int, default=50,
         help="controlplane only: streaming watch connections held "
         "against the facade during the fan-out phase",
@@ -290,6 +306,8 @@ def main() -> None:
         return bench_chaos(args)
     if args.workload == "resilience":
         return bench_resilience(args)
+    if args.workload == "rl":
+        return bench_rl(args)
     if args.workload == "controlplane":
         return bench_controlplane(args)
     bench_resnet(args)
@@ -1413,6 +1431,303 @@ def bench_resilience(args) -> None:
         f"# elastic resize soak converged in {elapsed_e:.1f}s "
         f"(seed {seed}, coverage={me['coverage']}, "
         f"mean resize {me['resize_seconds']:.3f}s)",
+        file=sys.stderr,
+    )
+
+
+def bench_rl(args) -> None:
+    """Podracer-style RL workload (ISSUE 12): control plane, serving,
+    and training load-bearing AT ONCE.
+
+    Phase A (in-proc): one actor–learner loop — CR-materialized policy
+    fleet behind the drain-aware router, actors rolling out through the
+    continuous batcher, a stock guarded `fit()` learner on the bounded
+    replay queue, weight publication riding checkpoint-save →
+    modelVersion bump → drain roll. Emits actor steps/sec, the
+    publish→actor observation latency, and the learner-throughput
+    RATIO under actor traffic vs the SAME compiled step solo
+    (`rl_learner_mfu_under_actor_traffic` — a ratio, not an absolute
+    MFU: on the CPU CI host absolute MFU is meaningless, but the ratio
+    measures exactly what the Sebulba split promises, a learner that
+    actor traffic does not slow down). The loaded measurement feeds
+    the step synthetically while REAL actors hammer the serving fleet:
+    data-starvation (the queue's supply rate, visible separately as
+    `rl_actor_steps_per_sec`) must not masquerade as learner slowdown.
+
+    Phase B: the seeded chaos-gated study soak
+    (`test_rl_soak_nightly`) as a subprocess — StudyJob sweeping RL
+    trials, each trial its own actor–learner worker process, while the
+    fault schedule kills an actor replica, a learner, and a whole
+    trial. Emits studies/hour and hard-fails unless the study lands
+    with zero lost trials and every RL fault class shows
+    worker-reported evidence. Same repro contract as the other soaks:
+    the seed is printed up front and KFTPU_RL_SEED=<seed> (or
+    --chaos-seed) replays the byte-identical schedule."""
+    import itertools
+    import os
+    import random
+    import shutil
+    import subprocess
+    import tempfile
+
+    import jax.numpy as jnp
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if args.chaos_seed is not None:
+        seed = args.chaos_seed
+    elif os.environ.get("KFTPU_RL_SEED"):
+        seed = int(os.environ["KFTPU_RL_SEED"])
+    else:
+        seed = random.randrange(2**31)
+    print(f"# rl soak seed={seed}", file=sys.stderr)
+
+    from kubeflow_tpu.api import serving as serving_api
+    from kubeflow_tpu.controllers.serving import ServingDeploymentController
+    from kubeflow_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_tpu.rl.env import EnvConfig
+    from kubeflow_tpu.rl.loop import (
+        RLConfig,
+        build_learner,
+        run_actor_learner,
+    )
+    from kubeflow_tpu.rl.policy import PolicyCheckpointPublisher
+    from kubeflow_tpu.rl.replay import ReplayQueue
+    from kubeflow_tpu.serving.replica import LocalReplicaRuntime
+    from kubeflow_tpu.serving.router import Router
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+    from kubeflow_tpu.train import Checkpointer
+
+    cfg = RLConfig(
+        env=EnvConfig(
+            seed=seed % 1000, obs_dim=8, n_actions=4, n_envs=8, horizon=4
+        ),
+        hidden=32,
+        total_steps=args.rl_steps,
+        publish_every=args.rl_publish_every,
+        staleness_bound=2 * args.rl_publish_every,
+        n_actors=2,
+    )
+    mesh = build_mesh(MeshSpec(dp=2), jax.devices()[:2])
+
+    # Solo learner throughput: the same compiled step, no actors, no
+    # queue — the denominator of the under-traffic ratio.
+    solo = build_learner(cfg, mesh)
+    state = solo.init_state(jax.random.PRNGKey(0))
+    step = solo.make_train_step()
+    b = cfg.batch_size
+    batch = {
+        "obs": jax.device_put(
+            jnp.zeros((b, cfg.env.obs_dim), jnp.float32),
+            solo.batch_sharding(2),
+        ),
+        "target": jax.device_put(
+            jnp.zeros((b, 2), jnp.float32), solo.batch_sharding(2)
+        ),
+    }
+    solo_steps = max(10, args.rl_steps)
+    elapsed_solo, _ = timed_run(
+        step, state, itertools.repeat(batch), 3, solo_steps
+    )
+    solo_sps = solo_steps / elapsed_solo
+
+    workdir = tempfile.mkdtemp(prefix="rl-bench-")
+    try:
+        ckpt_dir = os.path.join(workdir, "ckpt")
+        trainer = build_learner(cfg, mesh)
+        publisher = PolicyCheckpointPublisher(
+            ckpt_dir,
+            trainer.abstract_state,
+            obs_dim=cfg.env.obs_dim,
+            n_actions=cfg.env.n_actions,
+            hidden=cfg.hidden,
+            device=jax.devices("cpu")[0],
+        )
+        api = FakeApiServer()
+        router = Router()
+        ctl = ServingDeploymentController(
+            api, runtime=LocalReplicaRuntime(router, publisher)
+        )
+        api.create(
+            serving_api.make_serving_deployment(
+                "rl-policy", model="policy", replicas=2, max_batch=8,
+                batch_timeout_ms=1.0,
+            )
+        )
+        ctl.controller.run_until_idle()
+
+        # Learner throughput UNDER actor traffic: the same compiled
+        # step on synthetic batches while real actors drive rollouts
+        # through the fleet — pure host contention, no data coupling.
+        import threading
+
+        from kubeflow_tpu.rl.env import VectorEnv, rollout
+        from kubeflow_tpu.rl.loop import _RouterPolicy
+
+        stop = threading.Event()
+
+        def act(actor_id: int) -> None:
+            env = VectorEnv(cfg.env)
+            policy = _RouterPolicy(router, timeout_s=30)
+            index = actor_id
+            while not stop.is_set():
+                try:
+                    rollout(env, policy, index)
+                except Exception:
+                    if stop.is_set():
+                        return
+                index += cfg.n_actors
+
+        actors = [
+            threading.Thread(target=act, args=(a,), daemon=True)
+            for a in range(cfg.n_actors)
+        ]
+        for t in actors:
+            t.start()
+        try:
+            # Fresh state: the solo run's buffers were donated.
+            elapsed_loaded, _ = timed_run(
+                step,
+                solo.init_state(jax.random.PRNGKey(1)),
+                itertools.repeat(batch),
+                3,
+                solo_steps,
+            )
+        finally:
+            stop.set()
+            for t in actors:
+                t.join(timeout=30)
+        loaded_sps = solo_steps / elapsed_loaded
+
+        ckpt = Checkpointer(
+            ckpt_dir, save_interval_steps=cfg.publish_every
+        )
+        queue = ReplayQueue(
+            capacity=cfg.replay_capacity,
+            staleness_bound=cfg.staleness_bound,
+            mesh=mesh,
+            stall_timeout_s=120,
+        )
+        try:
+            result = run_actor_learner(
+                api=api,
+                deployment="rl-policy",
+                router=router,
+                trainer=trainer,
+                checkpointer=ckpt,
+                queue=queue,
+                cfg=cfg,
+                reconcile=ctl.controller.run_until_idle,
+            )
+        finally:
+            ckpt.close()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    latencies = result.publish_latencies
+    if not latencies:
+        print("# rl: no publication was ever observed by an actor",
+              file=sys.stderr)
+        raise SystemExit(1)
+    mfu_ratio = loaded_sps / solo_sps
+    print(
+        f"# rl loop: {result.trajectories} trajectories, "
+        f"{result.publishes[-1].version}-step learner; step rate "
+        f"{loaded_sps:.1f}/s under actor traffic vs {solo_sps:.1f}/s "
+        f"solo; coupled-loop learner {result.learner_steps_per_sec:.1f} "
+        f"steps/s (data-bound by design), {result.stale_dropped} stale "
+        f"dropped, {result.predict_retries} predict retries",
+        file=sys.stderr,
+    )
+
+    # Phase B: the chaos-gated study soak (subprocess, same pattern as
+    # the resilience soaks — the gate lives in the test).
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        metrics_path = f.name
+    try:
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "pytest",
+                "tests/e2e/test_rl_soak_e2e.py::test_rl_soak_nightly",
+                "-q", "-rs", "-p", "no:cacheprovider",
+                "-p", "no:randomly",
+            ],
+            cwd=repo,
+            env={
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "KFTPU_RL_SEED": str(seed),
+                "KFTPU_RL_METRICS": metrics_path,
+            },
+            capture_output=True,
+            text=True,
+        )
+        soak_elapsed = time.perf_counter() - t0
+        sys.stderr.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            print(
+                f"# rl soak FAILED (seed {seed}) — reproduce the exact "
+                f"fault schedule with:\n"
+                f"#   KFTPU_RL_SEED={seed} python bench.py --workload rl "
+                f"--chaos-seed {seed}",
+                file=sys.stderr,
+            )
+            raise SystemExit(proc.returncode)
+        with open(metrics_path) as f:
+            soak = json.load(f)
+    finally:
+        try:
+            os.unlink(metrics_path)
+        except OSError:
+            pass
+
+    rows = (
+        (
+            "rl_studies_per_hour",
+            round(soak["studies_per_hour"], 2),
+            f"chaos-gated RL studies/hour ({soak['trials']} trials, "
+            "zero lost; higher is better)",
+            _published_baseline("rl_studies_per_hour"),
+        ),
+        (
+            "rl_learner_mfu_under_actor_traffic",
+            round(mfu_ratio, 4),
+            "learner steps/sec under actor traffic vs the same step "
+            "solo (ratio; higher is better)",
+            _published_baseline("rl_learner_mfu_under_actor_traffic"),
+        ),
+        (
+            "rl_actor_steps_per_sec",
+            round(result.actor_steps_per_sec, 1),
+            f"env steps/sec through the serving stack "
+            f"({cfg.n_actors} actors, 2 replicas; higher is better)",
+            _published_baseline("rl_actor_steps_per_sec"),
+        ),
+        (
+            "rl_policy_publish_to_actor_seconds",
+            round(max(latencies), 3),
+            "worst modelVersion bump -> first actor-observed tagged "
+            "response (lower is better)",
+            _published_baseline("rl_policy_publish_to_actor_seconds"),
+        ),
+    )
+    for metric, value, unit, base in rows:
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": unit,
+                    "vs_baseline": (
+                        round(value / base, 4) if base else None
+                    ),
+                }
+            )
+        )
+    print(
+        f"# rl soak converged in {soak_elapsed:.1f}s (seed {seed}, "
+        f"coverage={soak['coverage']}) — zero lost studies",
         file=sys.stderr,
     )
 

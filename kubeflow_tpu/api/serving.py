@@ -41,6 +41,11 @@ class AutoscaleSpec:
     # Desired steady-state queued requests per replica. Depth above this
     # scales out; an idle fleet settles back to min_replicas.
     target_queue_depth: int = 32
+    # Observed-latency signal: rolling p99 queue-wait above this scales
+    # out even when queues look shallow (slow-drain pathology: a fleet
+    # whose batches execute slowly can hold SLO-busting waits at modest
+    # depth). 0 disables the signal — depth-only, the original policy.
+    target_latency_ms: float = 0.0
 
     def validate(self) -> None:
         if self.min_replicas < 1:
@@ -57,10 +62,37 @@ class AutoscaleSpec:
                 f"autoscale.targetQueueDepth must be >= 1, got "
                 f"{self.target_queue_depth}"
             )
+        if self.target_latency_ms < 0:
+            raise ValueError(
+                f"autoscale.targetLatencyMs must be >= 0, got "
+                f"{self.target_latency_ms}"
+            )
 
-    def target(self, total_queue_depth: int) -> int:
-        """Desired replica count for an observed fleet-wide queue depth."""
+    def target(
+        self,
+        total_queue_depth: int,
+        *,
+        p99_latency_ms: float | None = None,
+        current_replicas: int | None = None,
+    ) -> int:
+        """Desired replica count from the observed signals.
+
+        Two signals, scale-up wins (HPA's max-over-metrics rule): the
+        queue-depth want is ``ceil(depth / target_depth)``; the latency
+        want is the HPA proportional form ``ceil(current * p99/target)``
+        — when they disagree the fleet converges to the larger, so a
+        latency breach is never masked by shallow queues and a deep
+        backlog is never masked by fast batches."""
         want = math.ceil(total_queue_depth / self.target_queue_depth)
+        if (
+            self.target_latency_ms > 0
+            and p99_latency_ms is not None
+            and current_replicas
+        ):
+            latency_want = math.ceil(
+                current_replicas * p99_latency_ms / self.target_latency_ms
+            )
+            want = max(want, latency_want)
         return max(self.min_replicas, min(self.max_replicas, want))
 
 
@@ -84,11 +116,21 @@ class ServingDeploymentSpec:
     # replica loaded; a bump triggers a one-replica-at-a-time drain-based
     # roll (zero downtime — the rest of the fleet keeps admitting).
     model_version: int = 0
+    # How replicas are materialized: "local" = in-process servables
+    # behind the controller's router (dev/bench single-binary shape);
+    # "process" = real `python -m kubeflow_tpu.serving` worker
+    # processes that join the fleet over the apiserver facade and
+    # self-roll on config push.
+    runtime: str = "local"
     autoscale: AutoscaleSpec | None = None
 
     def validate(self) -> None:
         if not self.model:
             raise ValueError("model name must be non-empty")
+        if self.runtime not in ("local", "process"):
+            raise ValueError(
+                f"runtime must be 'local' or 'process', got {self.runtime!r}"
+            )
         if self.replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {self.replicas}")
         if self.max_batch < 1:
@@ -114,11 +156,13 @@ class ServingDeploymentSpec:
             },
             "checkpointDir": self.checkpoint_dir,
             "modelVersion": self.model_version,
+            "runtime": self.runtime,
             "autoscale": (
                 {
                     "minReplicas": self.autoscale.min_replicas,
                     "maxReplicas": self.autoscale.max_replicas,
                     "targetQueueDepth": self.autoscale.target_queue_depth,
+                    "targetLatencyMs": self.autoscale.target_latency_ms,
                 }
                 if self.autoscale is not None
                 else None
@@ -168,6 +212,9 @@ class ServingDeploymentSpec:
                 target_queue_depth=int(
                     autoscale_d.get("targetQueueDepth", 32)
                 ),
+                target_latency_ms=float(
+                    autoscale_d.get("targetLatencyMs", 0.0)
+                ),
             )
         spec = cls(
             model=d.get("model", "model"),
@@ -178,6 +225,7 @@ class ServingDeploymentSpec:
             continuous=bool(batching.get("continuous", True)),
             checkpoint_dir=d.get("checkpointDir", ""),
             model_version=int(d.get("modelVersion", 0)),
+            runtime=d.get("runtime", "local"),
             autoscale=autoscale,
         )
         spec.validate()
@@ -191,7 +239,8 @@ KNOWN_BATCHING_FIELDS = frozenset(
     ServingDeploymentSpec().to_dict()["batching"]
 )
 KNOWN_AUTOSCALE_FIELDS = frozenset(("minReplicas", "maxReplicas",
-                                    "targetQueueDepth"))
+                                    "targetQueueDepth",
+                                    "targetLatencyMs"))
 
 
 def replica_name(deployment: str, index: int) -> str:

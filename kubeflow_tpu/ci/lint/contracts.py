@@ -485,6 +485,117 @@ def _build_serving_batch_continuous() -> Program:
     )
 
 
+def _build_rl_learner_step() -> Program:
+    """The RL learner is the stock Trainer on a dp mesh (ISSUE 12):
+    its compiled step must be indistinguishable from any other dp train
+    step — gradient-sized all-reduce only. Trajectory ingestion,
+    serving traffic, and publication all live OFF the device program."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_tpu.rl.env import EnvConfig
+    from kubeflow_tpu.rl.loop import RLConfig, build_learner
+    from kubeflow_tpu.testing.hlo import compiled_hlo
+
+    _require_devices(2)
+    cfg = RLConfig(
+        env=EnvConfig(seed=0, obs_dim=8, n_actions=4, n_envs=8, horizon=4),
+        hidden=16,
+        total_steps=4,
+    )
+    mesh = build_mesh(MeshSpec(dp=2), jax.devices()[:2])
+    trainer = build_learner(cfg, mesh)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    step = trainer.make_train_step()
+    b = cfg.batch_size
+    batch = {
+        "obs": jax.device_put(
+            jnp.zeros((b, cfg.env.obs_dim), jnp.float32),
+            trainer.batch_sharding(2),
+        ),
+        "target": jax.device_put(
+            jnp.zeros((b, 2), jnp.float32), trainer.batch_sharding(2)
+        ),
+    }
+    cap = 1 + max(
+        leaf.size for leaf in jax.tree_util.tree_leaves(state.params)
+    )
+    return Program(
+        hlo=compiled_hlo(step, state, batch),
+        meta={"param_cap": cap},
+    )
+
+
+def _build_rl_actor_policy() -> Program:
+    """The actor side of the actor–learner split: the policy program
+    the serving replicas execute is single-device (zero collectives —
+    actors scale by adding replicas, never by sharding a rollout), and
+    the host-side acting loop (`_actor_loop`, `rollout`,
+    `sample_actions`) is numpy-only — no jax, no device sync. A
+    `block_until_ready` in the acting path would serialize every
+    rollout against device completion and the Sebulba split would
+    quietly degrade to lockstep."""
+    import ast as ast_mod
+    import pathlib
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.rl import env as env_mod
+    from kubeflow_tpu.rl import loop as loop_mod
+    from kubeflow_tpu.rl.policy import (
+        init_policy_variables,
+        make_policy_servable,
+    )
+    from kubeflow_tpu.testing.hlo import compiled_hlo
+
+    servable = make_policy_servable(
+        "contract-policy",
+        init_policy_variables(obs_dim=8, n_actions=4, hidden=16),
+        version=1,
+        n_actions=4,
+        hidden=16,
+        max_batch=8,
+    )
+
+    acting_fns = {
+        loop_mod.__file__: {"_actor_loop"},
+        env_mod.__file__: {"rollout", "sample_actions"},
+    }
+    found: set = set()
+    syncs: list[str] = []
+    for path, fns in acting_fns.items():
+        tree = ast_mod.parse(pathlib.Path(path).read_text())
+        for node in ast_mod.walk(tree):
+            if (
+                isinstance(node, ast_mod.FunctionDef)
+                and node.name in fns
+            ):
+                found.add(node.name)
+                for sub in ast_mod.walk(node):
+                    if isinstance(sub, ast_mod.Attribute) and sub.attr in (
+                        "block_until_ready", "device_get", "device_put",
+                    ):
+                        syncs.append(f"{node.name}: .{sub.attr}")
+                    if isinstance(sub, ast_mod.Name) and sub.id == "jax":
+                        syncs.append(f"{node.name}: jax")
+
+    return Program(
+        hlo=compiled_hlo(
+            servable._jitted,
+            servable.variables,
+            jnp.zeros((8, 8), jnp.float32),
+        ),
+        meta={
+            "no_host_sync_in_acting": (
+                not syncs
+                and found == {"_actor_loop", "rollout", "sample_actions"}
+            ),
+            "host_syncs": syncs,
+        },
+    )
+
+
 # -- the table --------------------------------------------------------------
 
 CONTRACTS: tuple[ProgramContract, ...] = (
@@ -552,6 +663,27 @@ CONTRACTS: tuple[ProgramContract, ...] = (
             "all-gather", "reduce-scatter", "all-reduce",
             "collective-permute", "all-to-all",
         ),
+    ),
+    ProgramContract(
+        name="rl-learner-step",
+        description="RL learner step: grad-sized all-reduce only",
+        build=_build_rl_learner_step,
+        expect_collectives=("all-reduce",),
+        forbid_collectives=(
+            "all-gather", "all-to-all", "collective-permute",
+        ),
+        allreduce_cap="param_cap",
+    ),
+    ProgramContract(
+        name="rl-actor-learner",
+        description="actor policy program: zero collectives; acting "
+        "loop free of host sync",
+        build=_build_rl_actor_policy,
+        forbid_collectives=(
+            "all-gather", "reduce-scatter", "all-reduce",
+            "collective-permute", "all-to-all",
+        ),
+        meta_true=("no_host_sync_in_acting",),
     ),
     ProgramContract(
         name="serving-batch-continuous",

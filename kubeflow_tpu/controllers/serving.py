@@ -82,13 +82,24 @@ class ServingDeploymentController:
         runtime=None,
         metrics: MetricsRegistry | None = None,
         resync_seconds: float = 1.0,
+        process_runtime=None,
     ):
         self.api = api
         metrics = metrics or MetricsRegistry()
         self.runtime = (
             runtime if runtime is not None else default_runtime(metrics)
         )
+        # `spec.runtime: process` fleets materialize here instead
+        # (`ProcessReplicaRuntime` — real model-server workers). None =
+        # such specs degrade to the in-process runtime, so a manager
+        # without a facade URL still reconciles everything.
+        self.process_runtime = process_runtime
         self.resync_seconds = resync_seconds
+        # Observed-latency autoscale signal: a rolling window of
+        # per-replica queue-wait samples per deployment. Controller
+        # state only (rebuilt from live stats after a restart) — never
+        # part of the API contract.
+        self._latency_windows: dict[tuple, object] = {}
         self.ready_replicas = metrics.gauge(
             "serving_ready_replicas",
             "replicas ready to admit traffic",
@@ -157,6 +168,17 @@ class ServingDeploymentController:
 
         retry_on_conflict(write)
 
+    def _runtimes(self) -> list:
+        runtimes = [self.runtime]
+        if self.process_runtime is not None:
+            runtimes.append(self.process_runtime)
+        return runtimes
+
+    def _runtime_for(self, spec) -> object:
+        if spec.runtime == "process" and self.process_runtime is not None:
+            return self.process_runtime
+        return self.runtime
+
     def _teardown(self, api, ns: str, name: str) -> None:
         for replica in api.list(
             serving_api.REPLICA_KIND,
@@ -166,18 +188,25 @@ class ServingDeploymentController:
             self._stop_replica(api, ns, replica.metadata.name)
         # The apiserver's owner-reference cascade may have deleted the
         # replica objects with the deployment — the runtime replicas
-        # behind them still need stopping.
-        names = getattr(self.runtime, "names", None)
-        if names is not None:
-            prefix = serving_api.replica_name(name, 0)[: -len("0")]
+        # behind them still need stopping. The CR (and its spec.runtime)
+        # is already gone, so sweep every runtime.
+        prefix = serving_api.replica_name(name, 0)[: -len("0")]
+        for runtime in self._runtimes():
+            names = getattr(runtime, "names", None)
+            if names is None:
+                continue
             for rname in list(names()):
                 if rname.startswith(prefix):
-                    self._stop_replica(api, ns, rname)
+                    self._stop_replica(api, ns, rname, runtime=runtime)
+        self._latency_windows.pop((ns, name), None)
 
-    def _stop_replica(self, api, ns: str, rname: str) -> None:
-        stop = getattr(self.runtime, "stop", None)
-        if stop is not None:
-            stop(rname)
+    def _stop_replica(
+        self, api, ns: str, rname: str, runtime=None
+    ) -> None:
+        for rt in [runtime] if runtime is not None else self._runtimes():
+            stop = getattr(rt, "stop", None)
+            if stop is not None:
+                stop(rname)
         try:
             api.delete(serving_api.REPLICA_KIND, rname, ns)
         except NotFound:
@@ -203,26 +232,37 @@ class ServingDeploymentController:
             )
 
         rspec = serving_api.replica_spec(spec)
+        runtime = self._runtime_for(spec)
 
-        # Autoscale on the observed fleet queue signal (queued + already
-        # executing — both represent demand a bigger fleet would absorb).
+        # Autoscale on the observed fleet signals: queue depth (queued +
+        # already executing — both represent demand a bigger fleet would
+        # absorb) and the rolling p99 of per-replica queue wait.
         existing = api.list(
             serving_api.REPLICA_KIND,
             ns,
             label_selector={serving_api.LABEL_DEPLOYMENT: name},
         )
         total_depth = 0
+        wait_samples = []
         for replica in existing:
-            stats = self._runtime_stats(replica.metadata.name)
+            stats = self._runtime_stats(runtime, replica.metadata.name)
             if stats is None:
                 stats = replica.status  # process replica self-report
                 total_depth += int(stats.get("queueDepth") or 0)
                 total_depth += int(stats.get("inflight") or 0)
+                wait = stats.get("queueWaitMs")
             else:
                 total_depth += int(stats.get("queue_depth") or 0)
                 total_depth += int(stats.get("inflight") or 0)
+                wait = stats.get("queue_wait_ms")
+            if wait:
+                wait_samples.append(float(wait))
         if spec.autoscale is not None:
-            target = spec.autoscale.target(total_depth)
+            target = spec.autoscale.target(
+                total_depth,
+                p99_latency_ms=self._observed_p99(ns, name, wait_samples),
+                current_replicas=len(existing),
+            )
         else:
             target = spec.replicas
 
@@ -242,22 +282,24 @@ class ServingDeploymentController:
 
         for rname in desired:
             self._ensure_replica_resource(api, dep, rname, rspec)
-            ensure = getattr(self.runtime, "ensure", None)
+            ensure = getattr(runtime, "ensure", None)
             if ensure is not None:
                 ensure(rname, rspec)
 
         # Drain-based checkpoint roll, one replica at a time, and only
         # while EVERY other replica is ready — the fleet keeps admitting
-        # during the whole roll (zero downtime).
+        # during the whole roll (zero downtime). Process replicas have
+        # no runtime roll surface: their workers self-roll on the config
+        # push above.
         if spec.model_version > 0:
-            self._roll_outdated(api, dep, spec, desired, rspec)
+            self._roll_outdated(api, dep, spec, desired, rspec, runtime)
 
         # Status: per-replica readiness (stamped onto the replica objects
         # too — the kubectl surface) aggregated onto the deployment.
         rows = []
         ready_count = 0
         for rname in desired:
-            stats = self._runtime_stats(rname)
+            stats = self._runtime_stats(runtime, rname)
             if stats is not None:
                 self._stamp_replica_status(api, ns, rname, stats)
                 row = {
@@ -302,26 +344,45 @@ class ServingDeploymentController:
             return Result(requeue_after=self.resync_seconds)
         return result
 
-    def _runtime_stats(self, rname: str) -> dict | None:
-        stats_fn = getattr(self.runtime, "stats", None)
+    def _runtime_stats(self, runtime, rname: str) -> dict | None:
+        stats_fn = getattr(runtime, "stats", None)
         if stats_fn is None:
             return None
         return stats_fn(rname)
 
+    def _observed_p99(
+        self, ns: str, name: str, samples: list
+    ) -> float | None:
+        """Rolling p99 queue wait across recent reconciles — the
+        latency half of the autoscale signal. None until a sample
+        exists (a cold fleet must not scale on latency it never
+        measured)."""
+        import collections
+
+        window = self._latency_windows.setdefault(
+            (ns, name), collections.deque(maxlen=200)
+        )
+        window.extend(samples)
+        if not window:
+            return None
+        ordered = sorted(window)
+        return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
     def _roll_outdated(
-        self, api, dep: Resource, spec, desired: list[str], rspec: dict
+        self, api, dep: Resource, spec, desired: list[str], rspec: dict,
+        runtime,
     ) -> None:
-        roll = getattr(self.runtime, "roll", None)
+        roll = getattr(runtime, "roll", None)
         if roll is None:
             return
         for rname in desired:
-            stats = self._runtime_stats(rname)
+            stats = self._runtime_stats(runtime, rname)
             if stats is None:
                 continue
             if int(stats.get("version") or 0) == spec.model_version:
                 continue
             others_ready = all(
-                (self._runtime_stats(o) or {}).get("ready")
+                (self._runtime_stats(runtime, o) or {}).get("ready")
                 for o in desired
                 if o != rname
             )

@@ -1,0 +1,207 @@
+"""The RL policy: one set of weights, two faces.
+
+- **Serving face** (`make_policy_servable`): a tiny MLP `Servable` the
+  actors query through the router/batcher. Its output carries one extra
+  column — the model VERSION, broadcast per row — so actors observe
+  which weights actually served each request *in-band*. That makes
+  `rl_policy_publish_to_actor_seconds` an honest end-to-end number
+  (CR bump → controller drain-roll → batcher swap → first tagged
+  response), not a controller-side timestamp diff.
+
+- **Learner face** (`PolicyWithLoss`): the same MLP wrapped in a
+  loss_in_model module so the REINFORCE objective rides the unmodified
+  `Trainer`/`fit()` path (dp mesh, AnomalyGuard, elastic resize —
+  nothing RL-specific in the trainer). Labels are packed
+  ``[action, return]`` columns, matching `Trajectory.transitions()`.
+
+- **Publication channel** (`PolicyCheckpointPublisher`): the serving
+  controller's servable factory. It materializes replicas FROM THE
+  LEARNER'S CHECKPOINT DIRECTORY — version = checkpoint step — so a
+  modelVersion bump on the ServingDeployment really does push freshly
+  trained weights through the drain-roll, the same way a production
+  roll would (docs/rl.md).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PolicyMLP(nn.Module):
+    """Actor-side policy network: obs -> action logits."""
+
+    n_actions: int = 4
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Dense(self.hidden)(x.astype(jnp.float32)))
+        return nn.Dense(self.n_actions)(x)
+
+
+class PolicyWithLoss(nn.Module):
+    """Learner-side wrapper: computes the REINFORCE loss in-model so the
+    stock trainer drives it (`loss_in_model=True`: the scalar return IS
+    the loss; requires train_metrics="loss", label_smoothing=0.0)."""
+
+    n_actions: int = 4
+    hidden: int = 32
+    entropy_bonus: float = 0.01
+
+    @nn.compact
+    def __call__(self, obs, train: bool = False, labels=None):
+        logits = PolicyMLP(self.n_actions, self.hidden, name="policy")(obs)
+        if labels is None:
+            # Shape-inference / init call (the trainer initializes with
+            # the example input only).
+            labels = jnp.zeros((obs.shape[0], 2), jnp.float32)
+        action = labels[:, 0].astype(jnp.int32)
+        ret = labels[:, 1]
+        logp = jax.nn.log_softmax(logits)
+        chosen = jnp.take_along_axis(logp, action[:, None], axis=1)[:, 0]
+        # Batch-mean baseline: enough variance reduction for a bandit
+        # horizon; anything fancier would make the task the story.
+        advantage = ret - jnp.mean(ret)
+        pg_loss = -jnp.mean(chosen * advantage)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=1))
+        return pg_loss - self.entropy_bonus * entropy
+
+
+def init_policy_variables(
+    obs_dim: int, n_actions: int, hidden: int, seed: int = 0
+):
+    """Fresh actor-face variables (the pre-first-publish fleet)."""
+    module = PolicyMLP(n_actions=n_actions, hidden=hidden)
+    return jax.jit(module.init)(
+        jax.random.PRNGKey(seed), np.zeros((1, obs_dim), np.float32)
+    )
+
+
+def extract_policy_variables(learner_params) -> dict:
+    """Project the learner's PolicyWithLoss params down to the serving
+    face (the wrapper adds one submodule level, no extra weights)."""
+    params = learner_params
+    if "params" in params:
+        params = params["params"]
+    return {"params": params["policy"]}
+
+
+def make_policy_servable(
+    name: str,
+    variables,
+    *,
+    version: int,
+    n_actions: int,
+    hidden: int,
+    max_batch: int = 64,
+    device=None,
+    obs_dim: int | None = None,
+):
+    """Build the version-tagged policy Servable.
+
+    Output shape is ``[B, n_actions + 1]``: logits, then the version
+    broadcast down a trailing column. `split_predictions` undoes it.
+    """
+    from kubeflow_tpu.serving.servable import Servable
+
+    module = PolicyMLP(n_actions=n_actions, hidden=hidden)
+    tag = float(int(version))
+
+    def apply_fn(vs, batch):
+        logits = module.apply(vs, batch, train=False)
+        col = jnp.full((logits.shape[0], 1), tag, logits.dtype)
+        return jnp.concatenate([logits, col], axis=1)
+
+    servable = Servable(
+        name,
+        apply_fn,
+        variables,
+        version=int(version),
+        max_batch=max_batch,
+        device=device,
+    )
+    if obs_dim is not None:
+        servable.warmup_with(np.zeros((obs_dim,), np.float32))
+    return servable
+
+
+def split_predictions(out: np.ndarray) -> tuple[np.ndarray, int]:
+    """(logits, served version) from a version-tagged response."""
+    return out[:, :-1], int(round(float(out[0, -1])))
+
+
+class PolicyCheckpointPublisher:
+    """Servable factory for `LocalReplicaRuntime`, reading weights back
+    out of the learner's checkpoint directory.
+
+    Before the first publish (rspec modelVersion == 0, or no committed
+    checkpoint yet) replicas serve a seeded fresh init at version 1 —
+    the fleet must be up and admitting before the learner has saved
+    anything. After a publish, the factory restores the latest committed
+    step and serves it at version == step; the controller's
+    `_roll_outdated` keeps rolling until the served version matches the
+    spec, so a restore racing the writer's in-flight save self-heals on
+    the next reconcile.
+    """
+
+    def __init__(
+        self,
+        ckpt_dir: str,
+        abstract_state_fn,
+        *,
+        obs_dim: int,
+        n_actions: int,
+        hidden: int,
+        init_seed: int = 0,
+        device=None,
+    ):
+        self._ckpt_dir = ckpt_dir
+        # Callable, not a state: the trainer may not exist yet when the
+        # fleet first materializes (and elastic resize may replace it).
+        self._abstract_state_fn = abstract_state_fn
+        self._obs_dim = obs_dim
+        self._n_actions = n_actions
+        self._hidden = hidden
+        self._init_seed = init_seed
+        self._device = device
+
+    def _restore(self):
+        from kubeflow_tpu.train.checkpoint import Checkpointer
+
+        try:
+            ckpt = Checkpointer(self._ckpt_dir, read_only=True)
+        except FileNotFoundError:
+            return None
+        try:
+            restored = ckpt.restore_latest(self._abstract_state_fn())
+        finally:
+            ckpt.close()
+        return restored
+
+    def __call__(self, rspec: dict):
+        want = int(rspec.get("modelVersion") or 0)
+        restored = self._restore() if want > 0 else None
+        if restored is None:
+            variables = init_policy_variables(
+                self._obs_dim, self._n_actions, self._hidden,
+                self._init_seed,
+            )
+            version = 1
+        else:
+            variables = extract_policy_variables(
+                {"params": restored.state.params}
+            )
+            version = max(int(restored.step), 1)
+        return make_policy_servable(
+            rspec.get("model", "policy"),
+            variables,
+            version=version,
+            n_actions=self._n_actions,
+            hidden=self._hidden,
+            max_batch=int(rspec.get("maxBatch", 64)),
+            device=self._device,
+            obs_dim=self._obs_dim,
+        )

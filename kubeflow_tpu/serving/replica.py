@@ -254,3 +254,118 @@ class LocalReplicaRuntime:
         if replica is None:
             return None
         return replica.stats()
+
+
+class ProcessReplicaRuntime:
+    """Replica fleet as REAL model-server processes
+    (``python -m kubeflow_tpu.serving --apiserver ... --replica ...``) —
+    the production shape behind ``spec.runtime: process``.
+
+    The split of responsibilities is deliberately thinner than
+    `LocalReplicaRuntime`'s: this runtime only SPAWNS and REAPS
+    processes. Config (model, batching, modelVersion) reaches a worker
+    through its ServingReplica object over the apiserver facade — the
+    worker self-rolls on config push (`serving/__main__.run_replica`),
+    stamps its own status, and advertises its endpoint there. So there
+    is no ``stats``/``roll`` surface here, ON PURPOSE: the serving
+    controller's replica-object fallback path carries readiness and the
+    roll, exactly as it would for workers on another machine.
+
+    When a ``router`` is given, each worker's advertised endpoint is
+    registered as an `HttpReplica` once it appears — in-process clients
+    (the RL actors, the bench) then reach process replicas through the
+    same drain-aware router surface as local ones.
+    """
+
+    def __init__(
+        self,
+        api,
+        apiserver_url: str,
+        *,
+        router: Router | None = None,
+        namespace: str = "default",
+        extra_env: dict | None = None,
+        python: str | None = None,
+    ):
+        import sys
+
+        self.api = api
+        self.apiserver_url = apiserver_url
+        self.router = router
+        self._namespace = namespace
+        self._extra_env = dict(extra_env or {})
+        self._python = python or sys.executable
+        self._procs: dict = {}
+
+    def names(self) -> list[str]:
+        return list(self._procs)
+
+    def ensure(self, name: str, rspec: dict) -> None:
+        """Idempotent: spawn the worker process if it isn't running
+        (a crashed worker is respawned on the next reconcile), and
+        register its advertised endpoint once it has one."""
+        import os
+        import subprocess
+
+        proc = self._procs.get(name)
+        if proc is None or proc.poll() is not None:
+            if proc is not None and self.router is not None:
+                # The old incarnation's endpoint is dead with it.
+                self.router.remove(name)
+            self._procs[name] = subprocess.Popen(
+                [
+                    self._python, "-m", "kubeflow_tpu.serving",
+                    "--host", "127.0.0.1", "--port", "0",
+                    "--apiserver", self.apiserver_url,
+                    "--replica", name,
+                    "--namespace", self._namespace,
+                ],
+                env={
+                    **os.environ,
+                    "JAX_PLATFORMS": "cpu",
+                    **self._extra_env,
+                },
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        self._register(name)
+
+    def _register(self, name: str) -> None:
+        """Put the worker's advertised endpoint behind the router (once
+        per live endpoint; the worker stamps it when it is ready)."""
+        from kubeflow_tpu.testing.fake_apiserver import NotFound
+
+        if self.router is None or self.router.replica(name) is not None:
+            return
+        try:
+            robj = self.api.get("ServingReplica", name, self._namespace)
+        except NotFound:
+            return
+        endpoint = robj.status.get("endpoint")
+        if endpoint and robj.status.get("ready"):
+            self.router.add(
+                HttpReplica(
+                    name, endpoint, robj.spec.get("model", "demo")
+                )
+            )
+
+    def stop(self, name: str) -> None:
+        """Teardown: out of the router first (stop admitting), then the
+        process. The worker also exits on its own when its object is
+        deleted — the SIGTERM just makes teardown prompt."""
+        if self.router is not None and self.router.replica(name):
+            self.router.drain(name)
+            self.router.remove(name)
+        proc = self._procs.pop(name, None)
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except Exception:
+            proc.kill()
+            proc.wait(timeout=5)
+
+    def shutdown(self) -> None:
+        for name in list(self._procs):
+            self.stop(name)

@@ -1,0 +1,252 @@
+"""One RL study trial: a REAL actor–learner loop, chaos self-delivered.
+
+The soak driver (`test_rl_soak_e2e.py` / `bench.py --workload rl`) runs
+a StudyJob whose trials exec THIS worker. Each trial stands up the full
+in-process RL stack — a ServingDeployment-materialized policy fleet
+behind the router/batcher, actor threads rolling out through it, a
+stock guarded `fit()` learner on the replay queue, checkpoint→
+modelVersion-bump→drain-roll publication — sweeps the learning rate it
+was assigned, and reports its mean return as the study objective over
+the HTTP apiserver facade (the same `report_observation` contract every
+trial uses).
+
+Chaos is SELF-DERIVED, never transported: with KFTPU_RL_CHAOS_SEED set,
+the worker reconstructs the driver's `RLFaultSchedule` from
+(seed, trials) and looks up its own trial index (read off its TpuJob's
+trial label) — so the fault plan can't be lost between processes:
+
+- ``trial_kill``: first incarnation SIGKILLs itself before training;
+  the gang restart (spec.maxRestarts) reschedules the trial and the
+  second incarnation reports the evidence.
+- ``learner_kill``: mid-fit SIGKILL; the restarted incarnation resumes
+  from the committed checkpoint (same replay position, proven by
+  ``resumed_from``) and finishes the SAME trial.
+- ``actor_kill``: a serving replica hard-killed mid-fit (in-flight
+  predicts fail like process death); the serving controller's resync
+  re-ensures it while actors retry through the router.
+
+Evidence rides the observation row (``fault_*`` fields): the driver's
+coverage gate counts only what a worker reported actually happening.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["KFTPU_REPO"])
+
+import argparse  # noqa: E402
+import math  # noqa: E402
+import signal  # noqa: E402
+import time  # noqa: E402
+
+from kubeflow_tpu.api import serving as serving_api  # noqa: E402
+from kubeflow_tpu.controllers.serving import (  # noqa: E402
+    ServingDeploymentController,
+)
+from kubeflow_tpu.controllers.study import LABEL_TRIAL  # noqa: E402
+from kubeflow_tpu.launcher.launcher import report_observation  # noqa: E402
+from kubeflow_tpu.parallel import MeshSpec, build_mesh  # noqa: E402
+from kubeflow_tpu.rl import (  # noqa: E402
+    EnvConfig,
+    PolicyCheckpointPublisher,
+    ReplayQueue,
+    RLConfig,
+    build_learner,
+    run_actor_learner,
+)
+from kubeflow_tpu.serving.replica import LocalReplicaRuntime  # noqa: E402
+from kubeflow_tpu.serving.router import Router  # noqa: E402
+from kubeflow_tpu.testing.apiserver_http import (  # noqa: E402
+    HttpApiClient,
+    endpoints_from_env,
+)
+from kubeflow_tpu.testing.chaos import (  # noqa: E402
+    ACTOR_KILL,
+    LEARNER_KILL,
+    TRIAL_KILL,
+    RLFaultSchedule,
+)
+from kubeflow_tpu.testing.fake_apiserver import FakeApiServer  # noqa: E402
+from kubeflow_tpu.train import Checkpointer, Preempted  # noqa: E402
+
+REPLICAS = 2
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lr", type=float, required=True)
+    args = parser.parse_args()
+
+    study_api_client = HttpApiClient(
+        endpoints_from_env(os.environ["KFTPU_APISERVER"])
+    )
+    job_name = os.environ["TPUJOB_NAME"]
+    namespace = os.environ["TPUJOB_NAMESPACE"]
+    job = study_api_client.get("TpuJob", job_name, namespace)
+    trial = int(job.metadata.labels[LABEL_TRIAL])
+    restarts = int(job.status.get("restarts", 0) or 0)
+    total_steps = int(os.environ.get("KFTPU_RL_STEPS", "18"))
+    publish_every = int(os.environ.get("KFTPU_RL_PUBLISH_EVERY", "6"))
+
+    fault = None
+    if os.environ.get("KFTPU_RL_CHAOS_SEED"):
+        sched = RLFaultSchedule(
+            int(os.environ["KFTPU_RL_CHAOS_SEED"]),
+            trials=int(os.environ["KFTPU_RL_TRIALS"]),
+        )
+        faults = sched.for_trial(trial)
+        fault = faults[0] if faults else None
+
+    evidence: dict[str, float] = {}
+    if fault is not None and fault.cls == TRIAL_KILL:
+        if restarts == 0:
+            # Die before any training happened: the study's whole-gang
+            # restart must reschedule this trial from scratch.
+            os.kill(os.getpid(), signal.SIGKILL)
+        evidence["fault_trial_kill"] = 1.0
+
+    workdir = os.path.join(
+        os.environ.get("KFTPU_RL_WORKDIR", "/tmp/kftpu-rl"),
+        f"trial-{trial}",
+    )
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    os.makedirs(workdir, exist_ok=True)
+
+    cfg = RLConfig(
+        env=EnvConfig(
+            seed=1000 + trial, obs_dim=8, n_actions=4, n_envs=8, horizon=3
+        ),
+        hidden=16,
+        learning_rate=args.lr,
+        total_steps=total_steps,
+        publish_every=publish_every,
+        staleness_bound=2 * publish_every,
+        n_actors=2,
+        dp=2,
+    )
+    mesh = build_mesh(MeshSpec(dp=cfg.dp), jax.devices()[: cfg.dp])
+    trainer = build_learner(cfg, mesh)
+
+    # The policy fleet's control plane is in-process (the OUTER facade is
+    # the study plane; a trial owns its own serving stack the way each
+    # Sebulba learner owns its actor fleet).
+    fleet_api = FakeApiServer()
+    router = Router()
+    publisher = PolicyCheckpointPublisher(
+        ckpt_dir,
+        trainer.abstract_state,
+        obs_dim=cfg.env.obs_dim,
+        n_actions=cfg.env.n_actions,
+        hidden=cfg.hidden,
+        device=jax.devices("cpu")[0],
+    )
+    ctl = ServingDeploymentController(
+        fleet_api, runtime=LocalReplicaRuntime(router, publisher)
+    )
+    fleet_api.create(
+        serving_api.make_serving_deployment(
+            "pol", model="policy", replicas=REPLICAS, max_batch=8,
+            batch_timeout_ms=1.0,
+        )
+    )
+    ctl.controller.run_until_idle()
+
+    ckpt = Checkpointer(ckpt_dir, save_interval_steps=cfg.publish_every)
+    resumed_from = int(ckpt.latest_step() or 0)
+    queue = ReplayQueue(
+        capacity=cfg.replay_capacity,
+        staleness_bound=cfg.staleness_bound,
+        mesh=mesh,
+        stall_timeout_s=60,
+    )
+
+    kill_at = None
+    if fault is not None and fault.cls == LEARNER_KILL and restarts == 0:
+        # Past the first publish (so resume has a committed checkpoint
+        # to prove continuity against), short of the end.
+        kill_at = min(
+            max(publish_every + 1,
+                math.ceil(fault.at_fraction * total_steps)),
+            total_steps - 2,
+        )
+    actor_kill_at = None
+    if fault is not None and fault.cls == ACTOR_KILL and restarts == 0:
+        actor_kill_at = min(
+            max(2, math.ceil(fault.at_fraction * total_steps)),
+            total_steps - 2,
+        )
+    actor_killed: list[str] = []
+
+    def fault_hook(step: int) -> None:
+        if kill_at is not None and step >= kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+        if actor_kill_at is not None and step >= actor_kill_at \
+                and not actor_killed:
+            ready = router.ready_names()
+            if ready:
+                name = ready[0]
+                replica = router.replica(name)
+                replica.kill()  # in-flight callers fail like SIGKILL
+                router.remove(name)
+                actor_killed.append(name)
+
+    try:
+        result = run_actor_learner(
+            api=fleet_api,
+            deployment="pol",
+            router=router,
+            trainer=trainer,
+            checkpointer=ckpt,
+            queue=queue,
+            cfg=cfg,
+            reconcile=ctl.controller.run_until_idle,
+            fault_hook=fault_hook,
+        )
+    finally:
+        ckpt.close()
+
+    if isinstance(result.fit_result, Preempted):
+        sys.exit(75)
+
+    # The healed fleet is part of the actor_kill evidence: the resync
+    # re-ensure must have brought the fleet back to spec strength.
+    if actor_killed:
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                len(router.ready_names()) < REPLICAS:
+            ctl.controller.run_until_idle()
+            time.sleep(0.05)
+        if len(router.ready_names()) >= REPLICAS:
+            evidence["fault_actor_kill"] = 1.0
+            evidence["healed_replicas"] = float(len(actor_killed))
+    if fault is not None and fault.cls == LEARNER_KILL and restarts > 0 \
+            and resumed_from > 0:
+        evidence["fault_learner_kill"] = 1.0
+        evidence["resumed_from"] = float(resumed_from)
+
+    observation = {
+        "return": result.mean_return,
+        "actor_steps": float(result.actor_steps),
+        "stale_dropped": float(result.stale_dropped),
+        "publishes": float(len(result.publishes)),
+        **evidence,
+    }
+    if result.publish_latencies:
+        observation["publish_latency_s"] = max(result.publish_latencies)
+    report_observation(
+        study_api_client, job_name, namespace, observation
+    )
+    print(
+        f"rl trial {trial} done lr={args.lr} return={result.mean_return:.3f} "
+        f"restarts={restarts} evidence={sorted(evidence)}"
+    )
+
+
+if __name__ == "__main__":
+    main()

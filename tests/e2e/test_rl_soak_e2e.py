@@ -1,0 +1,171 @@
+"""RL study soak: a StudyJob of real actor–learner trials under chaos.
+
+The full stack is load-bearing at once — study controller suggesting
+trials, TpuJob operator ganging them, LocalPodRunner exec'ing real
+worker processes (`rl_trial_worker.py`), each worker running its own
+serving-stack policy fleet and guarded `fit()` learner — while the
+seeded `RLFaultSchedule` kills a different layer in each victim trial:
+a serving replica (heal), the learner process (resume), a whole trial
+pre-training (reschedule).
+
+The gate is ZERO LOST STUDIES: the study must land Succeeded with every
+trial scored, and `coverage()` — counted from worker-REPORTED evidence
+only — must show every RL fault class actually fired. A kill the study
+absorbed so smoothly the driver can't find its evidence counts as a
+coverage failure, not a success.
+
+`test_rl_soak_small` is the tier-1 fixed-seed variant; the nightly
+(slow) variant is what `bench.py --workload rl` drives for
+`rl_studies_per_hour`, honoring KFTPU_RL_SEED / KFTPU_RL_METRICS.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu.api.objects import new_resource
+from kubeflow_tpu.api.study import KIND, ParameterSpec, StudySpec
+from kubeflow_tpu.controllers.study import StudyController, trial_name
+from kubeflow_tpu.controllers.tpujob import TpuJobController
+from kubeflow_tpu.runtime import LocalPodRunner
+from kubeflow_tpu.testing import FakeApiServer
+from kubeflow_tpu.testing.apiserver_http import ApiServerApp
+from kubeflow_tpu.testing.chaos import RL_FAULT_CLASSES, RLFaultSchedule
+from kubeflow_tpu.web.wsgi import serve
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+WORKER = os.path.join(REPO, "tests", "e2e", "rl_trial_worker.py")
+
+
+def _run_rl_study_soak(
+    tmp_path,
+    *,
+    seed: int,
+    trials: int = 3,
+    steps: int = 12,
+    publish_every: int = 4,
+    deadline_s: float = 240.0,
+) -> dict:
+    """One chaos-gated RL study end to end; returns the soak metrics."""
+    schedule = RLFaultSchedule(seed, trials=trials)
+    api = FakeApiServer()
+    server, _ = serve(ApiServerApp(api), host="127.0.0.1", port=0)
+    study_ctl = StudyController(api)
+    job_ctl = TpuJobController(api)
+    runner = LocalPodRunner(
+        api,
+        extra_env={
+            "KFTPU_REPO": REPO,
+            "KFTPU_APISERVER": f"http://127.0.0.1:{server.server_port}",
+            "KFTPU_RL_CHAOS_SEED": str(seed),
+            "KFTPU_RL_TRIALS": str(trials),
+            "KFTPU_RL_STEPS": str(steps),
+            "KFTPU_RL_PUBLISH_EVERY": str(publish_every),
+            "KFTPU_RL_WORKDIR": str(tmp_path / "rl"),
+        },
+        capture_dir=str(tmp_path / "logs"),
+    )
+
+    spec = StudySpec(
+        parameters=(
+            ParameterSpec(
+                "lr", "double", min=0.02, max=0.08, grid_points=trials
+            ),
+        ),
+        objective_metric="return",
+        goal="maximize",
+        algorithm="grid",
+        parallelism=2,
+        trial_template={
+            "replicas": 1,
+            "image": "local",
+            "command": [sys.executable, WORKER],
+            "args": ["--lr", "${trialParameters.lr}"],
+            "tpu": {"chipsPerWorker": 0},
+            # Every fault class costs its victim trial one gang restart
+            # (SIGKILL -> whole-gang restart is the operator's contract).
+            "maxRestarts": 2,
+        },
+    )
+    api.create(new_resource(KIND, "rl-sweep", "default", spec=spec.to_dict()))
+
+    t0 = time.perf_counter()
+    deadline = time.time() + deadline_s
+    try:
+        while time.time() < deadline:
+            study_ctl.controller.run_until_idle()
+            job_ctl.controller.run_until_idle()
+            runner.step()
+            phase = api.get(KIND, "rl-sweep").status.get("phase")
+            if phase in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.1)
+    finally:
+        runner.shutdown()
+        server.shutdown()
+    elapsed = time.perf_counter() - t0
+
+    study = api.get(KIND, "rl-sweep")
+    # ZERO lost studies: terminal, Succeeded, every trial scored.
+    assert study.status.get("phase") == "Succeeded", study.status
+    rows = study.status.get("trials") or []
+    assert len(rows) == trials, rows
+    assert all("objective" in r for r in rows), rows
+
+    # Coverage from worker-reported evidence only.
+    returns = []
+    publish_latency = 0.0
+    for idx in range(trials):
+        trial = api.get("TpuJob", trial_name("rl-sweep", idx), "default")
+        observation = trial.status.get("observation") or {}
+        returns.append(float(observation.get("return", 0.0)))
+        publish_latency = max(
+            publish_latency, float(observation.get("publish_latency_s", 0.0))
+        )
+        for cls in RL_FAULT_CLASSES:
+            if observation.get(f"fault_{cls}"):
+                schedule.mark_injected(cls)
+    coverage = schedule.coverage()
+    missing = [c for c in RL_FAULT_CLASSES if coverage[c] < 1]
+    assert not missing, (
+        f"fault classes with no worker-reported evidence: {missing} "
+        f"(coverage={coverage}, plan={schedule.plan})"
+    )
+
+    return {
+        "seed": seed,
+        "trials": trials,
+        "elapsed_seconds": elapsed,
+        "studies_per_hour": 3600.0 / elapsed,
+        "coverage": coverage,
+        "returns": returns,
+        "publish_latency_s": publish_latency,
+        "best_return": study.status["bestTrial"]["objective"],
+    }
+
+
+def test_rl_soak_small(tmp_path):
+    """Tier-1: fixed seed, three trials — one victim per fault class."""
+    m = _run_rl_study_soak(tmp_path, seed=7, trials=3)
+    assert m["best_return"] > 0, m
+
+
+@pytest.mark.slow
+def test_rl_soak_nightly(tmp_path):
+    """The bench-driven variant (`bench.py --workload rl`): seed from
+    KFTPU_RL_SEED (printed-seed repro contract), metrics out through
+    KFTPU_RL_METRICS."""
+    seed = int(os.environ.get("KFTPU_RL_SEED", "7"))
+    m = _run_rl_study_soak(
+        tmp_path, seed=seed, trials=4, steps=18, publish_every=6,
+        deadline_s=420.0,
+    )
+    path = os.environ.get("KFTPU_RL_METRICS")
+    if path:
+        with open(path, "w") as f:
+            json.dump(m, f)

@@ -258,6 +258,25 @@ def test_failover_soak_is_slow_marked_with_seeded_nightly_entry():
     assert "failover soak seed=" in bench
 
 
+def test_rl_soak_is_slow_marked_with_seeded_nightly_entry():
+    """The RL study soak (ISSUE 12) follows the same convention as the
+    chaos/resilience/failover soaks: tier-1 runs the small fixed-seed
+    study, the nightly variant is `slow`-marked, and `bench.py
+    --workload rl` drives it with a printed seed so any failure
+    reproduces from one integer."""
+    soak = (REPO / "tests" / "e2e" / "test_rl_soak_e2e.py").read_text()
+    assert "@pytest.mark.slow" in soak
+    assert "KFTPU_RL_SEED" in soak
+    nightly = soak.split("def test_rl_soak_nightly")
+    assert len(nightly) == 2
+    assert nightly[0].rstrip().endswith("@pytest.mark.slow")
+    bench = (REPO / "bench.py").read_text()
+    assert "test_rl_soak_nightly" in bench
+    assert "KFTPU_RL_SEED" in bench
+    # The seed is printed up front (the repro contract).
+    assert "rl soak seed=" in bench
+
+
 def test_clients_built_from_config_take_endpoint_lists():
     """Resilience gate (docs/resilience.md, ISSUE 6) → engine rule
     `endpoint-list-clients`: every `HttpApiClient` built from

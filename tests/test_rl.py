@@ -1,0 +1,280 @@
+"""RL subsystem units + the in-process actor–learner integration loop.
+
+The integration test here is the tentpole's proof shape at unit scale:
+a CR-materialized policy fleet (ServingDeployment → controller →
+in-proc replicas behind the router), actors rolling out through the
+batcher, a stock guarded `fit()` learner on the replay queue, and
+weight publication riding checkpoint-save → modelVersion bump →
+drain-roll — observed in-band by the actors. `bench.py --workload rl`
+runs the same loop bigger and under chaos.
+"""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.rl.env import (
+    EnvConfig,
+    VectorEnv,
+    rollout,
+)
+from kubeflow_tpu.rl.replay import ReplayQueue, ReplayStalled
+
+
+def fixed_predict(env_cfg, version=1):
+    """Deterministic stand-in for the serving stack in unit tests."""
+
+    def predict(obs):
+        return obs[:, : env_cfg.n_actions].copy(), version
+
+    return predict
+
+
+# -- env ------------------------------------------------------------------
+
+
+def test_rollout_is_pure_function_of_seed_salt_index():
+    cfg = EnvConfig(seed=11, horizon=4, n_envs=3)
+    env_a, env_b = VectorEnv(cfg), VectorEnv(cfg)
+    ta = rollout(env_a, fixed_predict(cfg), 5, salt=2)
+    tb = rollout(env_b, fixed_predict(cfg), 5, salt=2)
+    np.testing.assert_array_equal(ta.obs, tb.obs)
+    np.testing.assert_array_equal(ta.actions, tb.actions)
+    np.testing.assert_array_equal(ta.rewards, tb.rewards)
+    # Different salt (the guard's rollback perturbation) must change the
+    # trajectory; different index must too.
+    tc = rollout(env_a, fixed_predict(cfg), 5, salt=3)
+    assert not np.array_equal(ta.obs, tc.obs)
+    td = rollout(env_a, fixed_predict(cfg), 6, salt=2)
+    assert not np.array_equal(ta.obs, td.obs)
+
+
+def test_trajectory_transitions_pack_action_and_return():
+    cfg = EnvConfig(seed=0, horizon=2, n_envs=2)
+    env = VectorEnv(cfg)
+    traj = rollout(env, fixed_predict(cfg, version=7), 0)
+    assert traj.policy_version == 7
+    batch = traj.transitions()
+    assert batch["obs"].shape == (4, cfg.obs_dim)
+    assert batch["target"].shape == (4, 2)
+    np.testing.assert_array_equal(
+        batch["target"][:, 0].astype(np.int32),
+        traj.actions.reshape(-1),
+    )
+    np.testing.assert_array_equal(
+        batch["target"][:, 1], traj.rewards.reshape(-1)
+    )
+
+
+def test_optimal_policy_earns_full_return():
+    cfg = EnvConfig(seed=3, horizon=5, n_envs=4)
+    env = VectorEnv(cfg)
+    obs = env.observe(0, 0)
+    rewards = env.rewards(obs, env.optimal_actions(obs))
+    np.testing.assert_array_equal(rewards, np.ones(cfg.n_envs))
+
+
+# -- replay queue ---------------------------------------------------------
+
+
+def _batch(i):
+    return {"obs": np.full((4, 2), i, np.float32),
+            "target": np.zeros((4, 2), np.float32)}
+
+
+def test_replay_fifo_order_and_position():
+    q = ReplayQueue(capacity=4, stall_timeout_s=5)
+    claims = [q.claim() for _ in range(3)]
+    # Out-of-order pushes (two actors racing) still yield in order.
+    for i in [2, 0, 1]:
+        idx, salt = claims[i]
+        assert q.push(idx, salt, version=1, batch=_batch(idx))
+    got = [next(q)["obs"][0, 0] for _ in range(3)]
+    assert got == [0, 1, 2]
+    assert q.state_dict() == {"position": 3, "salt": 0}
+
+
+def test_replay_resume_continues_claims_and_rejects_stale_pushes():
+    q = ReplayQueue(capacity=4, stall_timeout_s=5)
+    stale = q.claim()  # in flight across the restore boundary
+    q.load_state_dict({"position": 7, "salt": 2})
+    # The pre-restore ticket bounces: wrong salt AND index < position.
+    assert not q.push(stale[0], stale[1], version=1, batch=_batch(0))
+    assert q.rejected_pushes == 1
+    # Fresh claims continue exactly at the restored position.
+    idx, salt = q.claim()
+    assert (idx, salt) == (7, 2)
+    assert q.push(idx, salt, version=1, batch=_batch(7))
+    next(q)
+    assert q.state_dict() == {"position": 8, "salt": 2}
+
+
+def test_replay_perturb_invalidates_buffered_work():
+    q = ReplayQueue(capacity=4, stall_timeout_s=5)
+    idx, salt = q.claim()
+    assert q.push(idx, salt, version=1, batch=_batch(idx))
+    q.perturb(5)
+    # Buffered pre-perturb work is gone; the index is re-claimable with
+    # the new salt (the retried trajectory must differ).
+    idx2, salt2 = q.claim()
+    assert (idx2, salt2) == (0, 5)
+
+
+def test_replay_abandoned_claim_is_reissued():
+    q = ReplayQueue(capacity=4, stall_timeout_s=5)
+    a = q.claim()
+    b = q.claim()
+    q.abandon(a[0], a[1])  # actor died mid-rollout
+    # Reissued before any new index — no permanent gap for the
+    # in-order learner to stall behind.
+    assert q.claim() == (a[0], a[1])
+    assert q.push(a[0], a[1], version=1, batch=_batch(0))
+    assert q.push(b[0], b[1], version=1, batch=_batch(1))
+    next(q), next(q)
+
+
+def test_replay_staleness_bound_drops_stale_and_stalls_loudly():
+    q = ReplayQueue(capacity=8, staleness_bound=2, stall_timeout_s=0.3)
+    for _ in range(4):
+        idx, salt = q.claim()
+        q.push(idx, salt, version=1, batch=_batch(idx))
+    # Learner far ahead of the behavior policy: everything buffered is
+    # past the bound — dropped (counted), never trained on; with the
+    # backlog cleared and nothing fresh arriving, the stall is loud.
+    q.note_learner_step(20)
+    with pytest.raises(ReplayStalled):
+        next(q)
+    assert q.stale_dropped == 4
+    assert q.state_dict()["position"] == 4  # drops still retire indices
+    # A fresh trajectory (actors past the publish) trains normally.
+    idx, salt = q.claim()
+    q.push(idx, salt, version=20, batch=_batch(idx))
+    assert next(q) is not None
+    assert q.stale_dropped == 4
+
+
+def test_replay_within_bound_trajectories_are_not_dropped():
+    q = ReplayQueue(capacity=8, staleness_bound=5, stall_timeout_s=1)
+    idx, salt = q.claim()
+    q.push(idx, salt, version=6, batch=_batch(idx))
+    q.note_learner_step(10)  # 11 - 6 = 5 <= bound: admissible
+    assert next(q) is not None
+    assert q.stale_dropped == 0
+
+
+def test_replay_backpressure_at_claim_never_wedges_a_held_ticket():
+    """The out-of-order-full deadlock shape: one actor holds the head
+    index while another fills the buffer. Backpressure must land on the
+    NEXT claim, not on the held ticket's push — otherwise the in-order
+    learner waits on a gap whose owner waits on the learner."""
+    q = ReplayQueue(capacity=2, stall_timeout_s=5)
+    head = q.claim()       # actor A: slow rollout, holds index 0
+    other = q.claim()      # actor B: index 1
+    assert q.push(other[0], other[1], version=1, batch=_batch(1))
+    # B's NEXT claim is outside [position, position+capacity) and must
+    # block — verify without threads by checking the window directly.
+    assert q._next_claim == q.state_dict()["position"] + q.capacity
+    # A's push of the head index always has room.
+    assert q.push(head[0], head[1], version=1, batch=_batch(0))
+    assert next(q)["obs"][0, 0] == 0
+    assert next(q)["obs"][0, 0] == 1
+    # Learner progress reopened the window.
+    assert q.claim() == (2, 0)
+
+
+# -- the integration loop -------------------------------------------------
+
+
+def test_actor_learner_loop_end_to_end(tmp_path, devices):
+    """CR-materialized fleet + real fit() + publication drain-rolls."""
+    import jax
+
+    from kubeflow_tpu.api import serving as serving_api
+    from kubeflow_tpu.controllers.serving import ServingDeploymentController
+    from kubeflow_tpu.parallel import MeshSpec, build_mesh
+    from kubeflow_tpu.rl.loop import RLConfig, build_learner, run_actor_learner
+    from kubeflow_tpu.rl.policy import PolicyCheckpointPublisher
+    from kubeflow_tpu.serving.replica import LocalReplicaRuntime
+    from kubeflow_tpu.serving.router import Router
+    from kubeflow_tpu.testing.fake_apiserver import FakeApiServer
+    from kubeflow_tpu.train import Checkpointer, FitResult
+    from kubeflow_tpu.rl.replay import ReplayQueue as RQ
+
+    cfg = RLConfig(
+        env=EnvConfig(seed=5, horizon=4, n_envs=8, obs_dim=8, n_actions=4),
+        hidden=16,
+        total_steps=24,
+        publish_every=8,
+        staleness_bound=16,
+        n_actors=2,
+        learning_rate=0.05,
+    )
+    mesh = build_mesh(MeshSpec(dp=2), devices[:2])
+    trainer = build_learner(cfg, mesh)
+    cpu0 = jax.devices("cpu")[0]
+    publisher = PolicyCheckpointPublisher(
+        str(tmp_path / "ckpt"),
+        trainer.abstract_state,
+        obs_dim=cfg.env.obs_dim,
+        n_actions=cfg.env.n_actions,
+        hidden=cfg.hidden,
+        device=cpu0,
+    )
+    api = FakeApiServer()
+    router = Router()
+    ctl = ServingDeploymentController(
+        api, runtime=LocalReplicaRuntime(router, publisher)
+    )
+    api.create(serving_api.make_serving_deployment(
+        "pol", model="policy", replicas=2, max_batch=8,
+        batch_timeout_ms=1.0,
+    ))
+    ctl.controller.run_until_idle()
+    assert len(router.ready_names()) == 2
+
+    ckpt = Checkpointer(
+        str(tmp_path / "ckpt"),
+        save_interval_steps=cfg.publish_every,
+    )
+    queue = RQ(
+        capacity=cfg.replay_capacity,
+        staleness_bound=cfg.staleness_bound,
+        mesh=mesh,
+        stall_timeout_s=60,
+    )
+    try:
+        result = run_actor_learner(
+            api=api,
+            deployment="pol",
+            router=router,
+            trainer=trainer,
+            checkpointer=ckpt,
+            queue=queue,
+            cfg=cfg,
+            reconcile=ctl.controller.run_until_idle,
+        )
+    finally:
+        ckpt.close()
+
+    assert isinstance(result.fit_result, FitResult)
+    assert result.fit_result.steps_done == cfg.total_steps
+    # Publications happened at every publish boundary and each was
+    # observed by the actors in-band (version column) after the roll.
+    versions = [p.version for p in result.publishes]
+    assert versions == [8, 16, 24]
+    assert len(result.publish_latencies) == 3, result.publishes
+    assert all(s >= 0 for s in result.publish_latencies)
+    # The fleet really rolled: replicas now serve the final version.
+    dep = api.get(serving_api.KIND, "pol", "default")
+    assert int(dep.spec["modelVersion"]) == 24
+    for rname in router.ready_names():
+        assert router.replica(rname).version == 24
+    # Actors made progress through the serving stack; every retired
+    # index is accounted for as either a learner batch or a counted
+    # staleness drop — nothing vanishes.
+    assert result.actor_steps > 0
+    assert (
+        queue.state_dict()["position"]
+        == cfg.total_steps + result.stale_dropped
+    )
+    # No request left mid-flight in the fleet.
+    assert router.stats()["outstanding"] == 0

@@ -1,0 +1,70 @@
+"""CI smoke for `bench.py --workload rl` (ISSUE 12): the actor–learner
+bench must run end-to-end at tiny scale — the coupled loop over the real
+serving fleet, the contention measurement, and the seeded-chaos StudyJob
+soak — and every headline row must resolve a real vs_baseline ratio
+against BASELINE.json's published rl_* entries."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_rl_bench_smoke_rows_resolve_baseline():
+    result = subprocess.run(
+        [
+            sys.executable, "bench.py", "--workload", "rl",
+            "--rl-steps", "24",
+            "--rl-publish-every", "8",
+            "--chaos-seed", "7",
+        ],
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+        capture_output=True,
+        text=True,
+        timeout=280,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    metrics = [
+        json.loads(line)
+        for line in result.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert metrics, f"no metric lines in:\n{result.stdout}"
+    by_name = {}
+    for m in metrics:
+        # The driver's parse contract — same shape as every other bench.
+        assert set(m) == {"metric", "value", "unit", "vs_baseline"}, m
+        assert isinstance(m["value"], (int, float)) and m["value"] > 0, m
+        by_name[m["metric"]] = m
+
+    # Every headline row resolves a ratio vs the published baseline.
+    for name in (
+        "rl_studies_per_hour",
+        "rl_learner_mfu_under_actor_traffic",
+        "rl_actor_steps_per_sec",
+        "rl_policy_publish_to_actor_seconds",
+    ):
+        assert name in by_name, (name, sorted(by_name))
+        assert by_name[name]["vs_baseline"] is not None, by_name[name]
+
+    # The contention ratio is a fraction of the solo step rate, and the
+    # publish->actor latency is wall-clock seconds, not a counter.
+    assert 0 < by_name["rl_learner_mfu_under_actor_traffic"]["value"] <= 1.5
+    assert by_name["rl_policy_publish_to_actor_seconds"]["value"] < 60
+
+    # The soak's repro contract: the seed is printed up front, the chaos
+    # schedule covered every RL fault class, and the study-loss gate held
+    # (nonzero exit would have tripped above).
+    assert "# rl soak seed=7" in result.stderr
+    assert "'actor_kill': 1" in result.stderr
+    assert "'learner_kill': 1" in result.stderr
+    assert "'trial_kill': 1" in result.stderr
+    assert "zero lost studies" in result.stderr

@@ -383,3 +383,115 @@ def test_run_replica_hot_swaps_on_config_push_and_exits_on_delete():
     api.delete(serving_api.REPLICA_KIND, "r0", "default")
     t.join(timeout=5)
     assert not t.is_alive()
+
+
+# -- observed-latency autoscale signal ------------------------------------
+
+
+def test_autoscale_target_latency_and_depth_agreement():
+    """Unit contract for the two-signal policy: scale-up wins."""
+    spec = serving_api.AutoscaleSpec(
+        min_replicas=1, max_replicas=8,
+        target_queue_depth=10, target_latency_ms=50.0,
+    )
+    # Agreement: both signals want 3.
+    assert spec.target(25, p99_latency_ms=140.0, current_replicas=1) == 3
+    # Conflict, latency higher: shallow queues must not mask a p99
+    # breach (slow-drain pathology).
+    assert spec.target(5, p99_latency_ms=200.0, current_replicas=2) == 8
+    # Conflict, depth higher: fast batches must not mask a backlog.
+    assert spec.target(60, p99_latency_ms=10.0, current_replicas=2) == 6
+    # Latency signal off (0) or unmeasured (None): depth-only.
+    off = serving_api.AutoscaleSpec(
+        min_replicas=1, max_replicas=8, target_queue_depth=10,
+    )
+    assert off.target(5, p99_latency_ms=500.0, current_replicas=2) == 1
+    assert spec.target(5, p99_latency_ms=None, current_replicas=2) == 1
+
+
+def test_autoscale_scales_out_on_observed_latency(harness):
+    """Controller path: rolling p99 queue wait above targetLatencyMs
+    scales the fleet out even though queues are shallow."""
+    api, runtime, controller = harness
+    api.create(
+        serving_api.make_serving_deployment(
+            "fleet",
+            replicas=1,
+            autoscale={
+                "min_replicas": 1,
+                "max_replicas": 4,
+                "target_queue_depth": 100,
+                "target_latency_ms": 50.0,
+            },
+        )
+    )
+    converge(controller)
+    assert len(runtime.replicas) == 1
+
+    r0 = serving_api.replica_name("fleet", 0)
+    runtime.replicas[r0]["queue_wait_ms"] = 150.0  # 3x the target
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    # The fake's wait signal never improves, so the proportional policy
+    # keeps compounding until it hits the ceiling — queues stayed at
+    # depth 0 the whole time, so this is purely the latency signal.
+    assert dep_status(api)["targetReplicas"] == 4
+    assert len(runtime.replicas) == 4
+
+
+# -- runtime: process -----------------------------------------------------
+
+
+def test_runtime_field_roundtrip_and_validation():
+    spec = serving_api.ServingDeploymentSpec(runtime="process")
+    assert spec.to_dict()["runtime"] == "process"
+    parsed = serving_api.ServingDeploymentSpec.from_dict(spec.to_dict())
+    assert parsed.runtime == "process"
+    # Default stays local (existing CRs parse unchanged).
+    assert serving_api.ServingDeploymentSpec.from_dict({}).runtime == "local"
+    with pytest.raises(ValueError, match="runtime"):
+        serving_api.ServingDeploymentSpec(runtime="docker").validate()
+    with pytest.raises(ValueError, match="targetLatency"):
+        serving_api.ServingDeploymentSpec.from_dict(
+            {"autoscale": {"targetLatency": 5}}
+        )
+
+
+def test_process_spec_routes_to_process_runtime():
+    """`spec.runtime: process` materializes via the process runtime;
+    local specs keep using the in-process one; teardown sweeps both."""
+    api = FakeApiServer()
+    local, procs = FakeRuntime(), FakeRuntime()
+    controller = ServingDeploymentController(
+        api, runtime=local, process_runtime=procs
+    )
+    api.create(
+        serving_api.make_serving_deployment(
+            "pfleet", replicas=2, runtime="process"
+        )
+    )
+    api.create(serving_api.make_serving_deployment("lfleet", replicas=1))
+    converge(controller)
+    assert sorted(procs.replicas) == [
+        serving_api.replica_name("pfleet", 0),
+        serving_api.replica_name("pfleet", 1),
+    ]
+    assert sorted(local.replicas) == [serving_api.replica_name("lfleet", 0)]
+
+    api.delete(serving_api.KIND, "pfleet", "default")
+    converge(controller)
+    assert procs.replicas == {}
+    assert local.replicas != {}  # the local fleet is untouched
+
+
+def test_process_spec_without_process_runtime_degrades_to_local():
+    api = FakeApiServer()
+    local = FakeRuntime()
+    controller = ServingDeploymentController(api, runtime=local)
+    api.create(
+        serving_api.make_serving_deployment(
+            "pfleet", replicas=1, runtime="process"
+        )
+    )
+    converge(controller)
+    assert sorted(local.replicas) == [serving_api.replica_name("pfleet", 0)]

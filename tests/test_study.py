@@ -866,3 +866,121 @@ def test_pruned_trial_counts_for_halving_rung():
         next(iter(study.status["prunedTrials"]))
     ]["assignment"]["lr"]
     assert pruned_lr not in promoted
+
+
+# -- suggest() under interleaved / out-of-order completion -----------------
+
+
+def _drive_suggest(spec, *, scramble_seed, score=lambda a: a["x"]):
+    """Simulate a controller driving suggest() with trials completing
+    OUT OF ORDER: each round fills the free parallelism slots, then a
+    scrambled subset of the running trials completes. Double assignment
+    of an index is asserted against at the moment of issue. Returns the
+    full index -> assignment mapping plus the issue order."""
+    import random as _random
+
+    rng = _random.Random(f"scramble-{scramble_seed}")
+    records: dict[int, TrialRecord] = {}
+    issued = []
+    floor = -1
+    for _ in range(300):
+        active = sum(1 for r in records.values() if not r.terminal)
+        new, done = spec.suggest(
+            list(records.values()), spec.parallelism - active, floor
+        )
+        for idx, a in new:
+            assert idx not in records, f"index {idx} double-assigned"
+            records[idx] = TrialRecord(
+                index=idx, state="Running", assignment=a
+            )
+            issued.append(idx)
+            floor = max(floor, idx)
+        running = [r.index for r in records.values() if not r.terminal]
+        if not running:
+            if done:
+                return {i: r.assignment for i, r in records.items()}, issued
+            continue
+        rng.shuffle(running)
+        for idx in running[: max(1, len(running) // 2)]:
+            a = records[idx].assignment
+            records[idx] = TrialRecord(
+                index=idx, state="Succeeded", assignment=a,
+                objective=float(score(a)),
+            )
+    raise AssertionError("suggest() never converged")
+
+
+def test_tpe_interleaved_out_of_order_scoring_is_deterministic():
+    def spec(seed):
+        return _tpe_spec(
+            max_trials=12, startup_trials=3, parallelism=3, seed=seed
+        )
+
+    got_a, order_a = _drive_suggest(spec(5), scramble_seed=1)
+    got_b, order_b = _drive_suggest(spec(5), scramble_seed=1)
+    # Same seed, same completion schedule: bit-identical study.
+    assert got_a == got_b and order_a == order_b
+    assert sorted(got_a) == list(range(12))
+    # A different study seed explores a different stream.
+    other, _ = _drive_suggest(spec(6), scramble_seed=1)
+    assert other != got_a
+
+
+def test_suggest_is_independent_of_record_list_order():
+    # The suggester ranks by (objective, index), never by list position
+    # — two controllers that LIST the same trials in different orders
+    # must propose identical next trials.
+    spec = _tpe_spec(startup_trials=3, max_trials=20)
+    history = _records([(0.1 * i, float(i)) for i in range(8)])
+    fwd = spec.suggest(history, 4)
+    rev = spec.suggest(list(reversed(history)), 4)
+    assert fwd == rev
+
+
+def test_racing_suggest_calls_propose_identical_trials():
+    # Two reconciles racing on the same snapshot propose the SAME
+    # (index, assignment) pairs — the loser's create is a benign
+    # already-exists conflict, never a second config under a new index.
+    spec = _tpe_spec(max_trials=10, parallelism=4)
+    history = _records([(0.2, 1.0), (0.4, 2.0)])
+    assert spec.suggest(history, 4) == spec.suggest(history, 4)
+
+
+def test_grid_interleaved_never_double_assigns_an_index():
+    spec = StudySpec(
+        parameters=(
+            ParameterSpec("x", "double", min=0.0, max=1.0, grid_points=4),
+            ParameterSpec("opt", "categorical", values=("a", "b", "c")),
+        ),
+        algorithm="grid",
+        max_trials=12,
+        parallelism=3,
+        trial_template=TEMPLATE,
+    )
+    got, issued = _drive_suggest(spec, scramble_seed=2)
+    assert sorted(issued) == list(range(12))  # each index exactly once
+    # Every grid point ran exactly once, in enumeration order.
+    assert [got[i] for i in range(12)] == spec.grid_assignments()
+
+
+def test_halving_out_of_order_scoring_promotes_deterministically():
+    def run(scramble):
+        return _drive_suggest(
+            _halving_spec(parallelism=4),
+            scramble_seed=scramble,
+            score=lambda a: a["lr"],
+        )
+
+    got_a, _ = run(3)
+    got_b, _ = run(4)
+    # Different completion orders, same bracket: rung-0 configs are
+    # pure in (seed, index) and promotion ranks the scored SET.
+    assert got_a == got_b
+    assert sorted(got_a) == list(range(13))
+    # The promoted rung-1 configs are the 3 best (lowest lr) of rung 0,
+    # re-stamped with the bigger budget.
+    rung0 = sorted(got_a[i]["lr"] for i in range(9))
+    promoted = sorted(got_a[i]["lr"] for i in range(9, 12))
+    assert promoted == rung0[:3]
+    assert all(got_a[i]["budget"] == 3 for i in range(9, 12))
+    assert got_a[12]["budget"] == 9 and got_a[12]["lr"] == rung0[0]
