@@ -713,6 +713,18 @@ def bench_serving(args) -> None:
 
 
 def _bench_serving_dataplane(args) -> None:
+    """Serving data-plane phases, optionally under the dynamic
+    lock-graph witness (KFTPU_LOCKGRAPH=1): on a green run the observed
+    lock-acquisition edges must be acyclic and a subset of the static
+    lock-order graph (ci/lint/concurrency.py) — kftpu-race's
+    under-approximation check on the bench's exact hot paths."""
+    from kubeflow_tpu.testing.lockgraph import maybe_witness
+
+    with maybe_witness():
+        _serving_dataplane_body(args)
+
+
+def _serving_dataplane_body(args) -> None:
     """Multi-replica serving data plane (ISSUE 11): ServingDeployment CR
     -> controller -> replica fleet behind the drain-aware router, driven
     by thousands of concurrent closed-loop clients. Four phases:

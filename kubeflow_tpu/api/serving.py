@@ -46,6 +46,13 @@ class AutoscaleSpec:
     # whose batches execute slowly can hold SLO-busting waits at modest
     # depth). 0 disables the signal — depth-only, the original policy.
     target_latency_ms: float = 0.0
+    # Scale-down stabilization window (HPA's stabilizationWindowSeconds
+    # posture): the controller only shrinks the fleet to the MAXIMUM
+    # target computed over this many trailing seconds, so one quiet
+    # reconcile between bursts can't flap replicas down and back up —
+    # the latency signal is especially spiky (p99 over a small rolling
+    # window). Scale-UP stays immediate. 0 disables (original policy).
+    scale_down_stabilization_s: float = 0.0
 
     def validate(self) -> None:
         if self.min_replicas < 1:
@@ -66,6 +73,11 @@ class AutoscaleSpec:
             raise ValueError(
                 f"autoscale.targetLatencyMs must be >= 0, got "
                 f"{self.target_latency_ms}"
+            )
+        if self.scale_down_stabilization_s < 0:
+            raise ValueError(
+                f"autoscale.scaleDownStabilizationSeconds must be >= 0, "
+                f"got {self.scale_down_stabilization_s}"
             )
 
     def target(
@@ -163,6 +175,9 @@ class ServingDeploymentSpec:
                     "maxReplicas": self.autoscale.max_replicas,
                     "targetQueueDepth": self.autoscale.target_queue_depth,
                     "targetLatencyMs": self.autoscale.target_latency_ms,
+                    "scaleDownStabilizationSeconds": (
+                        self.autoscale.scale_down_stabilization_s
+                    ),
                 }
                 if self.autoscale is not None
                 else None
@@ -215,6 +230,9 @@ class ServingDeploymentSpec:
                 target_latency_ms=float(
                     autoscale_d.get("targetLatencyMs", 0.0)
                 ),
+                scale_down_stabilization_s=float(
+                    autoscale_d.get("scaleDownStabilizationSeconds", 0.0)
+                ),
             )
         spec = cls(
             model=d.get("model", "model"),
@@ -240,7 +258,8 @@ KNOWN_BATCHING_FIELDS = frozenset(
 )
 KNOWN_AUTOSCALE_FIELDS = frozenset(("minReplicas", "maxReplicas",
                                     "targetQueueDepth",
-                                    "targetLatencyMs"))
+                                    "targetLatencyMs",
+                                    "scaleDownStabilizationSeconds"))
 
 
 def replica_name(deployment: str, index: int) -> str:

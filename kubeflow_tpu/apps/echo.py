@@ -33,9 +33,13 @@ class EchoApp(App):
 if __name__ == "__main__":  # python -m kubeflow_tpu.apps.echo
     import sys
 
+    from kubeflow_tpu.utils import threads
     from kubeflow_tpu.web.wsgi import serve
 
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
     server, thread = serve(EchoApp(), port=port)
     print(f"echo-server on :{server.server_port}")
-    thread.join()
+    # Bounded foreground park (^C stops cleanly; no untimed join).
+    if threads.run_until_interrupt(thread):
+        server.shutdown()
+        threads.join_thread(thread, timeout=10.0, what="http server")

@@ -127,9 +127,16 @@ def main() -> None:  # python -m kubeflow_tpu.apps.probe
     prober = AvailabilityProber(
         args.url, interval_seconds=args.interval, headers=headers or None
     )
+    from kubeflow_tpu.utils import threads
+
     thread = prober.start()
     serve(ProberApp(prober), port=args.port)
-    thread.join()
+    # Bounded foreground park (^C stops the prober; no untimed join).
+    if threads.run_until_interrupt(thread):
+        prober.stop()
+        threads.join_thread(
+            thread, timeout=args.interval + 10.0, what="prober thread"
+        )
 
 
 if __name__ == "__main__":

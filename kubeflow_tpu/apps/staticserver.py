@@ -34,10 +34,14 @@ class StaticConfigApp(App):
 if __name__ == "__main__":  # python -m kubeflow_tpu.apps.staticserver
     import sys
 
+    from kubeflow_tpu.utils import threads
     from kubeflow_tpu.web.wsgi import serve
 
     root = sys.argv[1] if len(sys.argv) > 1 else "."
     port = int(sys.argv[2]) if len(sys.argv) > 2 else 8080
     server, thread = serve(StaticConfigApp(root), port=port)
     print(f"static-config-server on :{server.server_port} root={root}")
-    thread.join()
+    # Bounded foreground park (^C stops cleanly; no untimed join).
+    if threads.run_until_interrupt(thread):
+        server.shutdown()
+        threads.join_thread(thread, timeout=10.0, what="http server")

@@ -35,6 +35,11 @@ def add_lint_parser(sub) -> None:
         "tracing + compilation)",
     )
     p.add_argument(
+        "--concurrency", action="store_true",
+        help="also run the whole-program concurrency pass (lock-order "
+        "cycles, blocking-under-lock, cv-wait/join hygiene)",
+    )
+    p.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
     )
@@ -44,8 +49,18 @@ def run_lint(args) -> int:
     from kubeflow_tpu.ci.lint import engine
 
     if args.list_rules:
-        for rule_id, rule in sorted(engine.all_rules().items()):
-            print(f"{rule_id}: {rule.rationale}")
+        from kubeflow_tpu.ci.lint.concurrency import CONCURRENCY_RULES
+
+        catalog = {
+            rule_id: rule.rationale
+            for rule_id, rule in engine.all_rules().items()
+        }
+        catalog.update(
+            (rule_id, f"{rationale} [--concurrency]")
+            for rule_id, rationale in CONCURRENCY_RULES.items()
+        )
+        for rule_id, rationale in sorted(catalog.items()):
+            print(f"{rule_id}: {rationale}")
         return 0
 
     if args.programs:
@@ -71,7 +86,8 @@ def run_lint(args) -> int:
 
     try:
         result = engine.lint_repo(
-            rules=args.rule, baseline=baseline, programs=args.programs
+            rules=args.rule, baseline=baseline, programs=args.programs,
+            concurrency=args.concurrency,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)

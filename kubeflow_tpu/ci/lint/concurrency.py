@@ -1,0 +1,1104 @@
+"""kftpu-race: whole-program lock-order and blocking-under-lock analysis.
+
+The AST rules in `rules.py` are per-file: each one looks at a single
+class or call site. The hazards that actually wedge a soak are
+*cross-cutting*: thread A takes lock L then M while thread B takes M
+then L; a hot lock is held across a subprocess spawn three calls down
+the stack. This pass builds the whole-program model those hazards live
+in:
+
+- a **lock model**: every `threading.Lock/RLock/Condition` attribute
+  (instance or module level), named canonically as
+  ``<relpath>::<DefiningClass>.<attr>`` / ``<relpath>::<name>``.
+  ``Condition(self._lock)`` is an *alias* of the wrapped lock, not a
+  new node — acquiring the condition acquires that lock. The defining
+  class is resolved through the MRO, so ``self._lock`` used in `Gauge`
+  but created in `_Metric.__init__` is one node, `_Metric._lock`.
+- an **intra-package call graph**: `self.m()`, `self.attr.m()` via
+  inferred attribute types (constructor assignments, parameter and
+  return annotations), local variables, module functions, imported
+  names, and `ClassName(...)` → `__init__`. Unresolvable calls
+  (callbacks, duck-typed params, stdlib) are ignored — the analysis is
+  deliberately an under-approximation, and the dynamic lock-graph
+  witness (`kubeflow_tpu/testing/lockgraph.py`) cross-validates that
+  every acquisition edge *observed* at runtime is present in the
+  static graph built here.
+- per-function **summaries** (locks transitively acquired, blocking
+  primitives transitively reached) propagated to a fixed point, so a
+  `subprocess.Popen` two calls deep still reports at the `with` that
+  holds the lock over it.
+
+Rules (reported through the normal engine machinery — suppressions,
+baseline, byte-stable output):
+
+- ``lock-order-cycle``: the global acquisition-order graph has a
+  cycle — two threads interleaving those paths can deadlock.
+- ``blocking-under-lock``: a blocking primitive (`time.sleep`,
+  `subprocess.*`, HTTP/socket calls, untimed `.join()`/`queue.get()`/
+  `.wait()`) is reached, possibly transitively, while a lock is held.
+  A condition's own `wait()` releases that condition and is only
+  flagged for *other* locks held across it.
+- ``cv-wait-no-loop``: a condition wait not re-checked in an
+  enclosing loop (spurious wakeups and racing notifies require
+  ``while pred: cv.wait()``).
+- ``lock-leak``: bare ``lock.acquire()`` without a try/finally
+  release — an exception between acquire and release leaks the lock.
+- ``untimed-join``: a no-argument ``.join()`` — a stuck thread or
+  queue hangs the caller forever with no diagnostic; use
+  `kubeflow_tpu/utils/threads.py` or pass a timeout.
+
+Known limitations (all bias toward missing, never toward inventing,
+edges — the witness exists to measure the miss rate on real paths):
+locks held via bare ``acquire()`` are not tracked into the held set;
+nested `def`s are analyzed standalone (empty held set) and are not
+resolvable as callees; calls through callbacks/fields of unknown type
+are skipped; semaphores are not modeled.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Iterable
+
+from kubeflow_tpu.ci.lint.engine import CONCURRENCY_RULE_IDS, Finding
+
+CONCURRENCY_RULES: dict[str, str] = {
+    "lock-order-cycle": (
+        "cyclic lock acquisition order across the call graph — two "
+        "threads interleaving those paths can deadlock"
+    ),
+    "blocking-under-lock": (
+        "a blocking primitive (sleep/subprocess/HTTP/untimed "
+        "join/get/wait) is reached while a lock is held, possibly "
+        "through the call graph"
+    ),
+    "cv-wait-no-loop": (
+        "condition wait not re-checked in an enclosing loop — "
+        "spurious wakeups and racing notifies require `while pred: "
+        "cv.wait()`"
+    ),
+    "lock-leak": (
+        "bare lock.acquire() without try/finally release — an "
+        "exception leaks the lock; use `with` or try/finally"
+    ),
+    "untimed-join": (
+        "no-argument .join() hangs forever on a stuck thread/queue — "
+        "bound it (utils/threads) so shutdown wedges loudly, not "
+        "silently"
+    ),
+}
+
+assert set(CONCURRENCY_RULES) == set(CONCURRENCY_RULE_IDS)
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Condition": "cv",
+}
+
+# Dotted call names that block the calling thread outright.
+_BLOCKING_DOTTED = {
+    "time.sleep",
+    "subprocess.run",
+    "subprocess.Popen",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.call",
+    "os.system",
+}
+# Dotted-name suffixes for network primitives however they're imported
+# (`urllib.request.urlopen`, bare `urlopen`, `socket.create_connection`).
+_BLOCKING_TAILS = ("urlopen", "create_connection")
+# Method names that block regardless of receiver type.
+_BLOCKING_METHODS = ("getresponse",)
+
+
+def _dotted(node: ast.AST) -> str:
+    """`a.b.c` for Name/Attribute chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"
+
+
+def _ann_name(ann: ast.AST | None) -> str | None:
+    """Extract the class name out of an annotation expression:
+    `Gauge`, `"Gauge"`, `Gauge | None`, `Optional[Gauge]`."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, (ast.Name, ast.Attribute)):
+        d = _dotted(ann)
+        return d or None
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        for side in (ann.left, ann.right):
+            if isinstance(side, ast.Constant) and side.value is None:
+                continue
+            got = _ann_name(side)
+            if got:
+                return got
+        return None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value)
+        if base.rsplit(".", 1)[-1] == "Optional":
+            return _ann_name(ann.slice)
+    return None
+
+
+@dataclasses.dataclass
+class _Class:
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    module: "_Module"
+    base_names: list[str] = dataclasses.field(default_factory=list)
+    # attr -> (kind, alias): kind "lock"/"cv"; alias is the attr name a
+    # Condition wraps (`self._cv = threading.Condition(self._lock)`).
+    lock_attrs: dict[str, tuple[str, str | None]] = dataclasses.field(
+        default_factory=dict
+    )
+    # attr -> list of (value expr, defining method) to infer a type from.
+    attr_exprs: dict[
+        str, list[tuple[ast.AST, ast.FunctionDef]]
+    ] = dataclasses.field(default_factory=dict)
+    # attr -> annotation-derived class name (AnnAssign on self.attr).
+    attr_anns: dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class _Module:
+    relpath: str
+    modname: str
+    tree: ast.Module
+    # local name -> fully-qualified dotted target.
+    imports: dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: dict[str, _Class] = dataclasses.field(default_factory=dict)
+    functions: dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict
+    )
+    # module-level lock name -> (kind, alias name or None).
+    module_locks: dict[str, tuple[str, str | None]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class _Func:
+    key: str  # "<relpath>::<qual>" — the call-graph node id
+    qual: str  # "Class.method" / "func" / "Class.method.inner"
+    relpath: str
+    node: ast.FunctionDef
+    cls: _Class | None
+    module: _Module
+    # (lock node, held-before tuple, line)
+    acquires: list[tuple[str, tuple[str, ...], int]] = dataclasses.field(
+        default_factory=list
+    )
+    # (description, exempt lock node or None, held tuple, line)
+    prims: list[
+        tuple[str, str | None, tuple[str, ...], int]
+    ] = dataclasses.field(default_factory=list)
+    # (callee key, held tuple, line)
+    calls: list[tuple[str, tuple[str, ...], int]] = dataclasses.field(
+        default_factory=list
+    )
+    # (receiver source, line, inside-loop)
+    cv_waits: list[tuple[str, int, bool]] = dataclasses.field(
+        default_factory=list
+    )
+    joins: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+    leaks: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+
+
+class Model:
+    """The whole-program concurrency model over a set of parsed files."""
+
+    def __init__(self, trees: dict[str, ast.Module]):
+        self.modules: dict[str, _Module] = {}
+        self.by_modname: dict[str, _Module] = {}
+        self.funcs: dict[str, _Func] = {}
+        # (from, to) -> (relpath, line, qual) best provenance.
+        self.edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        self._mro_cache: dict[str, list[_Class]] = {}
+        for relpath in sorted(trees):
+            if not relpath.startswith("kubeflow_tpu/"):
+                continue
+            mod = self._collect_module(relpath, trees[relpath])
+            self.modules[relpath] = mod
+            self.by_modname[mod.modname] = mod
+        for relpath in sorted(self.modules):
+            self._collect_funcs(self.modules[relpath])
+        for key in sorted(self.funcs):
+            self._scan_function(self.funcs[key])
+        self._fixed_point()
+        self._build_edges()
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect_module(self, relpath: str, tree: ast.Module) -> _Module:
+        if relpath.endswith("/__init__.py"):
+            modname = relpath[: -len("/__init__.py")].replace("/", ".")
+        else:
+            modname = relpath[:-3].replace("/", ".")
+        mod = _Module(relpath=relpath, modname=modname, tree=tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom) and not node.level:
+                base = node.module or ""
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = (
+                        f"{base}.{alias.name}" if base else alias.name
+                    )
+        for st in tree.body:
+            if isinstance(st, ast.ClassDef):
+                mod.classes[st.name] = self._collect_class(st, mod)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[st.name] = st
+            elif isinstance(st, ast.Assign) and len(st.targets) == 1:
+                tgt = st.targets[0]
+                if isinstance(tgt, ast.Name):
+                    fac = self._lock_factory(st.value, mod)
+                    if fac:
+                        mod.module_locks[tgt.id] = fac
+        return mod
+
+    def _lock_factory(
+        self, value: ast.AST, mod: _Module
+    ) -> tuple[str, str | None] | None:
+        """(kind, alias) when `value` constructs a threading lock."""
+        if not isinstance(value, ast.Call):
+            return None
+        d = _dotted(value.func)
+        kind = _LOCK_FACTORIES.get(d)
+        if kind is None and d and "." not in d:
+            kind = _LOCK_FACTORIES.get(mod.imports.get(d, ""))
+        if kind is None:
+            return None
+        alias = None
+        if kind == "cv" and value.args:
+            arg = value.args[0]
+            if (
+                isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"
+            ):
+                alias = arg.attr
+            elif isinstance(arg, ast.Name):
+                alias = arg.id
+        return (kind, alias)
+
+    def _collect_class(self, node: ast.ClassDef, mod: _Module) -> _Class:
+        cls = _Class(
+            name=node.name, relpath=mod.relpath, node=node, module=mod
+        )
+        cls.base_names = [
+            _dotted(b) for b in node.bases if _dotted(b)
+        ]
+        for st in node.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.methods[st.name] = st
+        for meth in cls.methods.values():
+            for sub in ast.walk(meth):
+                target = value = ann = None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    target, value = sub.targets[0], sub.value
+                elif isinstance(sub, ast.AnnAssign):
+                    target, value, ann = sub.target, sub.value, sub.annotation
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    continue
+                attr = target.attr
+                fac = self._lock_factory(value, mod) if value else None
+                if fac:
+                    cls.lock_attrs.setdefault(attr, fac)
+                    continue
+                ann_name = _ann_name(ann)
+                if ann_name:
+                    cls.attr_anns.setdefault(attr, ann_name)
+                if value is not None:
+                    cls.attr_exprs.setdefault(attr, []).append(
+                        (value, meth)
+                    )
+        return cls
+
+    def _collect_funcs(self, mod: _Module) -> None:
+        def add(node, cls, qual):
+            key = f"{mod.relpath}::{qual}"
+            self.funcs[key] = _Func(
+                key=key, qual=qual, relpath=mod.relpath, node=node,
+                cls=cls, module=mod,
+            )
+            for st in node.body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add(st, cls, f"{qual}.{st.name}")
+
+        for fn in mod.functions.values():
+            add(fn, None, fn.name)
+        for cls in mod.classes.values():
+            for name, meth in cls.methods.items():
+                add(meth, cls, f"{cls.name}.{name}")
+        # Module-level code (the `if __name__ == "__main__":` blocks)
+        # blocks a real thread too — scan it as a synthetic function.
+        key = f"{mod.relpath}::<module>"
+        self.funcs[key] = _Func(
+            key=key, qual="<module>", relpath=mod.relpath,
+            node=mod.tree, cls=None, module=mod,
+        )
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_class(self, mod: _Module, name: str) -> _Class | None:
+        if not name:
+            return None
+        if name in mod.classes:
+            return mod.classes[name]
+        head, _, rest = name.partition(".")
+        fq = mod.imports.get(head)
+        if fq is None:
+            return None
+        if rest:
+            # `m.Cls` through `import pkg.mod as m` / `from pkg import mod`
+            fq = f"{fq}.{rest}"
+        if "." not in fq:
+            return None
+        modpart, _, clsname = fq.rpartition(".")
+        target = self.by_modname.get(modpart)
+        if target:
+            return target.classes.get(clsname)
+        return None
+
+    def mro(self, cls: _Class) -> list[_Class]:
+        cached = self._mro_cache.get(cls.relpath + "::" + cls.name)
+        if cached is not None:
+            return cached
+        out, seen = [], set()
+
+        def visit(c: _Class) -> None:
+            cid = c.relpath + "::" + c.name
+            if cid in seen:
+                return
+            seen.add(cid)
+            out.append(c)
+            for bname in c.base_names:
+                base = self.resolve_class(c.module, bname)
+                if base is not None:
+                    visit(base)
+
+        visit(cls)
+        self._mro_cache[cls.relpath + "::" + cls.name] = out
+        return out
+
+    def mro_lookup(
+        self, cls: _Class, name: str
+    ) -> tuple[_Class, ast.FunctionDef] | None:
+        for c in self.mro(cls):
+            if name in c.methods:
+                return (c, c.methods[name])
+        return None
+
+    def lock_node(
+        self, cls: _Class | None, mod: _Module, expr: ast.AST
+    ) -> tuple[str, str] | None:
+        """Resolve a lock-use expression to (node id, kind)."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            return self._attr_lock_node(cls, expr.attr, set())
+        if isinstance(expr, ast.Name):
+            return self._module_lock_node(mod, expr.id, set())
+        return None
+
+    def _attr_lock_node(
+        self, cls: _Class, attr: str, guard: set[str]
+    ) -> tuple[str, str] | None:
+        if attr in guard:
+            return None
+        guard.add(attr)
+        for c in self.mro(cls):
+            if attr in c.lock_attrs:
+                kind, alias = c.lock_attrs[attr]
+                if alias is not None:
+                    # Condition(self._lock): the node IS the wrapped
+                    # lock; acquisition order is about the real mutex.
+                    aliased = self._attr_lock_node(cls, alias, guard)
+                    if aliased is not None:
+                        return (aliased[0], kind)
+                return (f"{c.relpath}::{c.name}.{attr}", kind)
+        return None
+
+    def _module_lock_node(
+        self, mod: _Module, name: str, guard: set[str]
+    ) -> tuple[str, str] | None:
+        if name in guard or name not in mod.module_locks:
+            return None
+        guard.add(name)
+        kind, alias = mod.module_locks[name]
+        if alias is not None:
+            aliased = self._module_lock_node(mod, alias, guard)
+            if aliased is not None:
+                return (aliased[0], kind)
+        return (f"{mod.relpath}::{name}", kind)
+
+    # -- type inference -----------------------------------------------------
+
+    def infer_type(
+        self,
+        expr: ast.AST,
+        mod: _Module,
+        cls: _Class | None,
+        env: dict[str, ast.AST],
+        anns: dict[str, str],
+        depth: int = 0,
+    ):
+        """Best-effort static type of `expr`: a _Class, the marker
+        string "queue.Queue", or None. `env` maps local names to their
+        assigned expressions, `anns` to annotation class names."""
+        if depth > 8:
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return cls
+            if expr.id in anns:
+                return self._class_or_marker(mod, anns[expr.id])
+            if expr.id in env:
+                return self.infer_type(
+                    env[expr.id], mod, cls, {}, anns, depth + 1
+                )
+            return None
+        if isinstance(expr, ast.Attribute):
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and cls is not None
+            ):
+                return self._attr_type(cls, expr.attr, depth)
+            return None
+        if isinstance(expr, ast.BoolOp):
+            for operand in expr.values:
+                got = self.infer_type(
+                    operand, mod, cls, env, anns, depth + 1
+                )
+                if got is not None:
+                    return got
+            return None
+        if isinstance(expr, ast.IfExp):
+            for operand in (expr.body, expr.orelse):
+                got = self.infer_type(
+                    operand, mod, cls, env, anns, depth + 1
+                )
+                if got is not None:
+                    return got
+            return None
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d == "queue.Queue" or d.endswith(".Queue"):
+                return "queue.Queue"
+            if isinstance(expr.func, ast.Name):
+                c = self.resolve_class(mod, expr.func.id)
+                if c is not None:
+                    return c
+                fq = mod.imports.get(expr.func.id)
+                if fq:
+                    modpart, _, clsname = fq.rpartition(".")
+                    target = self.by_modname.get(modpart)
+                    if target:
+                        return target.classes.get(clsname)
+                return None
+            if isinstance(expr.func, ast.Attribute):
+                # `recv.m(...)` -> the return annotation of m.
+                recv_t = self.infer_type(
+                    expr.func.value, mod, cls, env, anns, depth + 1
+                )
+                if isinstance(recv_t, _Class):
+                    hit = self.mro_lookup(recv_t, expr.func.attr)
+                    if hit is not None:
+                        defcls, meth = hit
+                        ret = _ann_name(meth.returns)
+                        if ret:
+                            return self._class_or_marker(
+                                defcls.module, ret
+                            )
+            return None
+        return None
+
+    def _class_or_marker(self, mod: _Module, name: str):
+        if name == "queue.Queue" or name.endswith(".Queue"):
+            return "queue.Queue"
+        return self.resolve_class(mod, name)
+
+    def _attr_type(self, cls: _Class, attr: str, depth: int):
+        for c in self.mro(cls):
+            if attr in c.attr_anns:
+                got = self._class_or_marker(c.module, c.attr_anns[attr])
+                if got is not None:
+                    return got
+            for value, meth in c.attr_exprs.get(attr, ()):
+                env, anns = self._method_env(meth)
+                got = self.infer_type(
+                    value, c.module, c, env, anns, depth + 1
+                )
+                if got is not None:
+                    return got
+        return None
+
+    @staticmethod
+    def _method_env(
+        meth: ast.FunctionDef,
+    ) -> tuple[dict[str, ast.AST], dict[str, str]]:
+        env: dict[str, ast.AST] = {}
+        anns: dict[str, str] = {}
+        args = getattr(meth, "args", None)  # absent on the synthetic
+        if args is not None:  # module-level pseudo-function
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+            ):
+                name = _ann_name(a.annotation)
+                if name:
+                    anns[a.arg] = name
+        roots = [meth]
+        if isinstance(meth, ast.Module):
+            roots = [
+                st
+                for st in meth.body
+                if not isinstance(
+                    st,
+                    (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+                )
+            ]
+        for sub in (s for r in roots for s in ast.walk(r)):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt = sub.targets[0]
+                if isinstance(tgt, ast.Name) and tgt.id not in env:
+                    env[tgt.id] = sub.value
+            elif isinstance(sub, ast.AnnAssign) and isinstance(
+                sub.target, ast.Name
+            ):
+                name = _ann_name(sub.annotation)
+                if name:
+                    anns.setdefault(sub.target.id, name)
+        return env, anns
+
+    def resolve_call(self, call: ast.Call, func: _Func) -> str | None:
+        """Callee function key, or None when the target is outside the
+        package or not statically resolvable."""
+        f = call.func
+        mod = func.module
+        if isinstance(f, ast.Name):
+            if f.id in mod.functions:
+                return f"{mod.relpath}::{f.id}"
+            c = self.resolve_class(mod, f.id)
+            if c is not None:
+                hit = self.mro_lookup(c, "__init__")
+                if hit is not None:
+                    defcls, _ = hit
+                    return f"{defcls.relpath}::{defcls.name}.__init__"
+                return None
+            fq = mod.imports.get(f.id)
+            if fq and "." in fq:
+                modpart, _, name = fq.rpartition(".")
+                target = self.by_modname.get(modpart)
+                if target and name in target.functions:
+                    return f"{target.relpath}::{name}"
+            return None
+        if isinstance(f, ast.Attribute):
+            recv, m = f.value, f.attr
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                if func.cls is None:
+                    return None
+                hit = self.mro_lookup(func.cls, m)
+                if hit is None:
+                    return None
+                defcls, _ = hit
+                return f"{defcls.relpath}::{defcls.name}.{m}"
+            # `module.func(...)` through an imported module name.
+            if isinstance(recv, ast.Name):
+                fq = mod.imports.get(recv.id)
+                target = self.by_modname.get(fq) if fq else None
+                if target is not None:
+                    if m in target.functions:
+                        return f"{target.relpath}::{m}"
+                    return None
+            env, anns = self._method_env(func.node)
+            t = self.infer_type(recv, mod, func.cls, env, anns)
+            if isinstance(t, _Class):
+                hit = self.mro_lookup(t, m)
+                if hit is not None:
+                    defcls, _ = hit
+                    return f"{defcls.relpath}::{defcls.name}.{m}"
+            return None
+        return None
+
+    # -- per-function scan --------------------------------------------------
+
+    def _scan_function(self, func: _Func) -> None:
+        env, anns = self._method_env(func.node)
+
+        def queue_ish(recv: ast.AST) -> bool:
+            tail = _src(recv).rsplit(".", 1)[-1].lower()
+            if tail == "q" or tail.endswith("_q") or "queue" in tail:
+                return True
+            t = self.infer_type(recv, func.module, func.cls, env, anns)
+            return t == "queue.Queue"
+
+        def handle_call(
+            call: ast.Call, held: tuple[str, ...], in_loop: bool
+        ) -> None:
+            line = call.lineno
+            callee = self.resolve_call(call, func)
+            if callee is not None and callee in self.funcs:
+                func.calls.append((callee, held, line))
+                return
+            d = _dotted(call.func)
+            if d in _BLOCKING_DOTTED or (
+                d and d.rsplit(".", 1)[-1] in _BLOCKING_TAILS
+            ):
+                func.prims.append((f"{d}()", None, held, line))
+                return
+            if not isinstance(call.func, ast.Attribute):
+                return
+            attr = call.func.attr
+            recv = call.func.value
+            no_args = not call.args and not call.keywords
+            recv_src = _src(recv)
+            if attr in _BLOCKING_METHODS:
+                func.prims.append(
+                    (f"{recv_src}.{attr}()", None, held, line)
+                )
+            elif attr == "join" and no_args:
+                func.joins.append((recv_src, line))
+                func.prims.append(
+                    (f"{recv_src}.join()", None, held, line)
+                )
+            elif attr == "get" and no_args and queue_ish(recv):
+                func.prims.append(
+                    (f"{recv_src}.get()", None, held, line)
+                )
+            elif attr == "wait":
+                lock = self.lock_node(func.cls, func.module, recv)
+                tail = recv_src.rsplit(".", 1)[-1].lower()
+                cvish = (lock is not None and lock[1] == "cv") or (
+                    "cv" in tail or "cond" in tail
+                )
+                if cvish:
+                    func.cv_waits.append((recv_src, line, in_loop))
+                if no_args:
+                    exempt = lock[0] if lock else None
+                    func.prims.append(
+                        (f"{recv_src}.wait()", exempt, held, line)
+                    )
+
+        def scan_exprs(
+            node: ast.AST, held: tuple[str, ...], in_loop: bool
+        ) -> None:
+            for sub in ast.walk(node):
+                if isinstance(
+                    sub,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ) and sub is not node:
+                    continue  # deferred bodies: analyzed standalone
+                if isinstance(sub, ast.Call):
+                    handle_call(sub, held, in_loop)
+
+        def walk(
+            stmts: list[ast.stmt], held: tuple[str, ...], in_loop: bool
+        ) -> None:
+            for st in stmts:
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    new_held = held
+                    for item in st.items:
+                        scan_exprs(item.context_expr, new_held, in_loop)
+                        lock = self.lock_node(
+                            func.cls, func.module, item.context_expr
+                        )
+                        if lock is not None:
+                            func.acquires.append(
+                                (lock[0], new_held, st.lineno)
+                            )
+                            if lock[0] not in new_held:
+                                new_held = new_held + (lock[0],)
+                    walk(st.body, new_held, in_loop)
+                elif isinstance(
+                    st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                elif isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+                    test = st.test if isinstance(st, ast.While) else st.iter
+                    scan_exprs(test, held, in_loop)
+                    walk(st.body, held, True)
+                    walk(st.orelse, held, in_loop)
+                elif isinstance(st, ast.If):
+                    scan_exprs(st.test, held, in_loop)
+                    walk(st.body, held, in_loop)
+                    walk(st.orelse, held, in_loop)
+                elif isinstance(st, ast.Try):
+                    walk(st.body, held, in_loop)
+                    for handler in st.handlers:
+                        walk(handler.body, held, in_loop)
+                    walk(st.orelse, held, in_loop)
+                    walk(st.finalbody, held, in_loop)
+                else:
+                    scan_exprs(st, held, in_loop)
+
+        walk(func.node.body, (), False)
+        self._leak_scan(func, func.node.body, frozenset())
+
+    def _leak_scan(
+        self, func: _Func, stmts: list[ast.stmt], released: frozenset[str]
+    ) -> None:
+        def is_release(st: ast.stmt, recv_src: str) -> bool:
+            return (
+                isinstance(st, ast.Expr)
+                and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)
+                and st.value.func.attr == "release"
+                and _src(st.value.func.value) == recv_src
+            )
+
+        def finally_releases(st: ast.stmt) -> frozenset[str]:
+            if not isinstance(st, ast.Try):
+                return frozenset()
+            out = set()
+            for sub in st.finalbody:
+                if (
+                    isinstance(sub, ast.Expr)
+                    and isinstance(sub.value, ast.Call)
+                    and isinstance(sub.value.func, ast.Attribute)
+                    and sub.value.func.attr == "release"
+                ):
+                    out.add(_src(sub.value.func.value))
+            return frozenset(out)
+
+        for i, st in enumerate(stmts):
+            if (
+                isinstance(st, ast.Expr)
+                and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)
+                and st.value.func.attr == "acquire"
+            ):
+                recv = st.value.func.value
+                recv_src = _src(recv)
+                if (
+                    self.lock_node(func.cls, func.module, recv)
+                    is not None
+                    and recv_src not in released
+                ):
+                    nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                    if nxt is None or recv_src not in finally_releases(
+                        nxt
+                    ):
+                        func.leaks.append((recv_src, st.lineno))
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                self._leak_scan(func, st.body, released)
+            elif isinstance(st, (ast.While, ast.For, ast.AsyncFor, ast.If)):
+                self._leak_scan(func, st.body, released)
+                self._leak_scan(func, st.orelse, released)
+            elif isinstance(st, ast.Try):
+                self._leak_scan(
+                    func, st.body, released | finally_releases(st)
+                )
+                for handler in st.handlers:
+                    self._leak_scan(func, handler.body, released)
+                self._leak_scan(func, st.orelse, released)
+                self._leak_scan(func, st.finalbody, released)
+
+    # -- summaries ----------------------------------------------------------
+
+    def _fixed_point(self) -> None:
+        self.acq: dict[str, set[str]] = {}
+        # (desc, exempt) -> shortest call path (tuple of quals).
+        self.blocking: dict[
+            str, dict[tuple[str, str | None], tuple[str, ...]]
+        ] = {}
+        for key, func in self.funcs.items():
+            self.acq[key] = {node for node, _, _ in func.acquires}
+            self.blocking[key] = {
+                (desc, exempt): ()
+                for desc, exempt, _, _ in func.prims
+            }
+        keys = sorted(self.funcs)
+        changed = True
+        while changed:
+            changed = False
+            for key in keys:
+                func = self.funcs[key]
+                for callee, _, _ in func.calls:
+                    if callee == key:
+                        continue
+                    extra = self.acq[callee] - self.acq[key]
+                    if extra:
+                        self.acq[key] |= extra
+                        changed = True
+                    callee_qual = self.funcs[callee].qual
+                    for bkey, path in self.blocking[callee].items():
+                        cand = (callee_qual,) + path
+                        cur = self.blocking[key].get(bkey)
+                        if cur is None or (len(cand), cand) < (
+                            len(cur),
+                            cur,
+                        ):
+                            self.blocking[key][bkey] = cand
+                            changed = True
+
+    def _build_edges(self) -> None:
+        def add_edge(a: str, b: str, prov: tuple[str, int, str]) -> None:
+            if a == b:
+                return
+            cur = self.edges.get((a, b))
+            if cur is None or prov < cur:
+                self.edges[(a, b)] = prov
+
+        for key in sorted(self.funcs):
+            func = self.funcs[key]
+            for node, held, line in func.acquires:
+                for h in held:
+                    add_edge(h, node, (func.relpath, line, func.qual))
+            for callee, held, line in func.calls:
+                if not held:
+                    continue
+                for node in sorted(self.acq[callee]):
+                    for h in held:
+                        add_edge(
+                            h, node, (func.relpath, line, func.qual)
+                        )
+
+    # -- findings -----------------------------------------------------------
+
+    def findings(self) -> list[Finding]:
+        out: set[Finding] = set()
+        for key in sorted(self.funcs):
+            func = self.funcs[key]
+            for desc, exempt, held, line in func.prims:
+                eff = sorted({h for h in held if h != exempt})
+                if eff:
+                    out.add(
+                        Finding(
+                            func.relpath, line, "blocking-under-lock",
+                            f"blocking call {desc} while holding "
+                            f"{', '.join(eff)}",
+                        )
+                    )
+            for callee, held, line in func.calls:
+                if not held:
+                    continue
+                callee_qual = self.funcs[callee].qual
+                for (desc, exempt), path in sorted(
+                    self.blocking[callee].items()
+                ):
+                    eff = sorted({h for h in held if h != exempt})
+                    if not eff:
+                        continue
+                    chain = " -> ".join((callee_qual,) + path)
+                    out.add(
+                        Finding(
+                            func.relpath, line, "blocking-under-lock",
+                            f"blocking call {desc} reached via {chain} "
+                            f"while holding {', '.join(eff)}",
+                        )
+                    )
+            for recv_src, line, in_loop in func.cv_waits:
+                if not in_loop:
+                    out.add(
+                        Finding(
+                            func.relpath, line, "cv-wait-no-loop",
+                            f"{recv_src}.wait() outside a while/for "
+                            "re-check loop — condition waits must "
+                            "re-check their predicate (spurious "
+                            "wakeups, racing notifies)",
+                        )
+                    )
+            for recv_src, line in func.joins:
+                out.add(
+                    Finding(
+                        func.relpath, line, "untimed-join",
+                        f"untimed {recv_src}.join() hangs forever on a "
+                        "stuck thread/queue — bound it via "
+                        "utils/threads or pass a timeout",
+                    )
+                )
+            for recv_src, line in func.leaks:
+                out.add(
+                    Finding(
+                        func.relpath, line, "lock-leak",
+                        f"{recv_src}.acquire() without try/finally "
+                        "release — an exception leaks the lock; use "
+                        "`with` or try/finally",
+                    )
+                )
+        out |= set(self._cycle_findings())
+        return sorted(out)
+
+    def _cycle_findings(self) -> list[Finding]:
+        adj: dict[str, list[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for nbrs in adj.values():
+            nbrs.sort()
+        sccs = _tarjan(adj)
+        out = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            nodes = sorted(scc)
+            cycle = self._concrete_cycle(nodes, adj, set(nodes))
+            msg = " -> ".join(cycle)
+            first_edge = (cycle[0], cycle[1])
+            relpath, line, _ = self.edges[first_edge]
+            out.append(
+                Finding(
+                    relpath, line, "lock-order-cycle",
+                    f"cyclic lock acquisition order: {msg} — threads "
+                    "interleaving these paths can deadlock; pick one "
+                    "global order",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _concrete_cycle(
+        nodes: list[str], adj: dict[str, list[str]], scc: set[str]
+    ) -> list[str]:
+        start = nodes[0]
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            for nxt in adj[cur]:
+                if nxt == start and len(path) > 1:
+                    return path + [start]
+                if nxt in scc and nxt not in seen:
+                    seen.add(nxt)
+                    path.append(nxt)
+                    cur = nxt
+                    break
+            else:
+                # Dead end inside the SCC (shouldn't happen for a true
+                # SCC, but stay total): report the node set itself.
+                return nodes + [nodes[0]]
+
+    @property
+    def edge_set(self) -> frozenset[tuple[str, str]]:
+        return frozenset(self.edges)
+
+
+def _tarjan(adj: dict[str, list[str]]) -> list[list[str]]:
+    """Iterative Tarjan SCC over a sorted adjacency map."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(adj[nxt])))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+# -- public API -------------------------------------------------------------
+
+
+def build_model(trees: dict[str, ast.Module]) -> Model:
+    return Model(trees)
+
+
+def build_model_from_root(root: pathlib.Path | None = None) -> Model:
+    from kubeflow_tpu.ci.lint.engine import REPO_ROOT, default_files
+
+    root = root or REPO_ROOT
+    trees: dict[str, ast.Module] = {}
+    for path in default_files(root):
+        relpath = path.relative_to(root).as_posix()
+        if not relpath.startswith("kubeflow_tpu/"):
+            continue
+        try:
+            trees[relpath] = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # reported as parse-error by the engine pass
+    return Model(trees)
+
+
+def static_edges(
+    root: pathlib.Path | None = None,
+) -> frozenset[tuple[str, str]]:
+    """The static lock-acquisition-order edge set — the reference the
+    dynamic witness (`testing/lockgraph.py`) validates against."""
+    return build_model_from_root(root).edge_set
+
+
+def concurrency_findings(
+    trees: dict[str, ast.Module],
+    rules: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Engine entry point: findings over already-parsed files."""
+    found = Model(trees).findings()
+    if rules is not None:
+        wanted = set(rules)
+        found = [f for f in found if f.rule in wanted]
+    return found

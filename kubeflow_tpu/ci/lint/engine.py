@@ -51,6 +51,21 @@ _GENERATED_MARKER = "@generated"
 
 META_RULES = ("unused-suppression", "stale-baseline", "parse-error")
 
+# The whole-program concurrency pass (`concurrency.py`) — not in the
+# per-file Rule registry because its findings come from a global model
+# (call graph + lock graph), but first-class everywhere else:
+# suppressions, baseline, `--rule` narrowing, and the catalog listing
+# all treat these ids like any registered rule. Defined here (not
+# imported from concurrency.py) so rule-id validation never needs the
+# analysis module; a test asserts the two catalogs agree.
+CONCURRENCY_RULE_IDS = (
+    "blocking-under-lock",
+    "cv-wait-no-loop",
+    "lock-leak",
+    "lock-order-cycle",
+    "untimed-join",
+)
+
 
 @dataclasses.dataclass(frozen=True, order=True)
 class Finding:
@@ -256,23 +271,31 @@ def lint_files(
     extra_checks: Iterable[
         Callable[[], Iterable[Finding]]
     ] = (),
+    concurrency: bool = False,
 ) -> LintResult:
     """Run the engine over `files` (paths under `root`). `rules`
     narrows to a subset of rule ids; `extra_checks` lets callers splice
     in non-AST passes (the program-contract backend) so their findings
-    ride the same suppression-free reporting path."""
+    ride the same suppression-free reporting path. `concurrency` adds
+    the whole-program lock-order/blocking pass — it also switches on
+    automatically when `rules` names a concurrency rule id, so
+    `--rule untimed-join` just works."""
     root = root or REPO_ROOT
     registry = all_rules()
     if rules is not None:
-        unknown = set(rules) - set(registry)
+        unknown = set(rules) - set(registry) - set(CONCURRENCY_RULE_IDS)
         if unknown:
             raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        if set(rules) & set(CONCURRENCY_RULE_IDS):
+            concurrency = True
         registry = {k: v for k, v in registry.items() if k in rules}
 
     raw: list[Finding] = []
     suppressed: list[Finding] = []
     used: set[tuple[str, int, str]] = set()
     all_suppressions: list[tuple[str, int, set[str]]] = []
+    supp_by_file: dict[str, dict[int, set[str]]] = {}
+    parsed: dict[str, ast.Module] = {}
 
     for path in sorted(set(files)):
         relpath = path.relative_to(root).as_posix()
@@ -290,8 +313,10 @@ def lint_files(
                 )
             )
             continue
+        parsed[relpath] = tree
         ctx = FileContext(relpath, source, lines, tree)
         supp = suppressions(source)
+        supp_by_file[relpath] = supp
         for lineno, ids in sorted(supp.items()):
             all_suppressions.append((relpath, lineno, ids))
         for rule in registry.values():
@@ -305,13 +330,33 @@ def lint_files(
                 else:
                     raw.append(finding)
 
+    # Whole-program concurrency pass: findings land in real files, so
+    # they ride the same per-line suppression machinery as AST rules.
+    if concurrency:
+        from kubeflow_tpu.ci.lint.concurrency import concurrency_findings
+
+        for finding in concurrency_findings(parsed, rules=rules):
+            ids = supp_by_file.get(finding.path, {}).get(
+                finding.line, set()
+            )
+            if finding.rule in ids:
+                suppressed.append(finding)
+                used.add((finding.path, finding.line, finding.rule))
+            else:
+                raw.append(finding)
+
     # Unused suppressions: a disable comment whose (line, rule) matched
     # nothing. Only raised for rules this run actually executed, so a
-    # --rule-narrowed invocation never mislabels live suppressions.
+    # --rule-narrowed invocation never mislabels live suppressions, and
+    # a concurrency-rule suppression is only judged when the
+    # concurrency pass ran.
     for relpath, lineno, ids in all_suppressions:
         for rule_id in sorted(ids):
-            if rule_id not in registry:
-                if rules is None:
+            executed = rule_id in registry or (
+                concurrency and rule_id in CONCURRENCY_RULE_IDS
+            )
+            if not executed:
+                if rules is None and rule_id not in CONCURRENCY_RULE_IDS:
                     raw.append(
                         Finding(
                             relpath, lineno, "unused-suppression",
@@ -349,12 +394,15 @@ def lint_files(
         # Program-contract entries (path `<program:NAME>`) can only be
         # judged stale on runs where the program pass actually executed
         # (extra_checks carries it); the AST-only default run must not
-        # flag them.
+        # flag them. Same for concurrency-rule entries when the
+        # concurrency pass didn't run.
         programs_ran = bool(extra_checks)
         for key, e in by_key.items():
             if key in matched:
                 continue
             if e["path"].startswith("<program:") and not programs_ran:
+                continue
+            if e["rule"] in CONCURRENCY_RULE_IDS and not concurrency:
                 continue
             findings.append(
                 Finding(
@@ -378,6 +426,7 @@ def lint_repo(
     rules: Iterable[str] | None = None,
     baseline: pathlib.Path | None = DEFAULT_BASELINE,
     programs: bool = False,
+    concurrency: bool = False,
 ) -> LintResult:
     """The full engine over the repo's default file set — what both the
     CLI and `tests/test_lint_clean.py` run."""
@@ -392,4 +441,5 @@ def lint_repo(
         rules=rules,
         baseline=baseline,
         extra_checks=extra,
+        concurrency=concurrency,
     )

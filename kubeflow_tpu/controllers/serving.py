@@ -24,6 +24,7 @@ fleet (`api/serving.py`), this controller materializes it —
 from __future__ import annotations
 
 import logging
+import time
 
 from kubeflow_tpu.api import serving as serving_api
 from kubeflow_tpu.api.objects import Resource, new_resource, owner_ref
@@ -83,6 +84,7 @@ class ServingDeploymentController:
         metrics: MetricsRegistry | None = None,
         resync_seconds: float = 1.0,
         process_runtime=None,
+        clock=None,
     ):
         self.api = api
         metrics = metrics or MetricsRegistry()
@@ -100,6 +102,13 @@ class ServingDeploymentController:
         # state only (rebuilt from live stats after a restart) — never
         # part of the API contract.
         self._latency_windows: dict[tuple, object] = {}
+        # Scale-down stabilization (autoscale.scaleDownStabilizationSeconds):
+        # trailing (timestamp, raw target) samples per deployment. The
+        # fleet only shrinks to the max target over the window, so a
+        # single quiet reconcile can't flap replicas. Injectable clock
+        # so tests drive the window deterministically.
+        self._clock = clock if clock is not None else time.monotonic
+        self._target_history: dict[tuple, object] = {}
         self.ready_replicas = metrics.gauge(
             "serving_ready_replicas",
             "replicas ready to admit traffic",
@@ -199,6 +208,7 @@ class ServingDeploymentController:
                 if rname.startswith(prefix):
                     self._stop_replica(api, ns, rname, runtime=runtime)
         self._latency_windows.pop((ns, name), None)
+        self._target_history.pop((ns, name), None)
 
     def _stop_replica(
         self, api, ns: str, rname: str, runtime=None
@@ -262,6 +272,11 @@ class ServingDeploymentController:
                 total_depth,
                 p99_latency_ms=self._observed_p99(ns, name, wait_samples),
                 current_replicas=len(existing),
+            )
+            target = self._stabilized_target(
+                ns, name, target,
+                current_replicas=len(existing),
+                window_s=spec.autoscale.scale_down_stabilization_s,
             )
         else:
             target = spec.replicas
@@ -367,6 +382,27 @@ class ServingDeploymentController:
             return None
         ordered = sorted(window)
         return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
+    def _stabilized_target(
+        self, ns: str, name: str, raw: int, *,
+        current_replicas: int, window_s: float,
+    ) -> int:
+        """Damp scale-down through the stabilization window (HPA's
+        stabilizationWindowSeconds rule): record the raw target every
+        reconcile, and when the proposal would shrink the fleet, act on
+        the MAX over the trailing window instead — a burst that paused
+        for one reconcile still holds the fleet at burst size. Scale-up
+        passes through untouched (latency breaches must never wait)."""
+        if window_s <= 0:
+            return raw
+        now = self._clock()
+        history = self._target_history.setdefault((ns, name), [])
+        history.append((now, raw))
+        while history and history[0][0] < now - window_s:
+            history.pop(0)
+        if raw >= current_replicas:
+            return raw
+        return max(raw, *(t for _, t in history))
 
     def _roll_outdated(
         self, api, dep: Resource, spec, desired: list[str], rspec: dict,

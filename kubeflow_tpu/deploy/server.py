@@ -28,6 +28,7 @@ from kubeflow_tpu.testing.fake_apiserver import (
     FakeApiServer,
     NotFound,
 )
+from kubeflow_tpu.utils import threads
 from kubeflow_tpu.web import (
     App,
     HttpError,
@@ -325,19 +326,30 @@ class DeployServer(App):
     def _worker_for(self, name: str) -> _Worker | _ProcessWorker:
         with self._lock:
             worker = self._workers.get(name)
-            if worker is None:
-                if self.worker_mode == "process":
-                    worker = _ProcessWorker(
-                        name,
-                        self._facade_url,
-                        self._worker_token,
-                        self._worker_ca,
-                        self.worker_args,
-                    )
-                else:
-                    worker = _Worker(self.api)
-                self._workers[name] = worker
+        if worker is not None:
             return worker
+        # Construct OUTSIDE the lock: a process worker's __init__ spawns
+        # a subprocess (kftpu-race: blocking-under-lock), and _lock is on
+        # every request path. Two racing first-requests may both build a
+        # candidate; the double-checked insert picks one winner and the
+        # loser is stopped before it ever receives work.
+        if self.worker_mode == "process":
+            candidate: _Worker | _ProcessWorker = _ProcessWorker(
+                name,
+                self._facade_url,
+                self._worker_token,
+                self._worker_ca,
+                self.worker_args,
+            )
+        else:
+            candidate = _Worker(self.api)
+        with self._lock:
+            worker = self._workers.get(name)
+            if worker is None:
+                self._workers[name] = worker = candidate
+        if worker is not candidate:
+            candidate.stop()
+        return worker
 
     def _cloud_for(self, spec: PlatformSpec) -> CloudProvider:
         if spec.provider == "fake":
@@ -390,7 +402,11 @@ class DeployServer(App):
         if spec is None:
             raise HttpError(404, f"deployment {name!r} not found")
         if isinstance(worker, _Worker):
-            worker.queue.join()  # drain in-flight applies first
+            # Drain in-flight applies first — bounded, so a wedged apply
+            # fails the delete loudly instead of hanging the request.
+            threads.join_queue(
+                worker.queue, what=f"deployment {name!r} apply queue"
+            )
             worker.stop()
         elif worker is not None:
             worker.stop()  # the CR below is deleted; nothing to drain
@@ -455,4 +471,8 @@ class DeployServer(App):
                         )
                     time.sleep(0.1)
             else:
-                worker.queue.join()
+                threads.join_queue(
+                    worker.queue,
+                    timeout=max(0.1, deadline - time.time()),
+                    what=f"deployment {name!r} apply queue",
+                )

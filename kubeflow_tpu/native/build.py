@@ -21,18 +21,21 @@ _build_lock = threading.Lock()
 def ensure_built(lib_name: str) -> Path:
     """Build (if stale) and return the path to native/build/<lib_name>."""
     lib = _BUILD / lib_name
+    # _build_lock exists to serialize exactly these cmake invocations
+    # (two racing builders corrupt the ninja state); the subprocess IS
+    # the critical section, and nothing else ever takes this lock.
     with _build_lock:
         sources = list((_NATIVE / "src").glob("*.cc")) + [
             _NATIVE / "CMakeLists.txt"
         ]
         src_newest = max(p.stat().st_mtime for p in sources)
         if not lib.exists() or lib.stat().st_mtime < src_newest:
-            subprocess.run(
+            subprocess.run(  # kftpu-lint: disable=blocking-under-lock
                 ["cmake", "-S", str(_NATIVE), "-B", str(_BUILD), "-G",
                  "Ninja"],
                 check=True, capture_output=True,
             )
-            subprocess.run(
+            subprocess.run(  # kftpu-lint: disable=blocking-under-lock
                 ["cmake", "--build", str(_BUILD)],
                 check=True, capture_output=True,
             )
@@ -45,11 +48,22 @@ _libs_lock = threading.Lock()
 
 def load(lib_name: str, configure) -> ctypes.CDLL:
     """Load a native library once per process; `configure(lib)` declares
-    the C ABI (argtypes/restypes) on first load."""
+    the C ABI (argtypes/restypes) on first load.
+
+    The cmake build runs OUTSIDE `_libs_lock` (a cold-cache build takes
+    seconds; holding the cache lock over it would stall every other
+    library's `load`). Two racing first-loaders may both CDLL the same
+    library; the insert is double-checked so exactly one wins, and a
+    duplicate CDLL handle of the same .so is harmless."""
+    with _libs_lock:
+        cached = _libs.get(lib_name)
+    if cached is not None:
+        return cached
+    built = ensure_built(lib_name)
+    fresh = ctypes.CDLL(str(built))
+    configure(fresh)
     with _libs_lock:
         cached = _libs.get(lib_name)
         if cached is None:
-            cached = ctypes.CDLL(str(ensure_built(lib_name)))
-            configure(cached)
-            _libs[lib_name] = cached
+            _libs[lib_name] = cached = fresh
         return cached

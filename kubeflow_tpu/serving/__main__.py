@@ -31,6 +31,8 @@ import logging
 import os
 import threading
 
+from kubeflow_tpu.utils import threads
+
 log = logging.getLogger(__name__)
 
 REPLICA_KIND = "ServingReplica"
@@ -294,7 +296,15 @@ def main() -> None:
             client.close()
         return
 
-    thread.join()
+    # Foreground serve: park on the server thread in bounded slices
+    # (an untimed join would wedge silently if the server thread ever
+    # stuck); ^C shuts the server down and bounds the final join.
+    if threads.run_until_interrupt(thread):
+        server.shutdown()
+        app.close_batchers()
+        threads.join_thread(
+            thread, timeout=10.0, what="model server thread"
+        )
 
 
 if __name__ == "__main__":

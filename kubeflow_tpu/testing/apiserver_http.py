@@ -1120,9 +1120,11 @@ class HttpApiClient:
     def breaker_state(self) -> dict[str, tuple[int, bool]]:
         """Observability: endpoint → (trips, currently_open)."""
         with self._breakers_lock:
-            return {
-                k: (b.trips, b.open) for k, b in self._breakers.items()
-            }
+            snapshot = dict(self._breakers)
+        # Each breaker is read outside the registry lock: `open` takes
+        # the breaker's own lock, and nesting that under `_breakers_lock`
+        # adds a lock-order edge for a pure observability read.
+        return {k: (b.trips, b.open) for k, b in snapshot.items()}
 
     def _call(self, method: str, path: str, body: dict | None = None) -> dict:
         # Transport-level failures (dial refusals, mid-flight deaths,

@@ -1,0 +1,366 @@
+"""Dynamic lock-graph witness: the runtime half of kftpu-race.
+
+The static pass (`ci/lint/concurrency.py`) derives the package's
+lock-acquisition-order graph from source. A static analysis can only be
+trusted if it provably does not under-approximate the paths real runs
+take — so this module wraps `threading.Lock/RLock/Condition` and
+records the acquisition-order edges a live process actually performs.
+The chaos soak and the serving data-plane bench run under the witness
+(opt-in: ``KFTPU_LOCKGRAPH=1``) and assert two things:
+
+- the **observed** graph is acyclic (no run ever interleaved lock
+  acquisitions in cycle-forming order), and
+- every observed edge is **present in the static graph** — if a run
+  acquires B while holding A and the static model has no A→B edge, the
+  model's call-graph resolution missed a real path and must be fixed.
+
+Naming matches the static side exactly: a lock is named by its
+*allocation site* — ``<relpath>::<Class>.<attr>`` for ``self.X =
+threading.Lock()`` inside a method (the textually-enclosing class IS
+the static model's MRO defining class), ``<relpath>::<name>`` at module
+level. ``threading.Condition(existing_lock)`` creates no new node: the
+condition is an alias of the wrapped lock, and since the wrapped
+instrumented lock is handed to the real Condition, the edges attribute
+to the underlying lock automatically — the same aliasing rule the
+static model applies.
+
+Only locks allocated from files under ``kubeflow_tpu/`` are
+instrumented; stdlib internals (`queue.Queue`'s mutex, `threading.Event`'s
+condition) allocate from their own files and keep real primitives, so
+the witness never sees — and never has to model — stdlib-private
+ordering.
+"""
+
+from __future__ import annotations
+
+import ast
+import contextlib
+import linecache
+import os
+import pathlib
+import re
+import sys
+import threading
+import _thread
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+_PKG_PREFIX = str(_REPO_ROOT / "kubeflow_tpu") + os.sep
+
+_SELF_ATTR_RE = re.compile(r"self\.(\w+)\s*(?::[^=]*)?=")
+_NAME_RE = re.compile(r"^\s*(\w+)\s*(?::[^=]*)?=")
+
+
+class _SiteIndex:
+    """filename -> (line -> enclosing class name, line -> assigned attr),
+    built once per file from its AST so allocation sites can be named
+    identically to the static model."""
+
+    def __init__(self) -> None:
+        self._cache: dict[str, tuple[dict[int, str], dict[int, str]]] = {}
+
+    def _build(self, filename: str) -> tuple[dict[int, str], dict[int, str]]:
+        classes: dict[int, str] = {}
+        attrs: dict[int, str] = {}
+        try:
+            tree = ast.parse(
+                pathlib.Path(filename).read_text()
+            )
+        except (OSError, SyntaxError):
+            return classes, attrs
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                    # Innermost class wins: later (nested, higher lineno)
+                    # ClassDefs overwrite the enclosing one's range.
+                    classes[line] = node.name
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            if target is None:
+                continue
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                name = target.attr
+            elif isinstance(target, ast.Name):
+                name = target.id
+            else:
+                continue
+            for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                attrs.setdefault(line, name)
+        return classes, attrs
+
+    def name_for(self, filename: str, lineno: int) -> str:
+        if filename not in self._cache:
+            self._cache[filename] = self._build(filename)
+        classes, attrs = self._cache[filename]
+        relpath = filename
+        try:
+            relpath = pathlib.Path(filename).resolve().relative_to(
+                _REPO_ROOT
+            ).as_posix()
+        except ValueError:
+            pass
+        attr = attrs.get(lineno)
+        if attr is None:
+            line = linecache.getline(filename, lineno)
+            m = _SELF_ATTR_RE.search(line) or _NAME_RE.match(line)
+            attr = m.group(1) if m else f"line{lineno}"
+        cls = classes.get(lineno)
+        if cls:
+            return f"{relpath}::{cls}.{attr}"
+        return f"{relpath}::{attr}"
+
+
+class _InstrumentedLock:
+    """Delegating wrapper around a real Lock/RLock that reports
+    successful acquires/releases to the witness. Implements
+    `_is_owned` by its own owner tracking so a real Condition wrapping
+    it never has to probe with `acquire(0)` (which would record a
+    spurious self-edge)."""
+
+    def __init__(self, real, name: str, witness: "LockGraphWitness"):
+        self._real = real
+        self._kftpu_name = name
+        self._witness = witness
+        self._owner: int | None = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._owner = _thread.get_ident()
+            self._count += 1
+            self._witness._on_acquire(self._kftpu_name)
+        return got
+
+    def release(self) -> None:
+        self._count -= 1
+        if self._count <= 0:
+            self._owner = None
+            self._count = 0
+        self._real.release()
+        self._witness._on_release(self._kftpu_name)
+
+    def __enter__(self) -> "_InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._real, "locked", None)
+        if locked is not None:
+            return locked()
+        return self._count > 0
+
+    def _is_owned(self) -> bool:
+        return self._owner == _thread.get_ident()
+
+    def __repr__(self) -> str:
+        return f"<kftpu-instrumented {self._kftpu_name} {self._real!r}>"
+
+
+class LockGraphWitness:
+    """Records the observed lock-acquisition-order edge set.
+
+    Use as a context manager (or `install()`/`uninstall()`): while
+    installed, every Lock/RLock/Condition *allocated* from package code
+    is wrapped. Locks allocated before installation stay real and
+    unobserved — run the workload's constructors inside the witness.
+    """
+
+    def __init__(self) -> None:
+        # (held, acquired) -> True; guarded by a REAL lock so the
+        # recorder can never participate in instrumented ordering.
+        self._mutex = _thread.allocate_lock()
+        self._edges: set[tuple[str, str]] = set()
+        self._held: dict[int, list[str]] = {}
+        self._saved: dict[str, object] = {}
+        self._sites = _SiteIndex()
+        self._installed = False
+
+    # -- recording ----------------------------------------------------------
+
+    def _on_acquire(self, name: str) -> None:
+        tid = _thread.get_ident()
+        with self._mutex:
+            stack = self._held.setdefault(tid, [])
+            for held in set(stack):
+                if held != name:
+                    self._edges.add((held, name))
+            stack.append(name)
+
+    def _on_release(self, name: str) -> None:
+        tid = _thread.get_ident()
+        with self._mutex:
+            stack = self._held.get(tid)
+            if stack is not None:
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i] == name:
+                        del stack[i]
+                        break
+                if not stack:
+                    self._held.pop(tid, None)
+
+    def record_edge(self, a: str, b: str) -> None:
+        """Test hook: inject an observed edge directly."""
+        with self._mutex:
+            self._edges.add((a, b))
+
+    @property
+    def edges(self) -> frozenset[tuple[str, str]]:
+        with self._mutex:
+            return frozenset(self._edges)
+
+    # -- factory patching ---------------------------------------------------
+
+    def _caller_site(self) -> tuple[str, int] | None:
+        """(filename, lineno) of the allocation when it came from
+        package code, else None."""
+        frame = sys._getframe(2)
+        filename = frame.f_code.co_filename
+        try:
+            resolved = str(pathlib.Path(filename).resolve())
+        except OSError:
+            return None
+        if not resolved.startswith(_PKG_PREFIX):
+            return None
+        return (filename, frame.f_lineno)
+
+    def _make_lock(self, real_factory):
+        def factory():
+            site = self._caller_site()
+            real = real_factory()
+            if site is None:
+                return real
+            name = self._sites.name_for(*site)
+            return _InstrumentedLock(real, name, self)
+
+        return factory
+
+    def _make_condition(self, real_condition, real_lock_factory):
+        def factory(lock=None):
+            if lock is not None:
+                # Condition(existing_lock): alias — no new node. If the
+                # wrapped lock is instrumented its edges already carry
+                # the right name; if it's real, stay out of the way.
+                return real_condition(lock)
+            site = self._caller_site()
+            if site is None:
+                return real_condition()
+            name = self._sites.name_for(*site)
+            inner = _InstrumentedLock(
+                real_lock_factory(), name, self
+            )
+            return real_condition(inner)
+
+        return factory
+
+    def install(self) -> "LockGraphWitness":
+        assert not self._installed, "witness already installed"
+        self._saved = {
+            "Lock": threading.Lock,
+            "RLock": threading.RLock,
+            "Condition": threading.Condition,
+        }
+        real_lock, real_rlock = threading.Lock, threading.RLock
+        real_condition = threading.Condition
+        threading.Lock = self._make_lock(real_lock)  # type: ignore
+        threading.RLock = self._make_lock(real_rlock)  # type: ignore
+        threading.Condition = self._make_condition(  # type: ignore
+            real_condition, real_lock
+        )
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock = self._saved["Lock"]  # type: ignore
+        threading.RLock = self._saved["RLock"]  # type: ignore
+        threading.Condition = self._saved["Condition"]  # type: ignore
+        self._installed = False
+
+    def __enter__(self) -> "LockGraphWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- assertions ---------------------------------------------------------
+
+    def assert_acyclic(self) -> None:
+        """The observed graph must have no cycle: a cycle means the run
+        actually interleaved acquisitions in deadlock-capable order."""
+        edges = self.edges
+        adj: dict[str, list[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        state: dict[str, int] = {}  # 1 = on stack, 2 = done
+
+        def visit(node: str, path: list[str]) -> None:
+            state[node] = 1
+            path.append(node)
+            for nxt in sorted(adj[node]):
+                if state.get(nxt) == 1:
+                    cycle = path[path.index(nxt):] + [nxt]
+                    raise AssertionError(
+                        "observed lock-acquisition cycle: "
+                        + " -> ".join(cycle)
+                    )
+                if nxt not in state:
+                    visit(nxt, path)
+            path.pop()
+            state[node] = 2
+
+        for node in sorted(adj):
+            if node not in state:
+                visit(node, [])
+
+    def assert_subset_of_static(
+        self, static: frozenset[tuple[str, str]] | None = None
+    ) -> None:
+        """Every observed edge must appear in the static lock-order
+        graph — an unseen edge means `ci/lint/concurrency.py` missed a
+        real code path and under-approximates."""
+        if static is None:
+            from kubeflow_tpu.ci.lint.concurrency import static_edges
+
+            static = static_edges()
+        missing = sorted(self.edges - static)
+        if missing:
+            lines = "\n".join(f"  {a} -> {b}" for a, b in missing)
+            raise AssertionError(
+                "observed acquisition edge(s) missing from the static "
+                f"lock-order graph (kftpu-race under-approximates):\n"
+                f"{lines}"
+            )
+
+
+ENV_FLAG = "KFTPU_LOCKGRAPH"
+
+
+@contextlib.contextmanager
+def maybe_witness():
+    """Opt-in wrapper for soaks/benches: under ``KFTPU_LOCKGRAPH=1``
+    runs the body instrumented and, on *successful* exit, asserts the
+    observed graph is acyclic and a subset of the static graph; yields
+    None (and does nothing) otherwise."""
+    if os.environ.get(ENV_FLAG) != "1":
+        yield None
+        return
+    witness = LockGraphWitness()
+    witness.install()
+    try:
+        yield witness
+    finally:
+        witness.uninstall()
+    witness.assert_acyclic()
+    witness.assert_subset_of_static()

@@ -58,6 +58,8 @@ from typing import Any, NamedTuple
 import jax
 import orbax.checkpoint as ocp
 
+from kubeflow_tpu.utils import threads
+
 log = logging.getLogger(__name__)
 
 # Inside each step dir, next to orbax's files (which never collide with
@@ -484,7 +486,12 @@ class Checkpointer:
         manifests are written (call before process exit so a preemption
         can't lose the final save or leave it unverifiable)."""
         self._mgr.wait_until_finished()
-        self._manifest_q.join()
+        # Bounded drain (KFTPU_STUCK_TIMEOUT_S): a wedged manifest
+        # writer must fail the exit path loudly, not hang the trainer
+        # silently through its final save.
+        threads.join_queue(
+            self._manifest_q, what="checkpoint manifest queue"
+        )
         if self._manifest_errors:
             errors, self._manifest_errors = self._manifest_errors, []
             raise RuntimeError(

@@ -1,0 +1,105 @@
+"""Bounded joins with loud stuck-thread diagnostics.
+
+The kftpu-race pass (`ci/lint/concurrency.py`, rule ``untimed-join``)
+bans bare ``thread.join()`` / ``queue.Queue.join()`` in the package: a
+stuck worker then hangs its caller forever with nothing pointing at the
+culprit. These helpers are the sanctioned replacement — they wait up to
+a deadline (default `KFTPU_STUCK_TIMEOUT_S`, 300s) and then raise
+`StuckThreadError` carrying a stack dump of every live thread, so a
+wedged shutdown fails loudly with the evidence attached instead of
+silently parking in `pthread_cond_wait`.
+
+`queue.Queue.join()` has no timeout parameter at all; `join_queue`
+reimplements the drain-wait against the queue's own ``all_tasks_done``
+condition, which is the documented synchronization `Queue.join` uses.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import sys
+import threading
+import time
+import traceback
+
+DEFAULT_TIMEOUT_S = 300.0
+
+
+class StuckThreadError(RuntimeError):
+    """A bounded join expired: some thread/queue never finished."""
+
+
+def stuck_timeout_s() -> float:
+    """The default deadline, overridable via KFTPU_STUCK_TIMEOUT_S."""
+    raw = os.environ.get("KFTPU_STUCK_TIMEOUT_S", "")
+    try:
+        return float(raw) if raw else DEFAULT_TIMEOUT_S
+    except ValueError:
+        return DEFAULT_TIMEOUT_S
+
+
+def dump_thread_stacks() -> str:
+    """One formatted stack per live thread — the diagnostic payload a
+    stuck join attaches so the wedge names its culprit."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        name = names.get(ident, "?")
+        stack = "".join(traceback.format_stack(frame))
+        out.append(f"--- thread {name} (ident={ident}) ---\n{stack}")
+    return "\n".join(out)
+
+
+def join_thread(
+    thread: threading.Thread,
+    timeout: float | None = None,
+    *,
+    what: str = "",
+) -> None:
+    """`thread.join` with a deadline; raises `StuckThreadError` (with
+    all-thread stacks) instead of hanging forever."""
+    deadline = timeout if timeout is not None else stuck_timeout_s()
+    thread.join(deadline)
+    if thread.is_alive():
+        label = what or thread.name
+        raise StuckThreadError(
+            f"{label} still running after {deadline:.0f}s join — "
+            f"thread stacks:\n{dump_thread_stacks()}"
+        )
+
+
+def join_queue(
+    q: "queue_mod.Queue",
+    timeout: float | None = None,
+    *,
+    what: str = "",
+) -> None:
+    """`queue.Queue.join` with a deadline (the stdlib method has none);
+    raises `StuckThreadError` with all-thread stacks on expiry."""
+    deadline_s = timeout if timeout is not None else stuck_timeout_s()
+    deadline = time.monotonic() + deadline_s
+    with q.all_tasks_done:
+        while q.unfinished_tasks:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                label = what or "queue"
+                raise StuckThreadError(
+                    f"{label} still has {q.unfinished_tasks} "
+                    f"unfinished task(s) after {deadline_s:.0f}s — "
+                    f"thread stacks:\n{dump_thread_stacks()}"
+                )
+            q.all_tasks_done.wait(remaining)
+
+
+def run_until_interrupt(thread: threading.Thread) -> bool:
+    """Foreground-serve loop for `__main__` entry points: park on the
+    server thread in bounded slices (so the join is interruptible and
+    never an untimed wedge) until it exits or the operator hits ^C.
+    Returns True when interrupted, False when the thread exited."""
+    try:
+        while thread.is_alive():
+            thread.join(1.0)
+    except KeyboardInterrupt:
+        return True
+    return False

@@ -43,6 +43,7 @@ from kubeflow_tpu.controllers.notebook import NotebookController
 from kubeflow_tpu.controllers.runtime import ControllerManager
 from kubeflow_tpu.controllers.tpujob import LABEL_JOB, TpuJobController
 from kubeflow_tpu.testing.apiserver_http import ApiServerApp, HttpApiClient
+from kubeflow_tpu.testing.lockgraph import maybe_witness
 from kubeflow_tpu.testing.chaos import (
     FAULT_CLASSES,
     ChaosProxy,
@@ -122,7 +123,7 @@ def _poll(pred, timeout, interval=0.1):
     return pred()
 
 
-def _run_soak(
+def _soak_body(
     api,
     backend_name: str,
     seed: int,
@@ -331,6 +332,16 @@ def _run_soak(
         f"client_retries={client.retries_total} "
         f"breakers={client.breaker_state()} {repro}"
     )
+
+
+def _run_soak(api, backend_name, seed, **kwargs) -> None:
+    """Run the soak, optionally under the dynamic lock-graph witness
+    (KFTPU_LOCKGRAPH=1): on a green soak the observed lock-acquisition
+    edges must be acyclic and a subset of the static lock-order graph
+    (ci/lint/concurrency.py) — the under-approximation check for
+    kftpu-race on the exact paths chaos exercises."""
+    with maybe_witness():
+        _soak_body(api, backend_name, seed, **kwargs)
 
 
 def test_chaos_soak_converges(backend):

@@ -1,0 +1,27 @@
+"""True negative: the slow work happens outside the critical section;
+only the cheap publish happens under the lock."""
+
+import threading
+import time
+import urllib.request
+
+
+class Cache:
+    def __init__(self, url):
+        self.url = url
+        self._lock = threading.Lock()
+        self.value = None
+
+    def settle(self):
+        time.sleep(0.5)
+        with self._lock:
+            self.value = 1
+
+    def _fetch(self):
+        with urllib.request.urlopen(self.url) as resp:
+            return resp.read()
+
+    def refresh(self):
+        fresh = self._fetch()
+        with self._lock:
+            self.value = fresh

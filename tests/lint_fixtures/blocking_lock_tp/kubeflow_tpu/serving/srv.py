@@ -1,0 +1,26 @@
+"""True positive: blocking work under a lock — one direct, one reached
+through an intra-class call (the interprocedural half of the pass)."""
+
+import threading
+import time
+import urllib.request
+
+
+class Cache:
+    def __init__(self, url):
+        self.url = url
+        self._lock = threading.Lock()
+        self.value = None
+
+    def settle(self):
+        with self._lock:
+            time.sleep(0.5)  # direct: serializes every reader
+            self.value = 1
+
+    def _fetch(self):
+        with urllib.request.urlopen(self.url) as resp:
+            return resp.read()
+
+    def refresh(self):
+        with self._lock:
+            self.value = self._fetch()  # transitive: HTTP under the lock

@@ -1,0 +1,20 @@
+"""True negative: the wait re-checks its predicate in a while loop."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+    def take(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()
+            return self._items.pop()

@@ -1,0 +1,21 @@
+"""True positive: condition wait guarded by `if` — a spurious wakeup or
+racing notify pops an empty list."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+    def take(self):
+        with self._cv:
+            if not self._items:
+                self._cv.wait()
+            return self._items.pop()

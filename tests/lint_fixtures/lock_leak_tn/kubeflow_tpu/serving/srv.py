@@ -1,0 +1,21 @@
+"""True negative: explicit acquire paired with try/finally release (and
+the `with` form, for good measure)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self, delta):
+        self._lock.acquire()
+        try:
+            self.count += int(delta)
+        finally:
+            self._lock.release()
+
+    def read(self):
+        with self._lock:
+            return self.count

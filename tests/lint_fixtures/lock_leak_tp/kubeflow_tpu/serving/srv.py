@@ -1,0 +1,15 @@
+"""True positive: bare acquire/release — an exception between them
+leaks the lock and wedges every later caller."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self, delta):
+        self._lock.acquire()
+        self.count += int(delta)  # a bad delta raises with the lock held
+        self._lock.release()

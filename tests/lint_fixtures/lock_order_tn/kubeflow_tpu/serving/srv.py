@@ -1,0 +1,20 @@
+"""True negative: both paths honor one global a-before-b order."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.hits = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.hits += 1
+
+    def backward(self):
+        with self._a:
+            with self._b:
+                self.hits -= 1

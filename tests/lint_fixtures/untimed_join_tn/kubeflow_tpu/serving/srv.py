@@ -1,0 +1,16 @@
+"""True negative: every join is bounded."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self.done = False
+
+    def _run(self):
+        self.done = True
+
+    def stop(self):
+        self._thread.join(timeout=10.0)
+        return self._thread.is_alive()

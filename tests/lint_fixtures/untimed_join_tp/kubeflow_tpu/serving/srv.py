@@ -1,0 +1,20 @@
+"""True positive: untimed joins — a stuck worker or a lost task_done
+parks shutdown forever."""
+
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self._q.get()
+            self._q.task_done()
+
+    def stop(self):
+        self._thread.join()
+        self._q.join()

@@ -18,6 +18,15 @@ def test_repo_lint_clean():
     assert result.clean, "\n" + result.render()
 
 
+def test_repo_lint_clean_with_concurrency():
+    """The whole-program concurrency pass (lock-order graph,
+    blocking-under-lock, cv-wait, leaks, untimed joins) also runs clean
+    — every rollout finding was FIXED, not baselined, so the shipped
+    baseline stays empty."""
+    result = lint_repo(concurrency=True)
+    assert result.clean, "\n" + result.render()
+
+
 def test_repo_lint_output_is_byte_stable():
     """Deflake guard: two full engine runs render identical bytes
     (sorted findings, sorted file discovery, __pycache__/generated
@@ -27,10 +36,34 @@ def test_repo_lint_output_is_byte_stable():
     assert a.to_json() == b.to_json()
 
 
+def test_concurrency_lint_output_is_byte_stable():
+    """The concurrency pass iterates fixed-point summaries and a global
+    edge graph — all of it over sorted keys, so two runs must render
+    identical bytes too."""
+    a = lint_repo(concurrency=True)
+    b = lint_repo(concurrency=True)
+    assert a.render() == b.render()
+    assert a.to_json() == b.to_json()
+
+
 def test_lint_cli_exits_zero_on_clean_repo():
     """The acceptance-criteria invocation, exactly as CI runs it."""
     result = subprocess.run(
         [sys.executable, "-m", "kubeflow_tpu.ci", "lint"],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "0 finding(s)" in result.stdout
+
+
+def test_lint_cli_concurrency_exits_zero_on_clean_repo():
+    """The concurrency acceptance invocation, exactly as CI runs it."""
+    result = subprocess.run(
+        [
+            sys.executable, "-m", "kubeflow_tpu.ci", "lint",
+            "--concurrency",
+        ],
         capture_output=True,
         text=True,
     )
@@ -69,5 +102,7 @@ def test_lint_cli_list_rules_names_the_catalog():
         "no-bare-except", "no-interrupt-swallow",
         "no-deepcopy-hot-path", "endpoint-list-clients",
         "scalar-psum-only", "flash-blockwise", "fused-kernel-streams",
+        "lock-order-cycle", "blocking-under-lock", "cv-wait-no-loop",
+        "lock-leak", "untimed-join",
     ):
         assert rule in result.stdout, result.stdout

@@ -13,7 +13,11 @@ import pathlib
 import pytest
 
 from kubeflow_tpu.ci.lint import all_rules, lint_files
-from kubeflow_tpu.ci.lint.engine import Finding, load_baseline
+from kubeflow_tpu.ci.lint.engine import (
+    CONCURRENCY_RULE_IDS,
+    Finding,
+    load_baseline,
+)
 
 FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
 
@@ -46,6 +50,14 @@ TP_CASES = [
     ("psum_tp", "scalar-psum-only", 1),
     ("flash_tp", "flash-blockwise", 2),
     ("fused_tp", "fused-kernel-streams", 1),
+    # Whole-program concurrency pass (auto-enabled when named in rules=).
+    ("lock_order_tp", "lock-order-cycle", 1),
+    # One direct prim + one reached through an intra-class call.
+    ("blocking_lock_tp", "blocking-under-lock", 2),
+    ("cv_wait_tp", "cv-wait-no-loop", 1),
+    ("lock_leak_tp", "lock-leak", 1),
+    # Thread join + queue join, both untimed.
+    ("untimed_join_tp", "untimed-join", 2),
 ]
 
 TN_CASES = [
@@ -59,6 +71,11 @@ TN_CASES = [
     ("psum_tn", "scalar-psum-only"),
     ("flash_tn", "flash-blockwise"),
     ("flash_tn", "fused-kernel-streams"),
+    ("lock_order_tn", "lock-order-cycle"),
+    ("blocking_lock_tn", "blocking-under-lock"),
+    ("cv_wait_tn", "cv-wait-no-loop"),
+    ("lock_leak_tn", "lock-leak"),
+    ("untimed_join_tn", "untimed-join"),
 ]
 
 
@@ -81,7 +98,7 @@ def test_every_shipped_rule_has_fixture_coverage():
     """The catalog contract: a rule without a true-positive fixture is
     a rule nobody proved fires."""
     covered = {rule for _, rule, _ in TP_CASES}
-    shipped = set(all_rules())
+    shipped = set(all_rules()) | set(CONCURRENCY_RULE_IDS)
     assert shipped == covered, shipped ^ covered
 
 

@@ -1,0 +1,120 @@
+"""Dynamic lock-graph witness (`testing/lockgraph.py`).
+
+The witness is the runtime half of kftpu-race: it must name locks
+exactly as the static model does (allocation site, MRO defining class),
+record acquisition-order edges, detect observed cycles, and fail loudly
+when a run exercises an edge the static graph is missing — that last
+assertion is the feedback loop that keeps `ci/lint/concurrency.py`
+honest, so these tests fabricate both failure modes directly.
+"""
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.testing.lockgraph import (
+    ENV_FLAG,
+    LockGraphWitness,
+    maybe_witness,
+)
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+REG_LOCK = "kubeflow_tpu/utils/metrics.py::MetricsRegistry._lock"
+METRIC_LOCK = "kubeflow_tpu/utils/metrics.py::_Metric._lock"
+
+
+def test_witness_names_locks_by_defining_class():
+    """Locks allocated from package code are instrumented and named by
+    allocation site — including the MRO rule: a Gauge's lock is named
+    for `_Metric`, the class whose __init__ allocates it, matching the
+    static model exactly."""
+    with LockGraphWitness() as witness:
+        registry = MetricsRegistry()
+        gauge = registry.gauge("g", "test gauge")
+        with registry._lock:
+            with gauge._lock:
+                pass
+    assert (REG_LOCK, METRIC_LOCK) in witness.edges
+
+
+def test_locks_allocated_outside_the_package_stay_real():
+    with LockGraphWitness() as witness:
+        lock = threading.Lock()  # tests/ is not package code
+        with lock:
+            pass
+    assert not hasattr(lock, "_kftpu_name")
+    assert witness.edges == frozenset()
+
+
+def test_condition_wrapping_a_package_lock_aliases_it():
+    """Condition(existing_lock) introduces no new node: edges taken
+    through the condition attribute to the lock it wraps."""
+    with LockGraphWitness() as witness:
+        registry = MetricsRegistry()
+        gauge = registry.gauge("g", "test gauge")
+        cv = threading.Condition(registry._lock)
+        with gauge._lock:
+            with cv:
+                pass
+    assert (METRIC_LOCK, REG_LOCK) in witness.edges
+
+
+def test_uninstall_restores_the_real_factories():
+    real = (threading.Lock, threading.RLock, threading.Condition)
+    with LockGraphWitness():
+        assert threading.Lock is not real[0]
+    assert (threading.Lock, threading.RLock, threading.Condition) == real
+
+
+def test_assert_acyclic_detects_observed_cycle():
+    witness = LockGraphWitness()
+    witness.record_edge("a.py::A._l", "a.py::B._l")
+    witness.record_edge("a.py::B._l", "a.py::A._l")
+    with pytest.raises(AssertionError, match="cycle"):
+        witness.assert_acyclic()
+
+
+def test_assert_acyclic_passes_on_a_dag():
+    witness = LockGraphWitness()
+    witness.record_edge("a.py::A._l", "a.py::B._l")
+    witness.record_edge("a.py::A._l", "a.py::C._l")
+    witness.record_edge("a.py::B._l", "a.py::C._l")
+    witness.assert_acyclic()
+
+
+def test_subset_check_fires_on_an_edge_the_static_graph_lacks():
+    witness = LockGraphWitness()
+    edge = ("x.py::Fab._a", "x.py::Fab._b")
+    witness.record_edge(*edge)
+    with pytest.raises(AssertionError, match="Fab._a -> x.py::Fab._b"):
+        witness.assert_subset_of_static(frozenset())
+    witness.assert_subset_of_static(frozenset({edge}))  # covered: fine
+
+
+def test_maybe_witness_is_inert_without_the_env_flag(monkeypatch):
+    monkeypatch.delenv(ENV_FLAG, raising=False)
+    real_lock = threading.Lock
+    with maybe_witness() as witness:
+        assert witness is None
+        assert threading.Lock is real_lock
+
+
+def test_maybe_witness_asserts_on_exit_when_enabled(monkeypatch):
+    monkeypatch.setenv(ENV_FLAG, "1")
+    with pytest.raises(AssertionError, match="cycle"):
+        with maybe_witness() as witness:
+            assert witness is not None
+            witness.record_edge("a.py::A._l", "a.py::B._l")
+            witness.record_edge("a.py::B._l", "a.py::A._l")
+    assert not hasattr(threading.Lock, "_kftpu_name")
+
+
+def test_maybe_witness_skips_assertions_when_the_body_raises(monkeypatch):
+    """A failing workload must surface ITS error, not a witness
+    assertion stacked on top of it."""
+    monkeypatch.setenv(ENV_FLAG, "1")
+    with pytest.raises(RuntimeError, match="workload"):
+        with maybe_witness() as witness:
+            witness.record_edge("a.py::A._l", "a.py::B._l")
+            witness.record_edge("a.py::B._l", "a.py::A._l")
+            raise RuntimeError("workload died")

@@ -439,6 +439,81 @@ def test_autoscale_scales_out_on_observed_latency(harness):
     assert len(runtime.replicas) == 4
 
 
+def test_scale_down_stabilization_prevents_flap(harness):
+    """A transient pressure dip inside the stabilization window must not
+    shrink the fleet (flap-free scale-down); once the window drains of
+    high targets, scale-down proceeds — and scale-up stays immediate."""
+    api, runtime, _ = harness
+    now = [1000.0]
+    controller = ServingDeploymentController(
+        api, runtime=runtime, clock=lambda: now[0]
+    )
+    api.create(
+        serving_api.make_serving_deployment(
+            "fleet",
+            replicas=1,
+            autoscale={
+                "min_replicas": 1,
+                "max_replicas": 4,
+                "target_queue_depth": 10,
+                "scale_down_stabilization_s": 30.0,
+            },
+        )
+    )
+    converge(controller)
+    r0 = serving_api.replica_name("fleet", 0)
+    runtime.replicas[r0]["queue_depth"] = 40  # → 4 replicas
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    assert len(runtime.replicas) == 4
+
+    # The burst pauses for one reconcile: raw target collapses to 1 but
+    # the window still holds the 4 — the fleet must not move.
+    runtime.replicas[r0]["queue_depth"] = 0
+    now[0] += 5.0
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    assert len(runtime.replicas) == 4
+    assert dep_status(api)["targetReplicas"] == 4
+    assert runtime.stopped == []
+
+    # Pressure returns mid-window: scale-up needs no window to pass —
+    # the fleet is already at 4 and stays there.
+    runtime.replicas[r0]["queue_depth"] = 40
+    now[0] += 5.0
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    assert len(runtime.replicas) == 4
+
+    # Quiet past the whole window: the high samples age out and the
+    # fleet finally settles to min.
+    runtime.replicas[r0]["queue_depth"] = 0
+    now[0] += 31.0
+    controller.controller.enqueue(("default", "fleet"))
+    converge(controller)
+    assert len(runtime.replicas) == 1
+    assert dep_status(api)["targetReplicas"] == 1
+
+
+def test_stabilization_field_roundtrip_and_validation():
+    spec = serving_api.ServingDeploymentSpec(
+        autoscale=serving_api.AutoscaleSpec(
+            max_replicas=4, scale_down_stabilization_s=30.0
+        )
+    )
+    d = spec.to_dict()
+    assert d["autoscale"]["scaleDownStabilizationSeconds"] == 30.0
+    parsed = serving_api.ServingDeploymentSpec.from_dict(d)
+    assert parsed.autoscale.scale_down_stabilization_s == 30.0
+    # Absent field defaults off (existing CRs parse unchanged).
+    no_window = serving_api.ServingDeploymentSpec.from_dict(
+        {"autoscale": {"maxReplicas": 2}}
+    )
+    assert no_window.autoscale.scale_down_stabilization_s == 0.0
+    with pytest.raises(ValueError, match="scaleDownStabilization"):
+        serving_api.AutoscaleSpec(scale_down_stabilization_s=-1).validate()
+
+
 # -- runtime: process -----------------------------------------------------
 
 
