@@ -727,7 +727,7 @@ def _bench_serving_dataplane(args) -> None:
 def _serving_dataplane_body(args) -> None:
     """Multi-replica serving data plane (ISSUE 11): ServingDeployment CR
     -> controller -> replica fleet behind the drain-aware router, driven
-    by thousands of concurrent closed-loop clients. Four phases:
+    by thousands of concurrent closed-loop clients. Five phases:
 
     1. STEADY latency: every client in flight at once, fleet provisioned
        with 2x headroom — serving_p50/p99_latency_ms.
@@ -738,11 +738,17 @@ def _serving_dataplane_body(args) -> None:
     3. ROLL under load: bump spec.modelVersion on the CR and let the
        threaded controller drain-swap-readmit one replica at a time —
        serving_checkpoint_roll_seconds, gated on ZERO request failures.
-    4. CHAOS: a seeded ReplicaKillSchedule SIGKILLs a replica (a real
+    4. WIRE: binary tensor frames vs the JSON surface over a real
+       model-server HTTP boundary — serving_wire_bytes_per_request,
+       hard-gated at <= 0.35x the JSON bytes, pooling engaged.
+    5. CHAOS: a seeded ReplicaKillSchedule SIGKILLs a replica (a real
        model-server subprocess, or an in-process hard queue kill with
        --serving-chaos local) mid-load; the run hard-fails unless
        acked == completed and failed == 0 — zero dropped ACKNOWLEDGED
-       requests (shed-before-ack is the 429 path, not a drop).
+       requests (shed-before-ack is the 429 path, not a drop). With
+       --serving-chaos processes the clients are pooled keep-alive
+       HttpReplicas speaking the binary protocol — the SIGKILL lands on
+       live pooled sockets and the ack contract must still hold.
 
     Same repro contract as the other soaks: the kill schedule's seed is
     printed up front and on failure, and --chaos-seed replays it."""
@@ -813,11 +819,14 @@ def _serving_dataplane_body(args) -> None:
     # 2x headroom: steady/roll/chaos phases must never shed (a shed
     # during chaos would hide a dropped acked request behind a 429).
     max_pending = max(64, (2 * clients + n_replicas - 1) // n_replicas)
+    # max_batch 64: the tiny model sustains ~3.2k inst/s at batch 64 vs
+    # ~2.4k at 32 on the CI host (deeper flush windows amortize the
+    # per-flush scheduling work the r15 batcher overhaul shrank).
     api.create(
         serving_api.make_serving_deployment(
             "bench",
             replicas=n_replicas,
-            max_batch=32,
+            max_batch=64,
             batch_timeout_ms=2.0,
             max_pending=max_pending,
             model_version=1,
@@ -965,7 +974,11 @@ def _serving_dataplane_body(args) -> None:
             f"drain-based roll — a roll must be zero-downtime"
         )
 
-    # -- phase 4: replica-kill chaos — zero dropped acked requests
+    # -- phase 4: wire protocol — binary tensor frames vs JSON bytes
+    # over a REAL model-server HTTP boundary (ISSUE 15)
+    wire_row = _serving_wire_phase(x, factory)
+
+    # -- phase 5: replica-kill chaos — zero dropped acked requests
     chaos_row = None
     if args.serving_chaos != "off":
         chaos_row = _serving_chaos_phase(
@@ -1018,6 +1031,7 @@ def _serving_dataplane_body(args) -> None:
                 }
             )
         )
+    print(json.dumps(wire_row))
     if chaos_row is not None:
         print(json.dumps(chaos_row))
     print(
@@ -1028,6 +1042,83 @@ def _serving_dataplane_body(args) -> None:
         f"(0 failures); seed={seed}",
         file=sys.stderr,
     )
+
+
+def _serving_wire_phase(x, factory, requests: int = 200) -> dict:
+    """Binary tensor protocol vs JSON, measured as bytes on a REAL
+    model-server HTTP boundary (ISSUE 15): one server, two HttpReplica
+    clients — one negotiating ``application/x-kftpu-tensor`` frames
+    (the default), one pinned to the TF-Serving JSON surface — each
+    driving the same float32 batch. Gates:
+
+    - binary wire bytes must be <= 0.35x the JSON path (the whole
+      point of the frame: raw little-endian bytes vs ~19 chars of
+      decimal text per float);
+    - the pooled keep-alive transport must actually pool (dials stays
+      O(1) while requests grow — conn-per-request would dial per
+      request).
+
+    The published BASELINE for serving_wire_bytes_per_request is the
+    JSON path's bytes, so vs_baseline IS the ratio under the gate."""
+    from kubeflow_tpu.serving import (
+        HttpReplica,
+        ModelRepository,
+        ModelServerApp,
+    )
+    from kubeflow_tpu.web.wsgi import serve
+
+    app = ModelServerApp(ModelRepository([factory({"model": "demo"})]))
+    server, thread = serve(app, host="127.0.0.1", port=0)
+    addr = f"127.0.0.1:{server.server_port}"
+    stats = {}
+    try:
+        for mode, binary in (("binary", True), ("json", False)):
+            replica = HttpReplica(
+                f"wire-{mode}", addr, "demo", binary=binary
+            )
+            for _ in range(requests):
+                replica.predict(x)
+            stats[mode] = replica.transport_stats()
+            replica.close()
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+    per_request = {
+        mode: (st["bytes_sent"] + st["bytes_received"]) / requests
+        for mode, st in stats.items()
+    }
+    ratio = per_request["binary"] / per_request["json"]
+    if ratio > 0.35:
+        raise SystemExit(
+            f"serving wire: binary path moved {per_request['binary']:.0f} "
+            f"bytes/request vs JSON {per_request['json']:.0f} — ratio "
+            f"{ratio:.3f} > 0.35; the frame negotiation regressed"
+        )
+    max_dials = max(st["dials"] for st in stats.values())
+    if max_dials > 4:
+        raise SystemExit(
+            f"serving wire: {max_dials} dials for {requests} requests — "
+            f"the keep-alive pool is not reusing connections"
+        )
+    print(
+        f"# serving wire: binary {per_request['binary']:.0f} B/req vs "
+        f"json {per_request['json']:.0f} B/req (ratio {ratio:.3f}, "
+        f"gate 0.35); dials binary={stats['binary']['dials']} "
+        f"json={stats['json']['dials']} over {requests} reqs each",
+        file=sys.stderr,
+    )
+    base = _published_baseline("serving_wire_bytes_per_request")
+    value = round(per_request["binary"], 1)
+    return {
+        "metric": "serving_wire_bytes_per_request",
+        "value": value,
+        "unit": (
+            "request+response bytes per float32 (1,32,32,3) predict "
+            "over the binary tensor protocol; baseline is the JSON "
+            "path (lower is better, gate <= 0.35x)"
+        ),
+        "vs_baseline": round(value / base, 4) if base else None,
+    }
 
 
 def _serving_chaos_phase(

@@ -334,13 +334,48 @@ def _build_elastic_resize_step() -> Program:
 def _build_serving_batch() -> Program:
     """One servable bucket execution: a single-device program — no
     collective of any family may appear (a sharded-serving refactor
-    that silently leaves one in costs every request a device fence)."""
+    that silently leaves one in costs every request a device fence).
+
+    Also pins the binary wire path (ISSUE 15): the tensor-frame
+    encode/decode in `serving/wire.py` and the server's binary request/
+    response helpers must never regrow a ``tolist()`` or a per-element
+    JSON encode — that text round-trip is exactly the overhead the
+    protocol removed (docs/perf.md §serving wire path)."""
+    import ast as ast_mod
+    import pathlib
+
     import jax
     import jax.numpy as jnp
 
+    from kubeflow_tpu.serving import server as server_mod
+    from kubeflow_tpu.serving import wire as wire_mod
     from kubeflow_tpu.serving.servable import Servable
     from kubeflow_tpu.testing.hlo import compiled_hlo
     from kubeflow_tpu.testing.tinymodels import TinyMLP
+
+    binary_fns = {
+        wire_mod.__file__: {"encode_tensor", "decode_tensor"},
+        server_mod.__file__: {
+            "_binary_instances", "_binary_prediction_response",
+        },
+    }
+    found: set = set()
+    text_hops: list[str] = []
+    for path, names in binary_fns.items():
+        tree = ast_mod.parse(pathlib.Path(path).read_text())
+        for node in ast_mod.walk(tree):
+            if (
+                isinstance(node, ast_mod.FunctionDef)
+                and node.name in names
+            ):
+                found.add(node.name)
+                for sub in ast_mod.walk(node):
+                    if isinstance(sub, ast_mod.Attribute) and sub.attr in (
+                        "tolist", "dumps", "loads",
+                    ):
+                        text_hops.append(f"{node.name}: .{sub.attr}")
+                    if isinstance(sub, ast_mod.Name) and sub.id == "json":
+                        text_hops.append(f"{node.name}: json")
 
     model = TinyMLP()
     x = jnp.zeros((4, 8, 8, 1), jnp.float32)
@@ -349,7 +384,18 @@ def _build_serving_batch() -> Program:
         name="contract", apply_fn=model.apply, variables=variables,
         max_batch=4,
     )
-    return Program(hlo=compiled_hlo(sv._jitted, sv.variables, x))
+    return Program(
+        hlo=compiled_hlo(sv._jitted, sv.variables, x),
+        meta={
+            # All four functions found (a rename would silently exempt
+            # them from the scan) and none round-trips through text.
+            "binary_wire_clean": (
+                not text_hops
+                and found == set().union(*binary_fns.values())
+            ),
+            "text_hops": text_hops,
+        },
+    )
 
 
 def _build_serving_batch_continuous() -> Program:
@@ -657,12 +703,14 @@ CONTRACTS: tuple[ProgramContract, ...] = (
     ),
     ProgramContract(
         name="serving-batch",
-        description="servable bucket program: zero collectives",
+        description="servable bucket program: zero collectives; binary "
+        "wire path free of tolist/JSON text hops",
         build=_build_serving_batch,
         forbid_collectives=(
             "all-gather", "reduce-scatter", "all-reduce",
             "collective-permute", "all-to-all",
         ),
+        meta_true=("binary_wire_clean",),
     ),
     ProgramContract(
         name="rl-learner-step",

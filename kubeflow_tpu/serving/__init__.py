@@ -8,7 +8,10 @@ equivalent: a JAX model server speaking the same REST surface
 (`/v1/models/<name>` status + `:predict` verb), with TPU-first execution —
 requests are padded into a small set of static batch buckets so XLA
 compiles one program per bucket instead of one per request size, and the
-hot path is a single jitted apply on device.
+hot path is a single jitted apply on device. Tensors cross the wire as
+binary frames (`serving/wire.py`, ``application/x-kftpu-tensor``)
+negotiated on the same routes, with the JSON surface intact for
+TF-Serving parity clients.
 """
 
 from kubeflow_tpu.serving.batching import BatchingConfig, BatchingQueue
@@ -26,6 +29,12 @@ from kubeflow_tpu.serving.router import (
 )
 from kubeflow_tpu.serving.servable import Servable
 from kubeflow_tpu.serving.server import ModelRepository, ModelServerApp
+from kubeflow_tpu.serving.wire import (
+    TENSOR_CONTENT_TYPE,
+    WireFormatError,
+    decode_tensor,
+    encode_tensor,
+)
 
 __all__ = [
     "BatchingConfig",
@@ -41,4 +50,8 @@ __all__ = [
     "ReplicaOverloaded",
     "Router",
     "Servable",
+    "TENSOR_CONTENT_TYPE",
+    "WireFormatError",
+    "decode_tensor",
+    "encode_tensor",
 ]

@@ -5,7 +5,10 @@
 Each --model loads an orbax checkpoint written by the training loop and
 serves it at /v1/models/<name>. With no --model flags a demo model is
 served under the name "demo" so the REST surface can be probed standalone
-(the tf-serving sample served mnist the same way).
+(the tf-serving sample served mnist the same way). The :predict route
+speaks both JSON and the binary tensor protocol
+(``application/x-kftpu-tensor``, `serving/wire.py`) — router-side
+`HttpReplica` clients negotiate binary automatically.
 
 Replica mode (the ServingDeployment data plane, docs/serving.md):
 

@@ -39,6 +39,7 @@ status (docs/serving.md).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
@@ -67,7 +68,9 @@ class BatchingConfig:
 
 
 class _Entry:
-    __slots__ = ("instances", "event", "result", "error", "arrived")
+    __slots__ = (
+        "instances", "event", "result", "error", "arrived", "signature",
+    )
 
     def __init__(self, instances: np.ndarray):
         self.instances = instances
@@ -75,6 +78,10 @@ class _Entry:
         self.result: np.ndarray | None = None
         self.error: BaseException | None = None
         self.arrived = time.monotonic()
+        # Computed ONCE at admission: the scheduler re-reads it on every
+        # cut, grouping pass, and late-admission scan — under the queue
+        # lock, where per-entry tuple building was pure contention.
+        self.signature = _signature(instances)
 
 
 def _signature(instances: np.ndarray) -> tuple:
@@ -134,7 +141,10 @@ class BatchingQueue:
             ("model",),
         )
         self._cv = threading.Condition()
-        self._pending: list[_Entry] = []
+        # Deque, not list: _cut_locked consumes from the head, and under
+        # a deep queue list.pop(0) made every cut O(pending) while
+        # holding the lock every caller needs.
+        self._pending: collections.deque[_Entry] = collections.deque()
         self._pending_count = 0
         self._inflight: list[_Entry] = []
         self._wait_ewma_ms = 0.0
@@ -168,12 +178,24 @@ class BatchingQueue:
                     f"batching queue for {self.servable.name!r} is full "
                     f"({self._pending_count} pending)"
                 )
+            was_empty = not self._pending
+            prev_count = self._pending_count
             self._pending.append(entry)
             self._pending_count += batch.shape[0]
             self.queue_depth.set(
                 self._pending_count, model=self.servable.name
             )
-            self._cv.notify_all()
+            # Wake the scheduler only when this admission changes what
+            # it would do: first entry arms the timeout window (it is
+            # parked in an untimed wait), and crossing max_batch makes
+            # the cut due early. Everything else it discovers on its own
+            # timed wakeup — under a deep queue the old unconditional
+            # notify_all was thousands of pure-overhead scheduler
+            # wakeups a second (docs/perf.md §serving wire path).
+            if was_empty or (
+                prev_count < self.config.max_batch <= self._pending_count
+            ):
+                self._cv.notify()
         entry.event.wait()
         if entry.error is not None:
             raise entry.error
@@ -207,7 +229,9 @@ class BatchingQueue:
         idempotent requests elsewhere (`serving/router.py`)."""
         with self._cv:
             self._closed = True
-            pending, self._pending = self._pending, []
+            pending, self._pending = (
+                list(self._pending), collections.deque()
+            )
             self._pending_count = 0
             self.queue_depth.set(0, model=self.servable.name)
             inflight = list(self._inflight)
@@ -255,7 +279,7 @@ class BatchingQueue:
             n = nxt.instances.shape[0]
             if take and count + n > self.config.max_batch:
                 break  # next entry rides the following flush
-            take.append(self._pending.pop(0))
+            take.append(self._pending.popleft())
             count += n
             if count >= self.config.max_batch:
                 break
@@ -286,14 +310,16 @@ class BatchingQueue:
                 n = e.instances.shape[0]
                 if (
                     count + n <= self.config.max_batch
-                    and _signature(e.instances) == key
+                    and e.signature == key
                 ):
                     taken.append(e)
                     count += n
                 else:
                     kept.append(e)
             if taken:
-                self._pending = kept
+                # Mismatched entries stay IN ARRIVAL ORDER — the next
+                # cut still honors the oldest caller's deadline.
+                self._pending = collections.deque(kept)
                 admitted = sum(e.instances.shape[0] for e in taken)
                 self._pending_count -= admitted
                 self.queue_depth.set(
@@ -320,9 +346,7 @@ class BatchingQueue:
             # requests sharing the flush.
             groups: dict = {}
             for entry in entries:
-                groups.setdefault(_signature(entry.instances), []).append(
-                    entry
-                )
+                groups.setdefault(entry.signature, []).append(entry)
             try:
                 for key, group in groups.items():
                     self._run_group(key, group)
@@ -343,7 +367,9 @@ class BatchingQueue:
     def _abort(self, entries: list[_Entry], e: BaseException) -> None:
         with self._cv:
             self._closed = True  # later predict() gets QueueClosed
-            pending, self._pending = self._pending, []
+            pending, self._pending = (
+                list(self._pending), collections.deque()
+            )
             self._pending_count = 0
             self.queue_depth.set(0, model=self.servable.name)
             inflight, self._inflight = self._inflight, []
@@ -361,7 +387,16 @@ class BatchingQueue:
             group = group + late
         self.inflight_batches.set(1, model=self.servable.name)
         try:
-            merged = np.concatenate([e.instances for e in group], axis=0)
+            # A flush window holding ONE entry (the batch-1 steady state
+            # at low concurrency) skips the concatenate — np.concatenate
+            # copies even for a single input, and this is the hot path.
+            merged = (
+                group[0].instances
+                if len(group) == 1
+                else np.concatenate(
+                    [e.instances for e in group], axis=0
+                )
+            )
             out = self.servable.predict(merged)
         except BaseException as e:
             # Execution failures propagate to THIS group only. An

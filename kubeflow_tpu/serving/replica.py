@@ -9,10 +9,11 @@ it:
   in this process. The single-binary dev/bench shape, and the unit the
   chaos tests hard-kill (`kill()` fails in-flight callers exactly the
   way a SIGKILLed process resets its connections).
-- `HttpReplica`: a model-server process reached over HTTP
-  (`serving/__main__.py`); connection failures and 5xx map to
-  `ReplicaGone`, 429 maps to `ReplicaOverloaded` with the server's own
-  Retry-After hint.
+- `HttpReplica`: a model-server process reached over a pooled
+  keep-alive HTTP transport speaking the binary tensor protocol
+  (`serving/wire.py`, JSON negotiation fallback); transport failures
+  and 5xx map to `ReplicaGone` (and invalidate the pool), 429 maps to
+  `ReplicaOverloaded` with the server's own Retry-After hint.
 
 `LocalReplicaRuntime` is the materialization backend the serving
 controller drives (`controllers/serving.py`): ensure/stop/roll replicas
@@ -24,10 +25,12 @@ from __future__ import annotations
 
 import http.client
 import json
+import select
 import threading
 
 import numpy as np
 
+from kubeflow_tpu.serving import wire
 from kubeflow_tpu.serving.batching import (
     BatchingConfig,
     BatchingQueue,
@@ -123,9 +126,36 @@ class LocalReplica:
 
 class HttpReplica:
     """A model-server process (`python -m kubeflow_tpu.serving`) behind
-    the router. One connection per request: the chaos variant SIGKILLs
-    the process mid-load, and a pooled half-dead keepalive socket would
-    blur the death signal the router's retry path depends on."""
+    the router, reached over a POOLED keep-alive transport speaking the
+    binary tensor protocol (`serving/wire.py`), with JSON as the
+    negotiation fallback.
+
+    The seed opened one TCP connection per request so that replica
+    death stayed crisp; pooling keeps the death contract crisp a
+    different way (docs/serving.md §wire protocol):
+
+    - Every pooled socket carries the pool's **generation** stamp.
+      `invalidate_pool()` (called on any transport failure, on router
+      drain, and on close) bumps the generation and closes idle
+      sockets; a request returning a socket from an older generation
+      discards it instead of re-pooling — a socket from a dead or
+      pre-drain incarnation can never serve a later request.
+    - A **stale idle socket** — the peer reaped the keep-alive, so the
+      socket shows EOF/reset BEFORE any request bytes are written — is
+      detected by a zero-timeout readability probe at checkout and
+      transparently replaced by one fresh dial. That is the only
+      transparent retry.
+    - Any failure **after bytes hit the wire** (send error, reset
+      mid-response) still raises `ReplicaGone`, exactly as
+      conn-per-request did: the router's idempotent-retry accounting
+      and the `acked == completed + failed` invariant see the same
+      crisp death signal.
+
+    Protocol negotiation: requests go out as
+    ``Content-Type: application/x-kftpu-tensor`` frames with a matching
+    Accept. A server that has never answered a frame and 4xx's the
+    first one is assumed JSON-only and the replica drops to the JSON
+    surface for good (`binary=False` forces it from the start)."""
 
     def __init__(
         self,
@@ -135,6 +165,8 @@ class HttpReplica:
         *,
         capacity: int = 256,
         timeout: float = 30.0,
+        binary: bool = True,
+        pool_size: int = 32,
     ):
         self.name = name
         host, _, port = address.rpartition(":")
@@ -142,37 +174,162 @@ class HttpReplica:
         self._model = model
         self.capacity = capacity
         self._timeout = timeout
+        self._pool_size = pool_size
+        self._pool_lock = threading.Lock()
+        self._idle: list[http.client.HTTPConnection] = []
+        self._generation = 0
+        self._dials = 0
+        self._bytes_sent = 0
+        self._bytes_received = 0
+        # Negotiation state: try frames until the server rejects one
+        # before ever accepting one. Flags are written OUTSIDE the pool
+        # lock on purpose — they are monotonic one-way latches.
+        self._binary = binary
+        self._binary_confirmed = False
 
-    def predict(self, instances) -> np.ndarray:
-        body = json.dumps(
-            {"instances": np.asarray(instances).tolist()}
-        ).encode()
-        conn = http.client.HTTPConnection(
-            self._host, self._port, timeout=self._timeout
-        )
+    # -- pooled transport --------------------------------------------------
+
+    @staticmethod
+    def _sock_idle_alive(conn) -> bool:
+        """Zero-timeout staleness probe on an idle pooled socket: a
+        readable idle HTTP connection means EOF, reset, or protocol
+        garbage — all stale. No request bytes have been written yet, so
+        discarding it is invisible to the death contract."""
+        sock = conn.sock
+        if sock is None:
+            return False
         try:
-            conn.request(
-                "POST",
-                f"/v1/models/{self._model}:predict",
-                body,
-                {"Content-Type": "application/json"},
-            )
+            readable, _, _ = select.select([sock], [], [], 0)
+        except (OSError, ValueError):
+            return False
+        return not readable
+
+    def _checkout(self) -> tuple[int, http.client.HTTPConnection]:
+        """A healthy connection + the generation it was issued under.
+        Stale idle sockets are discarded (see `_sock_idle_alive`) and
+        replaced by exactly one fresh dial."""
+        while True:
+            with self._pool_lock:
+                generation = self._generation
+                conn = self._idle.pop() if self._idle else None
+                if conn is None:
+                    self._dials += 1
+            if conn is None:
+                return generation, http.client.HTTPConnection(
+                    self._host, self._port, timeout=self._timeout
+                )
+            if self._sock_idle_alive(conn):
+                return generation, conn
+            conn.close()
+
+    def _checkin(self, generation: int, conn, resp) -> None:
+        reusable = (
+            conn.sock is not None
+            and not resp.will_close
+            and resp.isclosed()  # body fully read; framing intact
+        )
+        with self._pool_lock:
+            if (
+                reusable
+                and generation == self._generation
+                and len(self._idle) < self._pool_size
+            ):
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def _account(self, sent: int, received: int) -> None:
+        with self._pool_lock:
+            self._bytes_sent += sent
+            self._bytes_received += received
+
+    def invalidate_pool(self) -> None:
+        """Mark-dead / drain hook: bump the generation so nothing from
+        the old incarnation is ever reused, and close idle sockets."""
+        with self._pool_lock:
+            self._generation += 1
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()
+
+    def close(self) -> None:
+        self.invalidate_pool()
+
+    def transport_stats(self) -> dict:
+        """Observability for the bench and tests: dials tells you the
+        pool is actually pooling, the byte counters feed the
+        serving_wire_bytes_per_request row."""
+        with self._pool_lock:
+            return {
+                "dials": self._dials,
+                "idle": len(self._idle),
+                "generation": self._generation,
+                "bytes_sent": self._bytes_sent,
+                "bytes_received": self._bytes_received,
+            }
+
+    def _request(
+        self, method: str, path: str, body: bytes | None, headers: dict
+    ) -> tuple[int, bytes, str | None, str]:
+        """One request over the pool. Transport failure = the replica
+        is gone: invalidate the pool (no sibling thread may reuse a
+        socket into the dead incarnation) and raise `ReplicaGone`."""
+        generation, conn = self._checkout()
+        try:
+            conn.request(method, path, body or b"", headers)
             resp = conn.getresponse()
             data = resp.read()
             status = resp.status
             retry_after = resp.getheader("Retry-After")
+            content_type = resp.getheader("Content-Type") or ""
         except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            self.invalidate_pool()
             raise ReplicaGone(
                 f"replica {self.name!r} unreachable: {e}"
             ) from e
-        finally:
-            conn.close()
+        self._account(len(body or b""), len(data))
+        self._checkin(generation, conn, resp)
+        return status, data, retry_after, content_type
+
+    # -- request surface ---------------------------------------------------
+
+    def predict(self, instances) -> np.ndarray:
+        arr = np.asarray(instances)
+        use_binary = self._binary
+        if use_binary:
+            body = wire.encode_tensor(arr)
+            headers = {
+                "Content-Type": wire.TENSOR_CONTENT_TYPE,
+                "Accept": wire.TENSOR_CONTENT_TYPE,
+            }
+        else:
+            body = json.dumps({"instances": arr.tolist()}).encode()
+            headers = {
+                "Content-Type": "application/json",
+                "Accept": "application/json",
+            }
+        status, data, retry_after, content_type = self._request(
+            "POST", f"/v1/models/{self._model}:predict", body, headers
+        )
+        if (
+            use_binary
+            and not self._binary_confirmed
+            and status in (400, 415, 501)
+        ):
+            # Negotiation failure: a server that never spoke a frame
+            # rejected one — an old JSON-only surface. Fall back for
+            # good; a genuinely bad input gets the same 4xx from the
+            # JSON retry and propagates below.
+            self._binary = False
+            return self.predict(instances)
         if status == 429:
             raise ReplicaOverloaded(
                 f"replica {self.name!r} shed the request",
                 retry_after=float(retry_after or 0.05),
             )
         if status >= 500:
+            self.invalidate_pool()
             raise ReplicaGone(
                 f"replica {self.name!r} failed: HTTP {status}"
             )
@@ -181,10 +338,25 @@ class HttpReplica:
                 f"replica {self.name!r} rejected the request: "
                 f"HTTP {status}: {data[:200]!r}"
             )
+        if wire.is_tensor_request({"content-type": content_type}):
+            if use_binary:
+                self._binary_confirmed = True
+            return wire.decode_tensor(data)
         return np.asarray(json.loads(data)["predictions"])
 
     def stats(self) -> dict:
-        return {"ready": True}
+        """Honest readiness: probe ``GET /v1/models/<m>`` on the pooled
+        connection instead of hardcoding ready. A wedged-but-listening
+        worker (model never loaded, repository empty) now reports
+        not-ready into the status aggregation instead of vanishing
+        behind a hardcoded True."""
+        try:
+            status, _, _, _ = self._request(
+                "GET", f"/v1/models/{self._model}", None, {}
+            )
+        except ReplicaGone:
+            return {"ready": False}
+        return {"ready": status == 200}
 
 
 class LocalReplicaRuntime:
@@ -310,8 +482,12 @@ class ProcessReplicaRuntime:
         proc = self._procs.get(name)
         if proc is None or proc.poll() is not None:
             if proc is not None and self.router is not None:
-                # The old incarnation's endpoint is dead with it.
+                # The old incarnation's endpoint is dead with it —
+                # including any pooled keep-alive sockets into it.
+                stale = self.router.replica(name)
                 self.router.remove(name)
+                if stale is not None and hasattr(stale, "close"):
+                    stale.close()
             self._procs[name] = subprocess.Popen(
                 [
                     self._python, "-m", "kubeflow_tpu.serving",
@@ -354,8 +530,11 @@ class ProcessReplicaRuntime:
         process. The worker also exits on its own when its object is
         deleted — the SIGTERM just makes teardown prompt."""
         if self.router is not None and self.router.replica(name):
+            replica = self.router.replica(name)
             self.router.drain(name)
             self.router.remove(name)
+            if hasattr(replica, "close"):
+                replica.close()
         proc = self._procs.pop(name, None)
         if proc is None or proc.poll() is not None:
             return

@@ -102,6 +102,14 @@ class Router:
     ):
         self._cv = threading.Condition()
         self._slots: dict[str, _Slot] = {}
+        # Admission aggregates, maintained at every membership/state
+        # change instead of recomputed per dispatch: _admit_locked sits
+        # on the hot path of every request, and rebuilding the alive
+        # list plus two sums per call was measurable lock-hold time at
+        # bench concurrency (docs/perf.md §serving wire path).
+        self._alive: list[_Slot] = []
+        self._capacity = 0
+        self._outstanding = 0
         self.max_attempts = max_attempts
         self.retry_after_s = retry_after_s
         self.dispatch_timeout_s = dispatch_timeout_s
@@ -136,11 +144,13 @@ class Router:
     def add(self, replica) -> None:
         with self._cv:
             self._slots[replica.name] = _Slot(replica)
+            self._refresh_locked()
             self._cv.notify_all()
 
     def remove(self, name: str) -> None:
         with self._cv:
             self._slots.pop(name, None)
+            self._refresh_locked()
             self._cv.notify_all()
 
     def replica(self, name: str):
@@ -186,28 +196,44 @@ class Router:
 
     # -- dispatch ----------------------------------------------------------
 
+    def _refresh_locked(self) -> None:
+        """Rebuild the admission aggregates after any membership or
+        admitting/dead flip. Replica capacity is read here, once per
+        state change — a replica whose capacity attribute mutates
+        mid-flight is out of contract."""
+        self._alive = [
+            s for s in self._slots.values() if not s.dead and s.admitting
+        ]
+        self._capacity = sum(
+            max(int(s.replica.capacity), 1) for s in self._alive
+        )
+
     def _admit_locked(self, tried: set) -> "_Slot | None":
         """Admission + selection under the lock. Raises NoReadyReplicas /
         Overloaded; returns None when every eligible replica was already
-        tried this request (caller decides whether to wait and re-spread)."""
-        alive = [
-            s for s in self._slots.values() if not s.dead and s.admitting
-        ]
-        if not any(not s.dead for s in self._slots.values()):
-            raise NoReadyReplicas("no live serving replicas")
+        tried this request (caller decides whether to wait and re-spread).
+
+        `_outstanding` counts every dispatched-not-finished request,
+        including those still in flight on replicas that have since been
+        drained or removed — they hold real queue slots somewhere until
+        they finish, so the shed decision is (slightly conservatively)
+        honest about them."""
+        alive = self._alive
         if not alive:
+            if not any(not s.dead for s in self._slots.values()):
+                raise NoReadyReplicas("no live serving replicas")
             # Everything live is draining; momentary — ask for a retry.
             raise Overloaded(
                 "all replicas draining", retry_after=self.retry_after_s
             )
-        capacity = sum(max(int(s.replica.capacity), 1) for s in alive)
-        outstanding = sum(s.outstanding for s in self._slots.values())
-        if outstanding >= capacity:
+        if self._outstanding >= self._capacity:
             raise Overloaded(
-                f"fleet at capacity ({outstanding} outstanding >= "
-                f"{capacity} queue slots)",
+                f"fleet at capacity ({self._outstanding} outstanding >= "
+                f"{self._capacity} queue slots)",
                 retry_after=self.retry_after_s,
             )
+        if not tried:  # the common path builds no per-request list
+            return min(alive, key=lambda s: s.outstanding)
         candidates = [s for s in alive if s.replica.name not in tried]
         if not candidates:
             return None
@@ -215,6 +241,7 @@ class Router:
 
     def _finish_locked(self, slot: _Slot) -> None:
         slot.outstanding -= 1
+        self._outstanding -= 1
         self.outstanding_gauge.dec()
         self._cv.notify_all()
 
@@ -262,6 +289,7 @@ class Router:
                     acked = True
                     self.acked_total.inc()
                 slot.outstanding += 1
+                self._outstanding += 1
                 self.outstanding_gauge.inc()
                 name = slot.replica.name
                 replica = slot.replica
@@ -271,6 +299,7 @@ class Router:
                 with self._cv:
                     slot.dead = True
                     slot.admitting = False
+                    self._refresh_locked()
                     self._finish_locked(slot)
                 attempts += 1
                 if not idempotent or attempts >= self.max_attempts:
@@ -304,19 +333,30 @@ class Router:
         """Stop admitting to `name` and wait for its in-flight requests
         to finish (complete OR fail over to a sibling — a kill mid-drain
         converts the remainder into retries, see module docstring).
-        Returns True once outstanding hits zero within `timeout`."""
+        Returns True once outstanding hits zero within `timeout`.
+
+        A fully quiesced replica also gets its transport pool
+        invalidated (if it has one — `HttpReplica.invalidate_pool`):
+        the caller is about to swap or restart the process behind the
+        address, and a pooled keep-alive socket into the pre-drain
+        incarnation must never serve a post-roll request."""
         deadline = time.monotonic() + timeout
         with self._cv:
             slot = self._slots.get(name)
             if slot is None:
                 return True
             slot.admitting = False
+            self._refresh_locked()
             while slot.outstanding > 0:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cv.wait(remaining)
-            return True
+            replica = slot.replica
+        invalidate = getattr(replica, "invalidate_pool", None)
+        if invalidate is not None:
+            invalidate()
+        return True
 
     def admit(self, name: str) -> None:
         """Re-admit a drained (or replaced) replica. The caller vouches
@@ -327,6 +367,7 @@ class Router:
                 raise KeyError(f"unknown replica {name!r}")
             slot.admitting = True
             slot.dead = False
+            self._refresh_locked()
             self._cv.notify_all()
 
     def roll(self, name: str, swap_fn, timeout: float = 30.0) -> float:

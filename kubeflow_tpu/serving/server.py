@@ -5,6 +5,14 @@ Parity contract (`testing/test_tf_serving.py:107-118`): clients POST
 ``{"predictions": [...]}`` back; the E2E test compares predictions to a
 golden JSON within tolerance. ``GET /v1/models/<name>`` reports version
 state the way TF Serving's model-status API does.
+
+Wire negotiation (`serving/wire.py`, docs/serving.md §wire protocol):
+the same :predict route also accepts ``Content-Type:
+application/x-kftpu-tensor`` frames — decoded with ``np.frombuffer``,
+no JSON, no per-element Python objects — and answers in kind when the
+Accept header (or the request's own content type) asks for it. JSON
+requests get byte-identical JSON responses; nothing about the parity
+contract moves.
 """
 
 from __future__ import annotations
@@ -13,6 +21,7 @@ import logging
 import threading
 from typing import Iterable
 
+from kubeflow_tpu.serving import wire
 from kubeflow_tpu.serving.batching import BatchingQueue, QueueClosed, QueueFull
 from kubeflow_tpu.serving.servable import Servable
 from kubeflow_tpu.utils.metrics import MetricsRegistry
@@ -189,11 +198,16 @@ class ModelServerApp(App):
         if verb != "predict":
             raise HttpError(400, f"unsupported verb {verb!r}")
         model = self.repository.get(name, version)
-        body = req.json()
-        instances = body.get("instances")
-        if not isinstance(instances, list) or not instances:
-            self.request_count.inc(model=name, outcome="invalid")
-            raise HttpError(400, "body must have a non-empty 'instances' list")
+        if wire.is_tensor_request(req.headers):
+            instances = self._binary_instances(req, name)
+        else:
+            body = req.json()
+            instances = body.get("instances")
+            if not isinstance(instances, list) or not instances:
+                self.request_count.inc(model=name, outcome="invalid")
+                raise HttpError(
+                    400, "body must have a non-empty 'instances' list"
+                )
         try:
             try:
                 predictions = self._predictor(model)(instances)
@@ -229,7 +243,32 @@ class ModelServerApp(App):
             log.info("predict on %s rejected: %s", name, e)
             raise HttpError(400, f"bad instances: {e}") from None
         self.request_count.inc(model=name, outcome="ok")
+        if wire.wants_tensor_response(req.headers):
+            return self._binary_prediction_response(predictions)
         return json_response({"predictions": predictions.tolist()})
+
+    def _binary_instances(self, req: Request, name: str):
+        """Decode a tensor-framed request body. The returned array is a
+        read-only view over the request bytes — downstream (batching
+        concat, device put) copies, nothing mutates in place."""
+        try:
+            arr = wire.decode_tensor(req.body)
+        except wire.WireFormatError as e:
+            self.request_count.inc(model=name, outcome="invalid")
+            raise HttpError(400, f"bad tensor frame: {e}") from None
+        if arr.ndim < 1 or arr.shape[0] < 1:
+            self.request_count.inc(model=name, outcome="invalid")
+            raise HttpError(
+                400, "tensor batch needs a non-empty leading dimension"
+            )
+        return arr
+
+    @staticmethod
+    def _binary_prediction_response(predictions) -> Response:
+        return Response(
+            body=wire.encode_tensor(predictions),
+            content_type=wire.TENSOR_CONTENT_TYPE,
+        )
 
     def _retry_after(self) -> str:
         timeout_ms = getattr(self._batching, "timeout_ms", 0.0) or 0.0

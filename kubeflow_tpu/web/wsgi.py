@@ -348,6 +348,10 @@ class _Http11Handler(http.server.BaseHTTPRequestHandler):
     loops on `handle_one_request` until the peer closes or idles out."""
 
     protocol_version = "HTTP/1.1"
+    # TCP_NODELAY (StreamRequestHandler honors this): predict responses
+    # are small writes on a persistent connection, and Nagle + delayed
+    # ACK turns each into a ~40ms stall on the reply leg.
+    disable_nagle_algorithm = True
     # One knob, two jobs: reaps idle keep-alive connections (the blocking
     # readline for the next request times out) and caps a stalled
     # client's grip on its thread. Streaming responses emit bookmarks
@@ -574,17 +578,22 @@ class TestClient:
         path: str,
         body: dict | None = None,
         headers: dict[str, str] | None = None,
+        raw: bytes | None = None,
+        content_type: str = "application/json",
     ) -> Response:
+        """`body` is a JSON object; `raw` posts bytes verbatim with
+        `content_type` (the binary tensor wire surface in tests)."""
         import io
 
         path, _, query = path.partition("?")
-        raw = json.dumps(body).encode() if body is not None else b""
+        if raw is None:
+            raw = json.dumps(body).encode() if body is not None else b""
         environ = {
             "REQUEST_METHOD": method,
             "PATH_INFO": path,
             "QUERY_STRING": query,
             "CONTENT_LENGTH": str(len(raw)),
-            "CONTENT_TYPE": "application/json",
+            "CONTENT_TYPE": content_type,
             "wsgi.input": io.BytesIO(raw),
         }
         for key, value in {**self.headers, **(headers or {})}.items():

@@ -506,6 +506,169 @@ def test_queue_full_maps_to_429_with_retry_after():
         app.close_batchers()
 
 
+def test_admit_late_keeps_mismatched_pending_in_order():
+    """`_admit_late` pulls ONLY signature-compatible entries; everything
+    else must stay pending IN ARRIVAL ORDER, or the next cut would stop
+    honoring the oldest caller's timeout deadline."""
+    model = CountingServable()
+    # Huge window so submitted entries sit pending while the test drives
+    # the admission scan directly.
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=8, timeout_ms=10_000.0)
+    )
+    try:
+        inputs = [
+            np.full((1, 4), 1.0),  # mismatch, arrived first
+            np.full((1, 3), 2.0),  # the only width-3 entry
+            np.full((1, 4), 3.0),  # mismatch, arrived last
+        ]
+        results = [None] * 3
+        threads = []
+        for i, x in enumerate(inputs):
+            t = threading.Thread(
+                target=lambda i=i, x=x: results.__setitem__(
+                    i, queue.predict(x)
+                )
+            )
+            t.start()
+            threads.append(t)
+            deadline = time.monotonic() + 5
+            while (
+                queue._pending_count < i + 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+
+        taken = queue._admit_late(((3,), "<f8"), 0)
+        assert [e.instances.shape for e in taken] == [(1, 3)]
+        with queue._cv:
+            kept = [float(e.instances[0, 0]) for e in queue._pending]
+            assert kept == [1.0, 3.0]  # arrival order survived the scan
+            assert queue._pending_count == 2
+            assert taken[0] in queue._inflight  # kill() coverage moved too
+        # Complete the admitted caller the way _run_group would, then let
+        # close() drain the two kept entries through a normal flush.
+        taken[0].result = taken[0].instances * 2.0
+        taken[0].event.set()
+        queue.close()
+        for t in threads:
+            t.join(timeout=10)
+        for x, out in zip(inputs, results):
+            np.testing.assert_array_equal(out, x * 2.0)
+    finally:
+        queue.close()
+
+
+def test_admit_late_updates_queue_wait_ewma():
+    """Late-admitted entries must feed the queue-wait EWMA the same way
+    cut entries do — the autoscaler reads stats()['queue_wait_ms'], and
+    a continuous-batching replica whose admissions all ride the late
+    path would otherwise report zero wait forever."""
+    model = CountingServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=8, timeout_ms=10_000.0)
+    )
+    try:
+        holder = [None]
+        t = threading.Thread(
+            target=lambda: holder.__setitem__(
+                0, queue.predict(np.ones((1, 3)))
+            )
+        )
+        t.start()
+        deadline = time.monotonic() + 5
+        while queue._pending_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert queue.stats()["queue_wait_ms"] == 0.0
+        time.sleep(0.03)  # accrue measurable queue wait
+        taken = queue._admit_late(((3,), "<f8"), 0)
+        assert len(taken) == 1
+        assert queue.stats()["queue_wait_ms"] > 0.0
+        taken[0].result = taken[0].instances * 2.0
+        taken[0].event.set()
+        t.join(timeout=10)
+        np.testing.assert_array_equal(holder[0], np.ones((1, 3)) * 2.0)
+    finally:
+        queue.close()
+
+
+def test_kill_racing_late_admission_strands_no_caller():
+    """A late-admitted entry is in-flight from the moment it leaves
+    pending; a kill() landing while its flush executes must fail it like
+    any other in-flight caller — never leave it parked on an event
+    nobody will set."""
+    from kubeflow_tpu.serving.batching import QueueClosed
+
+    class TwoGateServable(CountingServable):
+        """Gates BOTH signatures so the test controls exactly when the
+        late-admitting width-3 group starts and blocks."""
+
+        def __init__(self):
+            super().__init__()
+            self.gates = {2: threading.Event(), 3: threading.Event()}
+            self.shapes: list[tuple] = []
+
+        def predict(self, instances):
+            batch = np.asarray(instances)
+            with self._lock:
+                self.shapes.append(batch.shape)
+            gate = self.gates.get(batch.shape[1])
+            if gate is not None:
+                gate.wait(10)
+            return batch * 2.0
+
+    model = TwoGateServable()
+    queue = BatchingQueue(
+        model, BatchingConfig(max_batch=2, timeout_ms=2000.0)
+    )
+    results, errors = [None] * 3, [None] * 3
+
+    def call(i, x):
+        try:
+            results[i] = queue.predict(x)
+        except BaseException as e:
+            errors[i] = e
+
+    try:
+        deadline = time.monotonic() + 5
+        t_x = threading.Thread(target=call, args=(0, np.ones((1, 2))))
+        t_x.start()
+        while queue._pending_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t_y1 = threading.Thread(target=call, args=(1, np.ones((1, 3))))
+        t_y1.start()  # rows hit max_batch -> cut {x, y1}
+        while (
+            not any(s[1] == 2 for s in model.shapes)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        # Width-2 group is executing (gated); y2 arrives post-cut and
+        # will be admitted late by the width-3 group.
+        t_y2 = threading.Thread(target=call, args=(2, np.ones((1, 3))))
+        t_y2.start()
+        while queue._pending_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        model.gates[2].set()  # width-3 group now admits y2 and executes
+        while (
+            (2, 3) not in model.shapes and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        assert (2, 3) in model.shapes, model.shapes
+
+        queue.kill()  # lands while the late-admitted flush is gated
+        model.gates[3].set()
+        for t in (t_x, t_y1, t_y2):
+            t.join(timeout=10)
+            assert not t.is_alive()  # the stranding regression
+        np.testing.assert_array_equal(results[0], np.ones((1, 2)) * 2.0)
+        assert isinstance(errors[1], QueueClosed), errors
+        assert isinstance(errors[2], QueueClosed), errors
+    finally:
+        for gate in model.gates.values():
+            gate.set()
+        queue.close()
+
+
 def test_unload_prunes_stale_queue():
     """An unloaded version's queue must not pin its weights + scheduler
     thread forever — the next predict prunes it."""

@@ -1,8 +1,9 @@
 """CI smoke for `bench.py --workload serving --serving-dataplane-only`
 (ISSUE 11): the multi-replica data-plane bench must run end-to-end at
-tiny scale — steady latency, overload goodput, the drain-based roll, and
-the replica-kill chaos gate — and every headline row must resolve a real
-vs_baseline ratio against BASELINE.json's published serving_* entries."""
+tiny scale — steady latency, overload goodput, the drain-based roll,
+the binary-wire phase (ISSUE 15), and the replica-kill chaos gate — and
+every headline row must resolve a real vs_baseline ratio against
+BASELINE.json's published serving_* entries."""
 
 import json
 import os
@@ -53,6 +54,15 @@ def test_serving_dataplane_bench_smoke_rows_resolve_baseline():
     ):
         assert name in by_name, (name, sorted(by_name))
         assert by_name[name]["vs_baseline"] is not None, by_name[name]
+
+    # The wire row (ISSUE 15) resolves against the published JSON-path
+    # bytes, so vs_baseline IS the binary/JSON ratio — and the bench
+    # itself hard-fails above the 0.35x gate, so a resolving row means
+    # the gate was actually evaluated.
+    wire = by_name["serving_wire_bytes_per_request"]
+    assert wire["vs_baseline"] is not None, wire
+    assert wire["vs_baseline"] <= 0.35, wire
+    assert "# serving wire:" in result.stderr
 
     # The chaos gate ran (nonzero exit would have tripped above) and
     # published its acked-request count; it is a gate, not a ratio.

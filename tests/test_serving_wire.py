@@ -1,0 +1,355 @@
+"""Binary tensor wire protocol + pooled keep-alive transport (ISSUE 15).
+
+Three layers under test:
+
+- `serving/wire.py` framing: roundtrip across dtypes/shapes, rejection
+  of corrupt frames, endianness normalization.
+- Server negotiation (`ModelServerApp`): tensor-framed requests decode
+  without JSON, responses answer in the negotiated format, and the JSON
+  surface stays byte-identical for TF-Serving parity clients.
+- `HttpReplica` transport: the keep-alive pool actually pools, a stale
+  idle socket is silently replaced (pre-write only), a failure after
+  bytes hit the wire still raises ReplicaGone and invalidates the pool
+  (the crisp-death contract the router's retry accounting needs), and a
+  JSON-only server triggers the sticky negotiation fallback.
+"""
+
+import http.client
+import socket
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.resnet import tiny_resnet
+from kubeflow_tpu.serving import (
+    ModelRepository,
+    ModelServerApp,
+    ReplicaGone,
+    Router,
+    Servable,
+)
+from kubeflow_tpu.serving import wire
+from kubeflow_tpu.serving.replica import HttpReplica
+from kubeflow_tpu.web import App, HttpError, TestClient, json_response
+from kubeflow_tpu.web.wsgi import _Http11Handler, serve
+
+
+# -- framing -----------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "arr",
+    [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(6, dtype=np.int8).reshape(2, 3, 1),
+        np.array([[True, False]]),
+        np.arange(4, dtype=np.float16).reshape(4, 1),
+        np.float64(3.5),  # scalar: empty dims segment
+        np.zeros((0, 7), np.float32),  # empty batch still frames
+    ],
+)
+def test_roundtrip(arr):
+    out = wire.decode_tensor(wire.encode_tensor(arr))
+    assert out.dtype == np.asarray(arr).dtype.newbyteorder("=")
+    assert out.shape == np.asarray(arr).shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_decoded_view_is_readonly_over_frame():
+    frame = wire.encode_tensor(np.arange(4, dtype=np.float32))
+    out = wire.decode_tensor(frame)
+    assert not out.flags.writeable  # frombuffer view, copy to mutate
+
+
+def test_big_endian_normalized():
+    be = np.arange(3, dtype=">f4")
+    out = wire.decode_tensor(wire.encode_tensor(be))
+    assert out.dtype.byteorder in ("<", "=")
+    np.testing.assert_array_equal(out, be.astype("<f4"))
+
+
+def test_object_dtype_refused():
+    with pytest.raises(wire.WireFormatError):
+        wire.encode_tensor(np.array([object()]))
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        b"",
+        b"KFT",
+        b"NOPE" + b"\x00" * 20,
+        b"KFT1\xff\xff\xff\xff",  # header length > _MAX_HEADER
+        b"KFT1\x10\x00\x00\x00<f4:",  # truncated header
+        wire.encode_tensor(np.zeros(4, np.float32))[:-3],  # short payload
+        wire.encode_tensor(np.zeros(4, np.float32)) + b"xx",  # long payload
+        b"KFT1\x07\x00\x00\x00<f4:a,b",  # non-integer dims
+        b"KFT1\x06\x00\x00\x00nope:1",  # unknown dtype
+    ],
+)
+def test_corrupt_frames_refused(data):
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_tensor(data)
+
+
+def test_negotiation_helpers():
+    tensor, js = wire.TENSOR_CONTENT_TYPE, "application/json"
+    assert wire.is_tensor_request({"content-type": tensor})
+    assert wire.is_tensor_request({"content-type": f"{tensor}; q=1"})
+    assert not wire.is_tensor_request({"content-type": js})
+    assert not wire.is_tensor_request({})
+    # Accept tensor wins; explicit JSON Accept loses; no Accept follows
+    # the request's own content type.
+    assert wire.wants_tensor_response({"accept": tensor})
+    assert not wire.wants_tensor_response(
+        {"accept": js, "content-type": tensor}
+    )
+    assert wire.wants_tensor_response({"content-type": tensor})
+    assert not wire.wants_tensor_response({"content-type": js})
+
+
+# -- server negotiation ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    module = tiny_resnet(num_classes=10)
+    variables = jax.jit(module.init)(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32)
+    )
+    return module, variables
+
+
+@pytest.fixture(scope="module")
+def app(model):
+    module, variables = model
+    servable = Servable.from_module(
+        "mnist", module, variables, max_batch=8, train=False
+    )
+    return ModelServerApp(ModelRepository([servable]))
+
+
+@pytest.fixture(scope="module")
+def client(app):
+    return TestClient(app)
+
+
+def _batch(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(n, 32, 32, 3).astype(np.float32)
+
+
+def test_binary_predict_matches_json(client):
+    x = _batch(3)
+    json_resp = client.post(
+        "/v1/models/mnist:predict", {"instances": x.tolist()}
+    )
+    assert json_resp.status == 200
+    bin_resp = client.post(
+        "/v1/models/mnist:predict",
+        raw=wire.encode_tensor(x),
+        content_type=wire.TENSOR_CONTENT_TYPE,
+        headers={"Accept": wire.TENSOR_CONTENT_TYPE},
+    )
+    assert bin_resp.status == 200, bin_resp.body
+    assert bin_resp.content_type == wire.TENSOR_CONTENT_TYPE
+    got = wire.decode_tensor(bin_resp.body)
+    assert got.shape == (3, 10)
+    np.testing.assert_allclose(
+        got, np.asarray(json_resp.json()["predictions"]), atol=1e-3
+    )
+
+
+def test_binary_request_json_accept_gets_json(client):
+    resp = client.post(
+        "/v1/models/mnist:predict",
+        raw=wire.encode_tensor(_batch(1)),
+        content_type=wire.TENSOR_CONTENT_TYPE,
+        headers={"Accept": "application/json"},
+    )
+    assert resp.status == 200
+    assert np.asarray(resp.json()["predictions"]).shape == (1, 10)
+
+
+def test_json_request_tensor_accept_gets_frame(client):
+    resp = client.post(
+        "/v1/models/mnist:predict",
+        {"instances": _batch(1).tolist()},
+        headers={"Accept": wire.TENSOR_CONTENT_TYPE},
+    )
+    assert resp.status == 200
+    assert wire.decode_tensor(resp.body).shape == (1, 10)
+
+
+def test_json_surface_unchanged(client):
+    """TF-Serving parity: a plain JSON request gets the same envelope
+    as before the protocol landed — application/json, predictions key."""
+    resp = client.post(
+        "/v1/models/mnist:predict", {"instances": _batch(1).tolist()}
+    )
+    assert resp.status == 200
+    assert resp.content_type == "application/json"
+    assert set(resp.json()) == {"predictions"}
+
+
+def test_bad_frame_is_400(client):
+    resp = client.post(
+        "/v1/models/mnist:predict",
+        raw=b"KFT1 this is not a frame",
+        content_type=wire.TENSOR_CONTENT_TYPE,
+    )
+    assert resp.status == 400
+
+
+def test_scalar_frame_is_400(client):
+    resp = client.post(
+        "/v1/models/mnist:predict",
+        raw=wire.encode_tensor(np.float32(1.0)),
+        content_type=wire.TENSOR_CONTENT_TYPE,
+    )
+    assert resp.status == 400  # no leading batch dimension
+
+
+# -- pooled transport over a real server -------------------------------------
+
+
+@pytest.fixture()
+def live_server(app):
+    server, thread = serve(app, host="127.0.0.1", port=0)
+    try:
+        yield f"127.0.0.1:{server.server_port}"
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+
+
+def test_pool_reuses_one_connection(live_server):
+    replica = HttpReplica("r", live_server, "mnist")
+    x = _batch(1)
+    for _ in range(10):
+        out = replica.predict(x)
+        assert out.shape == (1, 10)
+    stats = replica.transport_stats()
+    assert stats["dials"] == 1, stats  # conn-per-request would dial 10x
+    assert replica._binary_confirmed  # frames negotiated, not JSON
+    replica.close()
+
+
+def test_stale_idle_socket_replaced_prewrite(live_server):
+    """An idle pooled socket the peer closed (readable EOF before any
+    request bytes) is silently discarded and redialed — NOT surfaced as
+    ReplicaGone, because nothing was ever sent on it."""
+    replica = HttpReplica("r", live_server, "mnist")
+    a, b = socket.socketpair()
+    dead = http.client.HTTPConnection("127.0.0.1", 1)
+    dead.sock = a
+    b.close()  # EOF pending on a -> checkout must reject it
+    with replica._pool_lock:
+        replica._idle.append(dead)
+    out = replica.predict(_batch(1))
+    assert out.shape == (1, 10)
+    assert replica.transport_stats()["generation"] == 0  # no death signal
+    replica.close()
+
+
+def test_failure_after_bytes_is_replica_gone():
+    """A peer that accepts, reads, and resets mid-exchange is a dead
+    replica: ReplicaGone (no transparent retry), pool invalidated."""
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    port = lst.getsockname()[1]
+
+    def acceptor():
+        try:
+            while True:
+                conn, _ = lst.accept()
+                conn.close()  # reset after the request is written
+        except OSError:
+            pass
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    replica = HttpReplica("r", f"127.0.0.1:{port}", "mnist", timeout=5.0)
+    try:
+        with pytest.raises(ReplicaGone):
+            replica.predict(_batch(1))
+        assert replica.transport_stats()["generation"] >= 1
+        assert replica.transport_stats()["idle"] == 0
+    finally:
+        lst.close()
+        replica.close()
+
+
+def test_stats_probes_model_state(live_server):
+    assert HttpReplica("r", live_server, "mnist").stats() == {
+        "ready": True
+    }
+    # Listening but not serving this model: wedged, not ready — the
+    # seed hardcoded {"ready": True} here.
+    assert HttpReplica("r", live_server, "absent").stats() == {
+        "ready": False
+    }
+
+
+def test_stats_dead_endpoint_not_ready():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    replica = HttpReplica("r", f"127.0.0.1:{port}", "mnist", timeout=2.0)
+    assert replica.stats() == {"ready": False}
+
+
+def test_json_only_server_sticky_fallback():
+    """A server that 4xx's tensor frames (the pre-protocol surface) is
+    detected on the first exchange; the replica drops to JSON for good
+    and the request still succeeds."""
+
+    legacy = App("legacy-model-server")
+
+    @legacy.route("/v1/models/<name>", methods=("POST",))
+    def old_predict(req):
+        if "json" not in (req.headers.get("content-type") or ""):
+            raise HttpError(400, "expected JSON")
+        n = len(req.json()["instances"])
+        return json_response({"predictions": [[0.0]] * n})
+
+    server, thread = serve(legacy, host="127.0.0.1", port=0)
+    try:
+        replica = HttpReplica(
+            "r", f"127.0.0.1:{server.server_port}", "mnist:predict"
+        )
+        out = replica.predict(_batch(2))
+        assert out.shape == (2, 1)
+        assert replica._binary is False  # sticky: no frame retry per call
+        replica.close()
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+
+
+def test_router_drain_invalidates_pool():
+    calls = []
+
+    class FakeReplica:
+        name, capacity = "f", 4
+
+        def predict(self, x):
+            return np.asarray(x)
+
+        def invalidate_pool(self):
+            calls.append("invalidate")
+
+    router = Router()
+    router.add(FakeReplica())
+    assert router.drain("f")
+    assert calls == ["invalidate"]
+
+
+def test_wsgi_handler_disables_nagle():
+    # StreamRequestHandler applies TCP_NODELAY from this class attr;
+    # small predict responses must not eat Nagle/delayed-ACK stalls.
+    assert _Http11Handler.disable_nagle_algorithm is True
