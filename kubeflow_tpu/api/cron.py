@@ -144,10 +144,17 @@ class CronSchedule:
             for h in hours:
                 for m in minutes:
                     for isdst in (1, 0):
-                        cand = time.mktime(
-                            (ptm.tm_year, ptm.tm_mon, ptm.tm_mday, h, m,
-                             0, 0, 0, isdst)
-                        )
+                        try:
+                            cand = time.mktime(
+                                (ptm.tm_year, ptm.tm_mon, ptm.tm_mday,
+                                 h, m, 0, 0, 0, isdst)
+                            )
+                        except (OverflowError, ValueError):
+                            # A zone with no DST at all (TZ=UTC — every
+                            # CI container) has no isdst=1 reading of
+                            # any wall time; glibc signals that with
+                            # OverflowError rather than normalizing.
+                            continue
                         if cand > t and self.matches(cand):
                             if best is None or cand < best:
                                 best = cand

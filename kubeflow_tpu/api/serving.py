@@ -108,6 +108,74 @@ class AutoscaleSpec:
         return max(self.min_replicas, min(self.max_replicas, want))
 
 
+# Priority classes a CR may assign to a model (the admission ladder in
+# `serving/admission.DEFAULT_PRIORITIES`). Kept as a literal so the API
+# layer does not import the serving package.
+KNOWN_PRIORITY_CLASSES = ("critical", "standard", "batch")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    """One servable on a multiplexed fleet (``spec.models[*]``).
+
+    Per-model knobs: its own version (rolls are per-model), its own
+    checkpoint dir, the priority class its traffic defaults to, and a
+    token-bucket quota (``quotaRate``/``quotaBurst``) the admission
+    controller charges the model's tenants against. ``quotaRate`` 0 =
+    uncapped."""
+
+    name: str = "model"
+    model_version: int = 0
+    checkpoint_dir: str = ""
+    priority: str = "standard"
+    quota_rate: float = 0.0
+    quota_burst: float = 1.0
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("models[].name must be non-empty")
+        if self.model_version < 0:
+            raise ValueError("models[].modelVersion must be >= 0")
+        if self.priority not in KNOWN_PRIORITY_CLASSES:
+            raise ValueError(
+                f"models[].priority must be one of "
+                f"{list(KNOWN_PRIORITY_CLASSES)}, got {self.priority!r}"
+            )
+        if self.quota_rate < 0:
+            raise ValueError("models[].quotaRate must be >= 0")
+        if self.quota_burst < 1:
+            raise ValueError("models[].quotaBurst must be >= 1")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "modelVersion": self.model_version,
+            "checkpointDir": self.checkpoint_dir,
+            "priority": self.priority,
+            "quotaRate": self.quota_rate,
+            "quotaBurst": self.quota_burst,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ModelEntry":
+        unknown = set(d) - KNOWN_MODEL_FIELDS
+        if unknown:
+            raise ValueError(
+                f"unknown spec.models field(s) {sorted(unknown)}; "
+                f"known: {sorted(KNOWN_MODEL_FIELDS)}"
+            )
+        entry = cls(
+            name=d.get("name", "model"),
+            model_version=int(d.get("modelVersion", 0)),
+            checkpoint_dir=d.get("checkpointDir", ""),
+            priority=d.get("priority", "standard"),
+            quota_rate=float(d.get("quotaRate", 0.0)),
+            quota_burst=float(d.get("quotaBurst", 1.0)),
+        )
+        entry.validate()
+        return entry
+
+
 @dataclasses.dataclass(frozen=True)
 class ServingDeploymentSpec:
     """Typed view over a ServingDeployment's spec dict."""
@@ -135,10 +203,32 @@ class ServingDeploymentSpec:
     # self-roll on config push.
     runtime: str = "local"
     autoscale: AutoscaleSpec | None = None
+    # Multiplexing (ISSUE 17): N servables on one replica fleet. Empty =
+    # the original single-model deployment (spec.model/.checkpointDir/
+    # .modelVersion). Non-empty = every replica hosts a ServableRegistry
+    # over these entries and spec.model only names the deployment's
+    # default servable for clients that don't say which model they want.
+    models: tuple[ModelEntry, ...] = ()
+    # LRU weight paging: how many of `models` may hold device-resident
+    # weights per replica at once. 0 = unlimited (everything stays
+    # resident once touched). Ignored for single-model deployments.
+    max_resident: int = 0
 
     def validate(self) -> None:
         if not self.model:
             raise ValueError("model name must be non-empty")
+        if self.max_resident < 0:
+            raise ValueError(
+                f"paging.maxResident must be >= 0, got {self.max_resident}"
+            )
+        if self.models:
+            names = [m.name for m in self.models]
+            if len(set(names)) != len(names):
+                raise ValueError(
+                    f"models[].name entries must be unique, got {names}"
+                )
+            for m in self.models:
+                m.validate()
         if self.runtime not in ("local", "process"):
             raise ValueError(
                 f"runtime must be 'local' or 'process', got {self.runtime!r}"
@@ -169,6 +259,10 @@ class ServingDeploymentSpec:
             "checkpointDir": self.checkpoint_dir,
             "modelVersion": self.model_version,
             "runtime": self.runtime,
+            # Always emitted (even when unset) so KNOWN_FIELDS, derived
+            # from this serializer, admits them on the way back in.
+            "models": [m.to_dict() for m in self.models],
+            "paging": {"maxResident": self.max_resident},
             "autoscale": (
                 {
                     "minReplicas": self.autoscale.min_replicas,
@@ -234,7 +328,27 @@ class ServingDeploymentSpec:
                     autoscale_d.get("scaleDownStabilizationSeconds", 0.0)
                 ),
             )
+        models_d = d.get("models") or []
+        if not isinstance(models_d, list):
+            raise ValueError(
+                f"spec.models must be a list of model entries, got "
+                f"{models_d!r}"
+            )
+        paging_d = d.get("paging") or {}
+        if not isinstance(paging_d, dict):
+            raise ValueError(
+                f"spec.paging must be a mapping (maxResident), got "
+                f"{paging_d!r}"
+            )
+        unknown_p = set(paging_d) - KNOWN_PAGING_FIELDS
+        if unknown_p:
+            raise ValueError(
+                f"unknown spec.paging field(s) {sorted(unknown_p)}; "
+                f"known: {sorted(KNOWN_PAGING_FIELDS)}"
+            )
         spec = cls(
+            models=tuple(ModelEntry.from_dict(m) for m in models_d),
+            max_resident=int(paging_d.get("maxResident", 0)),
             model=d.get("model", "model"),
             replicas=int(d.get("replicas", 1)),
             max_batch=int(d.get("maxBatch", 64)),
@@ -260,6 +374,10 @@ KNOWN_AUTOSCALE_FIELDS = frozenset(("minReplicas", "maxReplicas",
                                     "targetQueueDepth",
                                     "targetLatencyMs",
                                     "scaleDownStabilizationSeconds"))
+KNOWN_MODEL_FIELDS = frozenset(ModelEntry().to_dict())
+KNOWN_PAGING_FIELDS = frozenset(
+    ServingDeploymentSpec().to_dict()["paging"]
+)
 
 
 def replica_name(deployment: str, index: int) -> str:
@@ -271,7 +389,7 @@ def replica_spec(spec: ServingDeploymentSpec) -> dict[str, Any]:
     ServingReplica object (the PR 2 watch machinery is the transport:
     the replica worker watches its own object and reacts to spec
     changes — model rolls, batching re-tunes — without re-listing)."""
-    return {
+    out: dict[str, Any] = {
         "model": spec.model,
         "maxBatch": spec.max_batch,
         "batching": {
@@ -282,6 +400,10 @@ def replica_spec(spec: ServingDeploymentSpec) -> dict[str, Any]:
         "checkpointDir": spec.checkpoint_dir,
         "modelVersion": spec.model_version,
     }
+    if spec.models:
+        out["models"] = [m.to_dict() for m in spec.models]
+        out["paging"] = {"maxResident": spec.max_resident}
+    return out
 
 
 def make_serving_deployment(
@@ -290,6 +412,13 @@ def make_serving_deployment(
     autoscale = spec_kwargs.pop("autoscale", None)
     if isinstance(autoscale, dict):
         autoscale = AutoscaleSpec(**autoscale)
-    spec = ServingDeploymentSpec(autoscale=autoscale, **spec_kwargs)
+    models = spec_kwargs.pop("models", ())
+    models = tuple(
+        ModelEntry.from_dict(m) if isinstance(m, dict) else m
+        for m in models
+    )
+    spec = ServingDeploymentSpec(
+        autoscale=autoscale, models=models, **spec_kwargs
+    )
     spec.validate()
     return new_resource(KIND, name, namespace, spec=spec.to_dict())

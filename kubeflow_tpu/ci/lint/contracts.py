@@ -531,6 +531,85 @@ def _build_serving_batch_continuous() -> Program:
     )
 
 
+def _build_serving_multiplex_registry() -> Program:
+    """The per-model queue path (ISSUE 17): a multiplexed replica runs
+    the SAME bucket program as a single-model one — the registry only
+    routes to a per-model `BatchingQueue`, so zero collectives may
+    appear, and the registry's hot path (predict → `_resident_queue` →
+    `_page_in` → LRU eviction) must stay free of host sync. A
+    `block_until_ready` in `_page_in` would stall every model behind a
+    cold one's weight load; one in `predict` would fence every request
+    on device completion."""
+    import ast as ast_mod
+    import pathlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.serving import registry as registry_mod
+    from kubeflow_tpu.serving.batching import BatchingConfig
+    from kubeflow_tpu.serving.registry import PagingConfig, ServableRegistry
+    from kubeflow_tpu.serving.servable import Servable
+    from kubeflow_tpu.testing.hlo import compiled_hlo
+    from kubeflow_tpu.testing.tinymodels import TinyMLP
+
+    hot_fns = {
+        "predict", "_resident_queue", "_page_in", "_claim_load_locked",
+        "_evict_locked", "_demote_locked",
+    }
+    tree = ast_mod.parse(
+        pathlib.Path(registry_mod.__file__).read_text()
+    )
+    found: set = set()
+    syncs: list[str] = []
+    for node in ast_mod.walk(tree):
+        if (
+            isinstance(node, ast_mod.FunctionDef)
+            and node.name in hot_fns
+        ):
+            found.add(node.name)
+            for sub in ast_mod.walk(node):
+                if isinstance(sub, ast_mod.Attribute) and sub.attr in (
+                    "block_until_ready", "device_get", "device_put",
+                ):
+                    syncs.append(f"{node.name}: .{sub.attr}")
+                if isinstance(sub, ast_mod.Name) and sub.id == "jax":
+                    syncs.append(f"{node.name}: jax")
+
+    # The bucket program a paged-in model executes — built through the
+    # registry's own factory path, so the HLO is the one the per-model
+    # queue actually flushes.
+    model = TinyMLP()
+    x = jnp.zeros((4, 8, 8, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    def factory(rspec: dict) -> Servable:
+        return Servable(
+            name=rspec["model"], apply_fn=model.apply,
+            variables=variables, max_batch=4,
+        )
+
+    reg = ServableRegistry(
+        factory,
+        batching=BatchingConfig(max_batch=4, timeout_ms=2.0),
+        paging=PagingConfig(max_resident=1),
+    )
+    try:
+        reg.ensure({"model": "contract-mux"})
+        reg.predict("contract-mux", x[:1])  # page-in + one flush
+        sv = reg._entries["contract-mux"].queue.servable
+        hlo = compiled_hlo(sv._jitted, sv.variables, x)
+    finally:
+        reg.close()
+    return Program(
+        hlo=hlo,
+        meta={
+            "no_host_sync_in_registry": not syncs and found == hot_fns,
+            "host_syncs": syncs,
+        },
+    )
+
+
 def _build_rl_learner_step() -> Program:
     """The RL learner is the stock Trainer on a dp mesh (ISSUE 12):
     its compiled step must be indistinguishable from any other dp train
@@ -711,6 +790,17 @@ CONTRACTS: tuple[ProgramContract, ...] = (
             "collective-permute", "all-to-all",
         ),
         meta_true=("binary_wire_clean",),
+    ),
+    ProgramContract(
+        name="serving-multiplex",
+        description="per-model queue path: same zero-collective bucket "
+        "program; registry hot path free of host sync",
+        build=_build_serving_multiplex_registry,
+        forbid_collectives=(
+            "all-gather", "reduce-scatter", "all-reduce",
+            "collective-permute", "all-to-all",
+        ),
+        meta_true=("no_host_sync_in_registry",),
     ),
     ProgramContract(
         name="rl-learner-step",

@@ -729,6 +729,11 @@ class EndpointListClients(Rule):
         "kubeflow_tpu/deploy/worker.py",
         "kubeflow_tpu/serving/__main__.py",
         "kubeflow_tpu/sidecar/__main__.py",
+        # The open-loop load worker (ISSUE 17): its target spec carries
+        # the address it fires at; an HttpApiClient built here from
+        # that config must parse the endpoint list or every worker
+        # stalls when the active facade dies mid-run.
+        "kubeflow_tpu/testing/loadgen.py",
     )
 
     def applies(self, relpath: str) -> bool:

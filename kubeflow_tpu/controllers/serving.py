@@ -244,6 +244,15 @@ class ServingDeploymentController:
         rspec = serving_api.replica_spec(spec)
         runtime = self._runtime_for(spec)
 
+        # Catalog admission policy (models[].priority/quotaRate) lives
+        # on the router, not in any replica — push it on every
+        # reconcile so spec edits (and model removals) take effect
+        # without a roll. Runtimes without a router (process fleets
+        # report through status) simply don't expose the hook.
+        apply_policy = getattr(runtime, "apply_model_policy", None)
+        if apply_policy is not None:
+            apply_policy(spec.models)
+
         # Autoscale on the observed fleet signals: queue depth (queued +
         # already executing — both represent demand a bigger fleet would
         # absorb) and the rolling p99 of per-replica queue wait.
@@ -305,12 +314,29 @@ class ServingDeploymentController:
         # while EVERY other replica is ready — the fleet keeps admitting
         # during the whole roll (zero downtime). Process replicas have
         # no runtime roll surface: their workers self-roll on the config
-        # push above.
-        if spec.model_version > 0:
+        # push above. Multiplexed fleets roll per model: only replicas
+        # holding a RESIDENT copy of an outdated model drain (non-
+        # resident copies pick up the new version on their next page-in
+        # for free).
+        if spec.model_version > 0 or any(
+            m.model_version > 0 for m in spec.models
+        ):
             self._roll_outdated(api, dep, spec, desired, rspec, runtime)
 
         # Status: per-replica readiness (stamped onto the replica objects
         # too — the kubectl surface) aggregated onto the deployment.
+        # Multiplexed fleets additionally aggregate per-model rows
+        # (resident replica count, max live version, page-in totals)
+        # so `kubectl get` answers "is model X up" per model.
+        models_agg: dict[str, dict] = {
+            m.name: {
+                "name": m.name,
+                "residentReplicas": 0,
+                "version": 0,
+                "pageIns": 0,
+            }
+            for m in spec.models
+        }
         rows = []
         ready_count = 0
         for rname in desired:
@@ -324,6 +350,20 @@ class ServingDeploymentController:
                     "queueDepth": int(stats.get("queue_depth") or 0),
                     "inflight": int(stats.get("inflight") or 0),
                 }
+                model_rows = stats.get("models")
+                if model_rows:
+                    row["resident"] = int(stats.get("resident") or 0)
+                    for mname, mrow in model_rows.items():
+                        slot = models_agg.get(mname)
+                        if slot is None:
+                            continue
+                        slot["pageIns"] += int(mrow.get("page_ins") or 0)
+                        if mrow.get("state") == "resident":
+                            slot["residentReplicas"] += 1
+                            slot["version"] = max(
+                                slot["version"],
+                                int(mrow.get("version") or 0),
+                            )
             else:
                 # Process replica: its worker stamps the replica object;
                 # we read it back.
@@ -354,6 +394,7 @@ class ServingDeploymentController:
             ready=ready_count,
             target=target,
             queue_depth=total_depth,
+            models=list(models_agg.values()) if spec.models else None,
         )
         if spec.autoscale is not None or ready_count < target:
             return Result(requeue_after=self.resync_seconds)
@@ -404,6 +445,32 @@ class ServingDeploymentController:
             return raw
         return max(raw, *(t for _, t in history))
 
+    def _replica_outdated(self, spec, stats: dict) -> list[str]:
+        """Which of the replica's models need a drain-based roll.
+
+        Single-model: the replica's live version vs spec.modelVersion.
+        Multiplexed: only models the replica holds RESIDENT at a stale
+        version count — a paged-out model carries no device state, so
+        its next page-in loads the desired version without costing the
+        fleet a drain."""
+        if spec.models:
+            rows = stats.get("models") or {}
+            stale = []
+            for m in spec.models:
+                if m.model_version <= 0:
+                    continue
+                row = rows.get(m.name)
+                if (
+                    row is not None
+                    and row.get("state") == "resident"
+                    and int(row.get("version") or 0) != m.model_version
+                ):
+                    stale.append(m.name)
+            return stale
+        if int(stats.get("version") or 0) != spec.model_version:
+            return [spec.model]
+        return []
+
     def _roll_outdated(
         self, api, dep: Resource, spec, desired: list[str], rspec: dict,
         runtime,
@@ -415,7 +482,8 @@ class ServingDeploymentController:
             stats = self._runtime_stats(runtime, rname)
             if stats is None:
                 continue
-            if int(stats.get("version") or 0) == spec.model_version:
+            stale = self._replica_outdated(spec, stats)
+            if not stale:
                 continue
             others_ready = all(
                 (self._runtime_stats(runtime, o) or {}).get("ready")
@@ -428,10 +496,16 @@ class ServingDeploymentController:
                 return
             seconds = roll(rname, rspec)
             self.rolls_total.inc(deployment=dep.metadata.name)
+            if spec.models:
+                wanted = {m.name: m.model_version for m in spec.models}
+                detail = ", ".join(
+                    f"{n} -> version {wanted[n]}" for n in stale
+                )
+            else:
+                detail = f"-> version {spec.model_version}"
             api.record_event(
                 dep, "ReplicaRolled",
-                f"{rname} -> version {spec.model_version} "
-                f"({seconds:.3f}s out of rotation)",
+                f"{rname} {detail} ({seconds:.3f}s out of rotation)",
             )
 
     # -- status -----------------------------------------------------------
@@ -447,6 +521,7 @@ class ServingDeploymentController:
         target: int | None = None,
         queue_depth: int | None = None,
         reason: str | None = None,
+        models=None,
     ) -> Result:
         def write():
             try:
@@ -467,6 +542,8 @@ class ServingDeploymentController:
                 new_status["targetReplicas"] = target
             if queue_depth is not None:
                 new_status["queueDepth"] = queue_depth
+            if models is not None:
+                new_status["models"] = models
             if reason is not None:
                 new_status["reason"] = reason
             if new_status != fresh.status:

@@ -14,11 +14,21 @@ negotiated on the same routes, with the JSON surface intact for
 TF-Serving parity clients.
 """
 
+from kubeflow_tpu.serving.admission import (
+    AdmissionController,
+    QuotaSpec,
+)
 from kubeflow_tpu.serving.batching import BatchingConfig, BatchingQueue
+from kubeflow_tpu.serving.registry import (
+    ModelNotFound,
+    PagingConfig,
+    ServableRegistry,
+)
 from kubeflow_tpu.serving.replica import (
     HttpReplica,
     LocalReplica,
     LocalReplicaRuntime,
+    MultiModelReplica,
 )
 from kubeflow_tpu.serving.router import (
     NoReadyReplicas,
@@ -28,7 +38,11 @@ from kubeflow_tpu.serving.router import (
     Router,
 )
 from kubeflow_tpu.serving.servable import Servable
-from kubeflow_tpu.serving.server import ModelRepository, ModelServerApp
+from kubeflow_tpu.serving.server import (
+    FrontDoorApp,
+    ModelRepository,
+    ModelServerApp,
+)
 from kubeflow_tpu.serving.wire import (
     TENSOR_CONTENT_TYPE,
     WireFormatError,
@@ -37,19 +51,26 @@ from kubeflow_tpu.serving.wire import (
 )
 
 __all__ = [
+    "AdmissionController",
     "BatchingConfig",
     "BatchingQueue",
+    "FrontDoorApp",
     "HttpReplica",
     "LocalReplica",
     "LocalReplicaRuntime",
+    "ModelNotFound",
     "ModelRepository",
     "ModelServerApp",
+    "MultiModelReplica",
     "NoReadyReplicas",
     "Overloaded",
+    "PagingConfig",
+    "QuotaSpec",
     "ReplicaGone",
     "ReplicaOverloaded",
     "Router",
     "Servable",
+    "ServableRegistry",
     "TENSOR_CONTENT_TYPE",
     "WireFormatError",
     "decode_tensor",

@@ -100,23 +100,56 @@ def sync_replica_once(
     except NotFound:
         return None
     rspec = dict(replica.spec)
-    model = rspec.get("model", "demo")
-    want_version = int(rspec.get("modelVersion") or 0)
-    try:
-        live = repository.get(model).version
-    except Exception:
-        live = None
-    if live is None or (want_version and live != want_version):
-        servable = build_servable(rspec)
-        repository.load(servable)
-        live = servable.version
-        log.info("replica %s: serving %s version %s", name, model, live)
+    model_specs = rspec.get("models") or []
+    model_rows: dict[str, int] = {}
+    if model_specs:
+        # Multiplexed fleet: one worker serves every spec'd model.
+        # Unlike the in-process MultiModelReplica there is no paging
+        # here — a worker owns its whole address space, so everything
+        # it loads stays resident; LRU paging is the router-side
+        # replica's concern.
+        from kubeflow_tpu.serving.replica import LocalReplicaRuntime
+
+        live = 0
+        for mspec in model_specs:
+            mr = LocalReplicaRuntime.model_rspec(rspec, mspec)
+            mname = mr["model"]
+            want = int(mr.get("modelVersion") or 0)
+            try:
+                mlive = repository.get(mname).version
+            except Exception:
+                mlive = None
+            if mlive is None or (want and mlive != want):
+                servable = build_servable(mr)
+                repository.load(servable)
+                mlive = servable.version
+                log.info(
+                    "replica %s: serving %s version %s", name, mname, mlive
+                )
+            model_rows[mname] = mlive
+            live = max(live, mlive)
+    else:
+        model = rspec.get("model", "demo")
+        want_version = int(rspec.get("modelVersion") or 0)
+        try:
+            live = repository.get(model).version
+        except Exception:
+            live = None
+        if live is None or (want_version and live != want_version):
+            servable = build_servable(rspec)
+            repository.load(servable)
+            live = servable.version
+            log.info(
+                "replica %s: serving %s version %s", name, model, live
+            )
     status = {
         "ready": True,
         "version": live,
         "endpoint": endpoint,
         "pid": os.getpid(),
     }
+    if model_rows:
+        status["models"] = model_rows
     if queue_stats is not None:
         stats = queue_stats()
         status["queueDepth"] = int(stats.get("queue_depth") or 0)

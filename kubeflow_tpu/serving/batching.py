@@ -72,7 +72,7 @@ class _Entry:
         "instances", "event", "result", "error", "arrived", "signature",
     )
 
-    def __init__(self, instances: np.ndarray):
+    def __init__(self, instances: np.ndarray, servable):
         self.instances = instances
         self.event = threading.Event()
         self.result: np.ndarray | None = None
@@ -81,11 +81,24 @@ class _Entry:
         # Computed ONCE at admission: the scheduler re-reads it on every
         # cut, grouping pass, and late-admission scan — under the queue
         # lock, where per-entry tuple building was pure contention.
-        self.signature = _signature(instances)
+        self.signature = _signature(servable, instances)
 
 
-def _signature(instances: np.ndarray) -> tuple:
-    return (instances.shape[1:], instances.dtype.str)
+def _signature(servable, instances: np.ndarray) -> tuple:
+    """Flush-group key: ``(model, version, shape-sans-batch, dtype)``.
+
+    Queues are per-servable, so within one queue the first two elements
+    are constant — but the key carries them anyway: a multiplexed
+    replica (`serving/registry.py`) must never merge two models' (or two
+    generations') rows into one device execution, and making the model
+    part of the KEY keeps that true even if flush windows are ever
+    pooled across queues."""
+    return (
+        servable.name,
+        getattr(servable, "version", 0),
+        instances.shape[1:],
+        instances.dtype.str,
+    )
 
 
 class QueueFull(RuntimeError):
@@ -162,7 +175,7 @@ class BatchingQueue:
         batch = np.asarray(instances)
         if batch.shape[0] == 0:
             raise ValueError("empty instances")
-        entry = _Entry(batch)
+        entry = _Entry(batch, self.servable)
         with self._cv:
             if self._closed:
                 raise QueueClosed(

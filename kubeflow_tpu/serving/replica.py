@@ -37,6 +37,11 @@ from kubeflow_tpu.serving.batching import (
     QueueClosed,
     QueueFull,
 )
+from kubeflow_tpu.serving.registry import (
+    ModelNotFound,
+    PagingConfig,
+    ServableRegistry,
+)
 from kubeflow_tpu.serving.router import (
     ReplicaGone,
     ReplicaOverloaded,
@@ -76,11 +81,15 @@ class LocalReplica:
         with self._lock:
             return not self._dead and not self._queue.stats()["closed"]
 
-    def predict(self, instances) -> np.ndarray:
+    def predict(self, instances, *, model: str | None = None) -> np.ndarray:
         with self._lock:
             dead, queue = self._dead, self._queue
         if dead:
             raise ReplicaGone(f"replica {self.name!r} is dead")
+        if model is not None and model != queue.servable.name:
+            # Single-model replica asked for a different servable: a
+            # model error (404 at the boundary), never a retry.
+            raise ModelNotFound(model)
         try:
             return queue.predict(instances)
         except QueueFull as e:
@@ -122,6 +131,94 @@ class LocalReplica:
         with self._lock:
             queue = self._queue
         queue.close()
+
+
+class MultiModelReplica:
+    """N servables behind ONE replica slot: the multiplexing adapter
+    over a `ServableRegistry` (per-model continuous-batch queues + LRU
+    weight paging). The router surface is the same as `LocalReplica`'s
+    plus the ``model=`` selector; exception mapping:
+
+    - `ModelNotFound` propagates (a model error → 404 at the boundary,
+      never a retry — every replica carries the same catalog);
+    - `QueueFull` → `ReplicaOverloaded` (that MODEL's queue is full —
+      siblings may still have room, the router respreads);
+    - `QueueClosed` out of a killed registry → `ReplicaGone`.
+
+    ``capacity`` is the router backpressure budget for the whole
+    replica. The default (one model's ``max_pending``) is deliberately
+    conservative — the fleet sheds before any single queue must."""
+
+    def __init__(
+        self,
+        name: str,
+        registry: ServableRegistry,
+        *,
+        capacity: int | None = None,
+    ):
+        self.name = name
+        self.registry = registry
+        self.capacity = (
+            capacity
+            if capacity is not None
+            else registry.batching.max_pending
+        )
+        self._dead = False
+
+    @property
+    def ready(self) -> bool:
+        return not self._dead and not self.registry.stats()["closed"]
+
+    def predict(self, instances, *, model: str | None = None) -> np.ndarray:
+        if self._dead:
+            raise ReplicaGone(f"replica {self.name!r} is dead")
+        if model is None:
+            models = self.registry.models()
+            if len(models) != 1:
+                raise ModelNotFound(
+                    "multiplexed replica needs an explicit model "
+                    f"(serving {len(models)})"
+                )
+            model = models[0]
+        try:
+            return self.registry.predict(model, instances)
+        except QueueFull as e:
+            raise ReplicaOverloaded(str(e)) from e
+        except QueueClosed as e:
+            raise ReplicaGone(str(e)) from e
+
+    def stats(self) -> dict:
+        """Per-model registry snapshot plus the aggregate queue signal
+        the autoscaler reads (sum of depths, worst wait)."""
+        rstats = self.registry.stats()
+        per_model = rstats["models"]
+        return {
+            "ready": self.ready,
+            "models": per_model,
+            "resident": rstats["resident"],
+            "queue_depth": sum(
+                m.get("queue_depth", 0) for m in per_model.values()
+            ),
+            "queue_wait_ms": max(
+                (m.get("queue_wait_ms", 0.0) for m in per_model.values()),
+                default=0.0,
+            ),
+        }
+
+    def roll_model(self, model: str, rspec: dict) -> None:
+        """Swap ONE model's generation; the other queues keep serving.
+        `LocalReplicaRuntime.roll` calls this with the replica drained —
+        per-model rolls ride the existing drain machinery."""
+        self.registry.roll(model, rspec)
+
+    def kill(self) -> None:
+        """Chaos: replica death fails every model's queued and in-flight
+        work with ReplicaGone (via the registry's QueueClosed)."""
+        self._dead = True
+        self.registry.kill()
+
+    def close(self) -> None:
+        self.registry.close()
 
 
 class HttpReplica:
@@ -294,7 +391,7 @@ class HttpReplica:
 
     # -- request surface ---------------------------------------------------
 
-    def predict(self, instances) -> np.ndarray:
+    def predict(self, instances, *, model: str | None = None) -> np.ndarray:
         arr = np.asarray(instances)
         use_binary = self._binary
         if use_binary:
@@ -309,8 +406,12 @@ class HttpReplica:
                 "Content-Type": "application/json",
                 "Accept": "application/json",
             }
+        # Multiplexed dispatch rides the path, same as TF-Serving: the
+        # router's model= selects which servable on the worker serves
+        # this request; None keeps the replica's configured default.
+        target = model or self._model
         status, data, retry_after, content_type = self._request(
-            "POST", f"/v1/models/{self._model}:predict", body, headers
+            "POST", f"/v1/models/{target}:predict", body, headers
         )
         if (
             use_binary
@@ -322,7 +423,7 @@ class HttpReplica:
             # good; a genuinely bad input gets the same 4xx from the
             # JSON retry and propagates below.
             self._binary = False
-            return self.predict(instances)
+            return self.predict(instances, model=model)
         if status == 429:
             raise ReplicaOverloaded(
                 f"replica {self.name!r} shed the request",
@@ -390,9 +491,49 @@ class LocalReplicaRuntime:
     def names(self) -> list[str]:
         return self.router.replica_names()
 
+    def apply_model_policy(self, models) -> None:
+        """Controller hook: push the CR catalog's admission policy
+        (per-model priority class + quota buckets) onto the fleet's
+        router on every reconcile."""
+        self.router.set_model_policy(models)
+
+    @staticmethod
+    def model_rspec(rspec: dict, mspec: dict) -> dict:
+        """Render ONE model's replica spec from the fleet rspec + its
+        entry in ``models: [...]`` — the same single-model shape the
+        servable factory has always consumed, so one factory serves
+        both fleet flavors."""
+        return {
+            "model": mspec["name"],
+            "maxBatch": rspec.get("maxBatch", 64),
+            "batching": dict(rspec.get("batching") or {}),
+            "checkpointDir": mspec.get(
+                "checkpointDir", rspec.get("checkpointDir", "")
+            ),
+            "modelVersion": int(mspec.get("modelVersion", 0) or 0),
+        }
+
     def ensure(self, name: str, rspec: dict) -> None:
-        """Idempotent: bring the named replica up if it isn't already."""
+        """Idempotent: bring the named replica up if it isn't already.
+        An rspec carrying ``models: [...]`` materializes a multiplexed
+        replica (ServableRegistry + LRU paging) instead of the
+        single-servable shape."""
         if self.router.replica(name) is not None:
+            return
+        models = rspec.get("models")
+        if models:
+            paging = rspec.get("paging") or {}
+            registry = ServableRegistry(
+                self._factory,
+                batching=self._config(rspec),
+                paging=PagingConfig(
+                    max_resident=int(paging.get("maxResident", 0) or 0)
+                ),
+                metrics=self._metrics,
+            )
+            for mspec in models:
+                registry.ensure(self.model_rspec(rspec, mspec))
+            self.router.add(MultiModelReplica(name, registry))
             return
         servable = self._factory(rspec)
         self.router.add(
@@ -412,14 +553,47 @@ class LocalReplicaRuntime:
         replica.close()
 
     def roll(self, name: str, rspec: dict) -> float:
-        """Drain-based hot swap to the spec's model version; returns the
-        seconds the replica was out of rotation."""
+        """Drain-based hot swap to the spec's model version(s); returns
+        the seconds the replica was out of rotation. On a multiplexed
+        replica only the OUTDATED models reload — per-model rolls ride
+        the same drain machinery, one replica at a time."""
         replica = self.router.replica(name)
         if replica is None:
             raise KeyError(f"unknown replica {name!r}")
+        if isinstance(replica, MultiModelReplica):
+            return self.router.roll(
+                name, lambda: self._sync_models(replica, rspec)
+            )
         return self.router.roll(
             name, lambda: replica.swap(self._factory(rspec))
         )
+
+    def _sync_models(
+        self, replica: MultiModelReplica, rspec: dict
+    ) -> None:
+        """Converge a (drained) multiplexed replica onto the rspec's
+        model list: add new entries, reload models whose desired version
+        moved (resident ones eagerly, paged-out ones lazily on their
+        next page-in), drop models no longer listed."""
+        desired = rspec.get("models") or []
+        live = replica.registry.stats()["models"]
+        for mspec in desired:
+            mr = self.model_rspec(rspec, mspec)
+            row = live.get(mspec["name"])
+            want = int(mr.get("modelVersion", 0) or 0)
+            if (
+                row is not None
+                and row["state"] == "resident"
+                and want
+                and row["version"] != want
+            ):
+                replica.roll_model(mspec["name"], mr)
+            else:
+                replica.registry.ensure(mr)
+        keep = {m["name"] for m in desired}
+        for name in replica.registry.models():
+            if name not in keep:
+                replica.registry.remove(name)
 
     def stats(self, name: str) -> dict | None:
         replica = self.router.replica(name)

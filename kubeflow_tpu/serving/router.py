@@ -33,9 +33,11 @@ admission (i.e. it was not shed). The router's terminal accounting keeps
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 
+from kubeflow_tpu.serving.admission import AdmissionController, QuotaSpec
 from kubeflow_tpu.utils.metrics import MetricsRegistry
 
 
@@ -89,7 +91,11 @@ class Router:
     requests it will hold — backpressure budget), and
     ``predict(instances)`` raising `ReplicaGone` / `ReplicaOverloaded`
     per the contracts above (`serving/replica.py` provides the local and
-    HTTP adapters).
+    HTTP adapters). On a multiplexed fleet every replica additionally
+    accepts ``predict(instances, model=...)`` (the `MultiModelReplica`
+    adapter over a `ServableRegistry`), and an `AdmissionController`
+    gates requests by priority class + tenant quota before they count
+    as acknowledged.
     """
 
     def __init__(
@@ -99,8 +105,19 @@ class Router:
         max_attempts: int = 4,
         retry_after_s: float = 0.25,
         dispatch_timeout_s: float = 30.0,
+        admission: AdmissionController | None = None,
+        retry_jitter_seed: int = 0,
     ):
         self._cv = threading.Condition()
+        # Admission policy (priority headroom + tenant quotas) — None
+        # keeps the original capacity-only shed, so single-model fleets
+        # are untouched.
+        self.admission = admission
+        # ±50% spread on every Retry-After hint: a fixed value
+        # synchronizes every shed client into a retry thundering herd
+        # that re-sheds as one wave. Seeded so chaos gates replay the
+        # same schedule run-to-run.
+        self._retry_rng = random.Random(retry_jitter_seed)
         self._slots: dict[str, _Slot] = {}
         # Admission aggregates, maintained at every membership/state
         # change instead of recomputed per dispatch: _admit_locked sits
@@ -113,7 +130,12 @@ class Router:
         self.max_attempts = max_attempts
         self.retry_after_s = retry_after_s
         self.dispatch_timeout_s = dispatch_timeout_s
+        # Catalog-declared default priority class per model (CR
+        # spec.models[].priority) — applied only when a request names
+        # no class of its own.
+        self._model_priority: dict[str, str] = {}
         metrics = metrics or MetricsRegistry()
+        self._metrics_registry = metrics
         self.acked_total = metrics.counter(
             "serving_router_acked_total",
             "requests admitted past load shedding",
@@ -170,6 +192,39 @@ class Router:
                 if s.admitting and not s.dead
             )
 
+    def set_model_policy(self, models) -> None:
+        """Wire the CR catalog's admission policy (spec.models[]) into
+        this router: each model's declared priority class becomes the
+        default for requests that name none, and a nonzero
+        ``quotaRate``/``quotaBurst`` becomes a per-model token bucket
+        (key ``model:<name>``) charged alongside the tenant bucket.
+
+        Idempotent under reconcile resync: an unchanged QuotaSpec keeps
+        its live bucket (re-creating it would refill the burst every
+        resync and the quota would never bind); only a changed spec
+        resets, and models that dropped their quota (or left the
+        catalog) lose their bucket."""
+        self._model_priority = {m.name: m.priority for m in models}
+        wanted = {
+            f"model:{m.name}": QuotaSpec(
+                rate=m.quota_rate, burst=m.quota_burst
+            )
+            for m in models
+            if m.quota_rate > 0
+        }
+        if not wanted and self.admission is None:
+            return
+        if self.admission is None:
+            self.admission = AdmissionController(
+                metrics=self._metrics_registry
+            )
+        for key, quota in wanted.items():
+            if self.admission.quotas.get(key) != quota:
+                self.admission.set_quota(key, quota)
+        for key in list(self.admission.quotas):
+            if key.startswith("model:") and key not in wanted:
+                self.admission.remove_quota(key)
+
     def stats(self) -> dict:
         """Aggregate autoscaling signal: fleet-wide outstanding plus each
         replica's own queue stats (the controller folds this into
@@ -208,7 +263,17 @@ class Router:
             max(int(s.replica.capacity), 1) for s in self._alive
         )
 
-    def _admit_locked(self, tried: set) -> "_Slot | None":
+    def _retry_hint(self, base: float | None = None) -> float:
+        """Retry-After with deterministic ±50% jitter: drawn from the
+        seeded RNG so a replayed chaos run sheds the same schedule, but
+        spread across [0.5, 1.5]× base so shed clients do not return as
+        one synchronized wave (the thundering-herd regression)."""
+        base = self.retry_after_s if base is None or base <= 0 else base
+        return base * (0.5 + self._retry_rng.random())
+
+    def _admit_locked(
+        self, tried: set, priority: str = "standard"
+    ) -> "_Slot | None":
         """Admission + selection under the lock. Raises NoReadyReplicas /
         Overloaded; returns None when every eligible replica was already
         tried this request (caller decides whether to wait and re-spread).
@@ -224,13 +289,28 @@ class Router:
                 raise NoReadyReplicas("no live serving replicas")
             # Everything live is draining; momentary — ask for a retry.
             raise Overloaded(
-                "all replicas draining", retry_after=self.retry_after_s
+                "all replicas draining", retry_after=self._retry_hint()
             )
+        if self.admission is not None:
+            # Priority headroom first: a low class sheds at ITS ceiling
+            # even before the fleet-wide capacity check would — the
+            # reserved slots above the ceiling are what keep
+            # high-priority p99 flat under 2× offered low-pri load.
+            verdict = self.admission.check_priority(
+                priority,
+                outstanding=self._outstanding,
+                capacity=self._capacity,
+            )
+            if not verdict.admitted:
+                raise Overloaded(
+                    verdict.reason,
+                    retry_after=self._retry_hint(verdict.retry_after),
+                )
         if self._outstanding >= self._capacity:
             raise Overloaded(
                 f"fleet at capacity ({self._outstanding} outstanding >= "
                 f"{self._capacity} queue slots)",
-                retry_after=self.retry_after_s,
+                retry_after=self._retry_hint(),
             )
         if not tried:  # the common path builds no per-request list
             return min(alive, key=lambda s: s.outstanding)
@@ -245,11 +325,38 @@ class Router:
         self.outstanding_gauge.dec()
         self._cv.notify_all()
 
-    def predict(self, instances, *, idempotent: bool = True):
+    def predict(
+        self,
+        instances,
+        *,
+        model: str | None = None,
+        priority: str | None = "standard",
+        tenant: str | None = None,
+        idempotent: bool = True,
+    ):
         """Route one request. Raises `Overloaded` (shed — never acked),
         `NoReadyReplicas`, or the model error from the replica that
         served it. An acknowledged idempotent request survives replica
-        death as long as one replica remains."""
+        death as long as one replica remains.
+
+        `model` selects the servable on a multiplexed fleet (None keeps
+        the single-model replicas' default); `priority`/`tenant` feed the
+        admission controller when one is attached — a quota token is
+        charged ONCE per request here, not per dispatch retry.
+        `priority=None` defers to the model's catalog-declared class
+        (`set_model_policy`), falling back to "standard"."""
+        if priority is None:
+            priority = self._model_priority.get(model or "", "standard")
+        if self.admission is not None:
+            verdict = self.admission.acquire_quota(
+                tenant, f"model:{model}" if model else None
+            )
+            if not verdict.admitted:
+                self.shed_total.inc()
+                raise Overloaded(
+                    verdict.reason,
+                    retry_after=self._retry_hint(verdict.retry_after),
+                )
         deadline = time.monotonic() + self.dispatch_timeout_s
         tried: set = set()
         acked = False
@@ -257,7 +364,7 @@ class Router:
         while True:
             with self._cv:
                 try:
-                    slot = self._admit_locked(tried)
+                    slot = self._admit_locked(tried, priority)
                 except Overloaded:
                     if not acked:
                         self.shed_total.inc()
@@ -280,7 +387,7 @@ class Router:
                         raise Overloaded(
                             "every replica refused within the dispatch "
                             "deadline",
-                            retry_after=self.retry_after_s,
+                            retry_after=self._retry_hint(),
                         )
                     tried = set()
                     self._cv.wait(0.005)
@@ -294,7 +401,10 @@ class Router:
                 name = slot.replica.name
                 replica = slot.replica
             try:
-                result = replica.predict(instances)
+                if model is None:
+                    result = replica.predict(instances)
+                else:
+                    result = replica.predict(instances, model=model)
             except ReplicaGone:
                 with self._cv:
                     slot.dead = True

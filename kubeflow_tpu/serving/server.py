@@ -18,11 +18,19 @@ contract moves.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Iterable
 
 from kubeflow_tpu.serving import wire
 from kubeflow_tpu.serving.batching import BatchingQueue, QueueClosed, QueueFull
+from kubeflow_tpu.serving.registry import ModelNotFound
+from kubeflow_tpu.serving.router import (
+    NoReadyReplicas,
+    Overloaded,
+    ReplicaGone,
+    Router,
+)
 from kubeflow_tpu.serving.servable import Servable
 from kubeflow_tpu.utils.metrics import MetricsRegistry
 from kubeflow_tpu.web import (
@@ -34,6 +42,20 @@ from kubeflow_tpu.web import (
 )
 
 log = logging.getLogger(__name__)
+
+# Priority/tenant ride request headers so the admission decision needs
+# no body parse (a shed request's body is never decoded past the WSGI
+# read).
+PRIORITY_HEADER = "x-kftpu-priority"
+TENANT_HEADER = "x-kftpu-tenant"
+
+
+def _format_retry_after(seconds: float) -> str:
+    """Retry-After with two decimals. RFC 7231 wants integer seconds;
+    we deliberately emit fractional ones (docs/serving.md §admission) —
+    our clients parse float, and rounding a jittered sub-second hint up
+    to 1 would re-synchronize the very herd the jitter de-correlates."""
+    return f"{max(0.01, seconds):.2f}"
 
 
 class ModelRepository:
@@ -107,6 +129,7 @@ class ModelServerApp(App):
         *,
         metrics: MetricsRegistry | None = None,
         batching=None,
+        retry_jitter_seed: int = 0,
     ):
         """`batching`: a `serving.BatchingConfig` turns on the TF-Serving
         batching-scheduler analog — concurrent requests merge into one
@@ -116,6 +139,9 @@ class ModelServerApp(App):
         self._batching = batching
         self._batchers: dict = {}
         self._batcher_lock = threading.Lock()
+        # ±50% Retry-After spread, seeded (chaos gates replay): a fixed
+        # hint synchronizes every shed client into one retry wave.
+        self._retry_rng = random.Random(retry_jitter_seed)
         metrics = metrics or MetricsRegistry()
         self.request_count = metrics.counter(
             "serving_requests_total", "predict requests", ("model", "outcome")
@@ -271,8 +297,14 @@ class ModelServerApp(App):
         )
 
     def _retry_after(self) -> str:
+        """One flush window (floored at 1s — the queue clears at flush
+        cadence), jittered ±50% from the seeded RNG so shed clients do
+        not return as one synchronized wave."""
         timeout_ms = getattr(self._batching, "timeout_ms", 0.0) or 0.0
-        return str(max(1, -(-int(timeout_ms) // 1000)))
+        base = float(max(1, -(-int(timeout_ms) // 1000)))
+        return _format_retry_after(
+            base * (0.5 + self._retry_rng.random())
+        )
 
     def _predictor(self, model):
         """model.predict, or its batching queue when batching is on.
@@ -329,6 +361,162 @@ class ModelServerApp(App):
             self._batchers.clear()
         for queue in queues:
             queue.close()
+
+    def metrics_text(self, req: Request) -> Response:
+        return Response(
+            body=self._metrics_registry.expose_text().encode(),
+            content_type="text/plain; version=0.0.4",
+        )
+
+
+class FrontDoorApp(App):
+    """The multi-model front door: one HTTP surface over the drain-aware
+    `Router` for a whole (possibly multiplexed) fleet.
+
+    Same routes and negotiation as `ModelServerApp` — ``/v1/models/<m>``
+    stops being decorative: the path segment selects the servable on
+    every replica, priority class and tenant ride the
+    ``X-KFTPU-Priority`` / ``X-KFTPU-Tenant`` headers, and the router's
+    verdicts map onto honest status codes:
+
+    - `Overloaded` (capacity, priority headroom, or tenant quota) →
+      429 with the router's already-jittered ``retry_after`` as a
+      fractional-seconds Retry-After;
+    - `NoReadyReplicas` / a dead fleet mid-request → 503;
+    - `ModelNotFound` → 404 (every replica carries the same catalog);
+    - an unknown priority class → 400 (client error, not a shed).
+    """
+
+    def __init__(
+        self,
+        router: Router,
+        *,
+        metrics: MetricsRegistry | None = None,
+    ):
+        super().__init__("serving-front-door")
+        self.router = router
+        metrics = metrics or MetricsRegistry()
+        self._metrics_registry = metrics
+        self.request_count = metrics.counter(
+            "serving_front_door_requests_total",
+            "front-door predict requests",
+            ("model", "outcome"),
+        )
+        self.add_route("/v1/models/<name>", self.model_get)
+        self.add_route("/v1/models/<name>", self.model_post, ("POST",))
+        self.add_route("/v1/models", self.models_list)
+        self.add_route("/metrics", self.metrics_text)
+
+    # -- catalog views (aggregated across the fleet) -----------------------
+
+    def _catalog(self) -> dict:
+        """model → per-replica state rows, from the router's aggregated
+        stats (MultiModelReplica exposes its registry snapshot there)."""
+        catalog: dict[str, dict[str, dict]] = {}
+        for rname, row in self.router.stats()["replicas"].items():
+            for model, mrow in (row.get("models") or {}).items():
+                catalog.setdefault(model, {})[rname] = mrow
+        return catalog
+
+    def models_list(self, req: Request) -> Response:
+        return json_response({"models": sorted(self._catalog())})
+
+    def model_get(self, req: Request) -> Response:
+        name, verb = ModelServerApp._split_verb(req.path_params["name"])
+        if verb is not None:
+            raise HttpError(405, "verbs require POST")
+        rows = self._catalog().get(name)
+        if rows is None:
+            raise HttpError(404, f"model {name!r} not found")
+        resident = sum(
+            1 for r in rows.values() if r.get("state") == "resident"
+        )
+        return json_response(
+            {
+                "model_version_status": [
+                    {
+                        "version": str(
+                            max(r.get("version", 0) for r in rows.values())
+                        ),
+                        "state": "AVAILABLE",
+                        "status": {"error_code": "OK", "error_message": ""},
+                    }
+                ],
+                "replicas": {
+                    rname: {
+                        "state": r.get("state", "resident"),
+                        "version": r.get("version", 0),
+                    }
+                    for rname, r in rows.items()
+                },
+                "resident_replicas": resident,
+            }
+        )
+
+    # -- predict -----------------------------------------------------------
+
+    def model_post(self, req: Request) -> Response:
+        name, verb = ModelServerApp._split_verb(req.path_params["name"])
+        if verb != "predict":
+            raise HttpError(400, f"unsupported verb {verb!r}")
+        if wire.is_tensor_request(req.headers):
+            try:
+                instances = wire.decode_tensor(req.body)
+            except wire.WireFormatError as e:
+                self.request_count.inc(model=name, outcome="invalid")
+                raise HttpError(400, f"bad tensor frame: {e}") from None
+            if instances.ndim < 1 or instances.shape[0] < 1:
+                self.request_count.inc(model=name, outcome="invalid")
+                raise HttpError(
+                    400, "tensor batch needs a non-empty leading dimension"
+                )
+        else:
+            body = req.json()
+            instances = body.get("instances")
+            if not isinstance(instances, list) or not instances:
+                self.request_count.inc(model=name, outcome="invalid")
+                raise HttpError(
+                    400, "body must have a non-empty 'instances' list"
+                )
+        # No header → None → the router applies the model's
+        # catalog-declared default class before falling back to
+        # "standard".
+        priority = req.headers.get(PRIORITY_HEADER) or None
+        tenant = req.headers.get(TENANT_HEADER) or None
+        try:
+            predictions = self.router.predict(
+                instances, model=name, priority=priority, tenant=tenant
+            )
+        except Overloaded as e:
+            # Honest shed: never acked by the router, surfaced as 429
+            # with the (already jittered) backoff hint.
+            self.request_count.inc(model=name, outcome="overload")
+            raise HttpError(
+                429,
+                str(e),
+                headers=[
+                    ("Retry-After", _format_retry_after(e.retry_after))
+                ],
+            ) from None
+        except (NoReadyReplicas, ReplicaGone) as e:
+            # No fleet left (or it died out from under an acked request
+            # after the retry budget) — unavailable, retryable.
+            self.request_count.inc(model=name, outcome="unavailable")
+            raise HttpError(503, str(e)) from None
+        except ModelNotFound:
+            self.request_count.inc(model=name, outcome="invalid")
+            raise HttpError(404, f"model {name!r} not found") from None
+        except ValueError as e:
+            # Unknown priority class, ragged instances — client errors.
+            self.request_count.inc(model=name, outcome="invalid")
+            raise HttpError(400, str(e)) from None
+        self.request_count.inc(model=name, outcome="ok")
+        if wire.wants_tensor_response(req.headers):
+            return Response(
+                body=wire.encode_tensor(predictions),
+                content_type=wire.TENSOR_CONTENT_TYPE,
+            )
+        return json_response({"predictions": predictions.tolist()})
 
     def metrics_text(self, req: Request) -> Response:
         return Response(

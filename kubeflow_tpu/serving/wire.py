@@ -31,6 +31,7 @@ per-element JSON encode (docs/serving.md §wire protocol).
 
 from __future__ import annotations
 
+import math
 import struct
 
 import numpy as np
@@ -45,6 +46,12 @@ _LEN = struct.Struct("<I")
 # a corrupt frame, not a real tensor header.
 _MAX_HEADER = 4096
 
+# Numeric tensor kinds only: bool, (un)signed int, float, complex.
+# Strings ('U'/'S'), void/records ('V'), datetimes ('M'/'m') and object
+# arrays never cross this wire — a servable can't batch them, and
+# several of them smuggle pickle-adjacent decode paths.
+_ALLOWED_KINDS = frozenset("biufc")
+
 
 class WireFormatError(ValueError):
     """The frame is not a valid tensor (bad magic, truncated payload,
@@ -58,6 +65,11 @@ def encode_tensor(arr) -> bytes:
     arr = np.asarray(arr)
     if arr.dtype.hasobject:
         raise WireFormatError("object arrays cannot cross the wire")
+    if arr.dtype.kind not in _ALLOWED_KINDS:
+        raise WireFormatError(
+            f"dtype kind {arr.dtype.kind!r} ({arr.dtype.str}) is not a "
+            f"wire tensor type"
+        )
     if arr.dtype.byteorder == ">":
         arr = arr.astype(arr.dtype.newbyteorder("<"))
     # Shape BEFORE ascontiguousarray: it promotes 0-d scalars to 1-d.
@@ -92,9 +104,24 @@ def decode_tensor(data: bytes) -> np.ndarray:
         shape = tuple(int(d) for d in dims.split(",")) if dims else ()
     except (UnicodeDecodeError, TypeError, ValueError) as e:
         raise WireFormatError(f"malformed tensor header: {e}") from e
+    # Decode guards (ISSUE 17 satellite): every malformed header must be
+    # a WireFormatError here — a raw ValueError out of reshape would
+    # escape the server's 400 mapping and 500 the WSGI handler.
     if dtype.hasobject:
         raise WireFormatError("object dtype refused")
-    expected = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+    if dtype.kind not in _ALLOWED_KINDS:
+        raise WireFormatError(
+            f"dtype kind {dtype.kind!r} ({dtype_str}) is not a wire "
+            f"tensor type"
+        )
+    if any(d < 0 for d in shape):
+        # reshape treats -1 as "infer this dim" — from the wire that is
+        # attacker-controlled reshaping, not a tensor.
+        raise WireFormatError(f"negative dimension in header: {shape}")
+    # Arbitrary-precision product: np.prod over int64 silently WRAPS on
+    # a crafted huge-dims header, which can collide with the payload
+    # length and push a bogus shape into reshape.
+    expected = dtype.itemsize * math.prod(shape)
     payload = memoryview(data)[body_off:]
     if len(payload) != expected:
         raise WireFormatError(

@@ -150,7 +150,7 @@ def test_forbid_skips_while_previous_runs():
     reasons = [e.spec["reason"] for e in api.list("Event", "ci")]
     assert "RunSkipped" in reasons
     # Finish the run → next tick fires again.
-    wf = spawned(api)[0]
+    wf = spawned(api)[0].thaw()
     wf.status["phase"] = "Succeeded"
     api.update_status(wf)
     _tick(api, clock, ctl)
@@ -178,6 +178,7 @@ def test_history_gc():
         _tick(api, clock, ctl)
         for wf in spawned(api):
             if wf.status.get("phase") != "Succeeded":
+                wf = wf.thaw()
                 wf.status["phase"] = "Succeeded"
                 api.update_status(wf)
     ctl.controller.enqueue(("ci", "nightly"))
@@ -303,7 +304,7 @@ def test_spawn_adopts_existing_run_after_crash():
     [wf] = spawned(api)
     # Simulate the crash: rewind lastScheduleTime so the same fire time
     # (and run name) is recomputed.
-    cw = api.get(KIND, "nightly", "ci")
+    cw = api.get(KIND, "nightly", "ci").thaw()
     cw.status["lastScheduleTime"] = cw.status["lastScheduleTime"] - 60
     api.update_status(cw)
     ctl.controller.enqueue(("ci", "nightly"))

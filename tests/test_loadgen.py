@@ -1,0 +1,178 @@
+"""Open-loop load harness (ISSUE 17): the generator that drives
+`bench.py --workload serving`.
+
+An open-loop harness is only trustworthy if (a) its schedules are
+deterministic (seeded — chaos replays and CI reruns see the same
+arrival process), (b) its merge arithmetic is exact, and (c) the
+multi-process engine actually holds an offered rate instead of
+silently degrading into a closed loop (coordinated omission). The
+bench's `serving_offered_rate_error` row gates (c) at scale; these
+tests pin the mechanics at unit size.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.testing import loadgen
+from kubeflow_tpu.testing.loadgen import (
+    ERROR,
+    OK,
+    SHED,
+    TrafficClass,
+    arrival_schedule,
+    assign_classes,
+    plan_rate,
+)
+
+
+# -- schedules ---------------------------------------------------------------
+
+
+def test_poisson_schedule_is_seeded_and_monotonic():
+    a = arrival_schedule(100.0, 2000, seed=7)
+    b = arrival_schedule(100.0, 2000, seed=7)
+    c = arrival_schedule(100.0, 2000, seed=8)
+    assert a == b
+    assert a != c
+    assert all(x <= y for x, y in zip(a, a[1:]))
+    # Mean inter-arrival gap ~ 1/rate (law of large numbers, loose).
+    mean_gap = a[-1] / (len(a) - 1)
+    assert 0.8 / 100.0 < mean_gap < 1.2 / 100.0
+
+
+def test_uniform_schedule_is_a_metronome():
+    assert arrival_schedule(50.0, 5, seed=0, process="uniform") == [
+        0.0, 1 / 50.0, 2 / 50.0, 3 / 50.0, 4 / 50.0
+    ]
+
+
+def test_schedule_rejects_bad_inputs():
+    with pytest.raises(ValueError, match="rate"):
+        arrival_schedule(0.0, 10, seed=0)
+    with pytest.raises(ValueError, match="process"):
+        arrival_schedule(10.0, 10, seed=0, process="bursty")
+
+
+def test_class_assignment_is_seeded_and_weighted():
+    classes = [
+        TrafficClass("hot", weight=4.0),
+        TrafficClass("cold", weight=1.0),
+    ]
+    a = assign_classes(classes, 5000, seed=3)
+    assert a == assign_classes(classes, 5000, seed=3)
+    assert a != assign_classes(classes, 5000, seed=4)
+    hot_share = a.count(0) / len(a)
+    assert 0.75 < hot_share < 0.85  # 4:1 weights
+    with pytest.raises(ValueError):
+        assign_classes([], 10, seed=0)
+
+
+def test_plan_rate():
+    assert plan_rate(600, 30.0) == 20.0
+
+
+# -- merge arithmetic --------------------------------------------------------
+
+
+def test_merge_counts_and_rate_are_exact():
+    """Hand-built records: a metronome at 10/s with zero lag must merge
+    to achieved == offered (error 0), with per-class outcome counts and
+    latency percentiles taken only over OK records."""
+    classes = [TrafficClass("m", priority="critical")]
+    # (cls_idx, offset, lag, latency_s, outcome)
+    records = [(0, i / 10.0, 0.0, 0.010, OK) for i in range(20)]
+    records[4] = (0, 0.4, 0.0, 0.500, SHED)  # shed latency must not count
+    records[9] = (0, 0.9, 0.0, 0.900, ERROR)
+    report = loadgen._merge(records, classes, rate=10.0)
+    assert report.fired == 20
+    assert (report.ok, report.shed, report.error) == (18, 1, 1)
+    assert report.offered_rate_error == 0.0
+    assert report.achieved_rate == 10.0
+    (cls,) = report.classes
+    assert (cls.ok, cls.shed, cls.error) == (18, 1, 1)
+    assert cls.p50_ms == 10.0 and cls.p99_ms == 10.0  # OK records only
+
+
+def test_merge_by_model_collapses_priority_streams():
+    classes = [
+        TrafficClass("m", priority="critical"),
+        TrafficClass("m", priority="batch"),
+        TrafficClass("other"),
+    ]
+    records = [
+        (0, 0.0, 0.0, 0.010, OK),
+        (1, 0.1, 0.0, 0.050, SHED),
+        (2, 0.2, 0.0, 0.020, OK),
+    ]
+    by_model = loadgen._merge(records, classes, rate=10.0).by_model()
+    assert set(by_model) == {"m", "other"}
+    assert by_model["m"].count == 2
+    assert by_model["m"].shed == 1
+
+
+def test_merge_slow_start_shows_as_rate_error():
+    """Coordinated omission guard: arrivals that fired LATE (lag) must
+    stretch the measured span and show up as offered-rate error — a
+    harness that blames its own stalls on the fleet is lying."""
+    classes = [TrafficClass("m")]
+    records = [
+        (0, i / 100.0, 0.05 * i, 0.001, OK) for i in range(100)
+    ]  # each fire 50ms later than the last: 5x the scheduled span
+    report = loadgen._merge(records, classes, rate=100.0)
+    assert report.achieved_rate < 25.0
+    assert report.offered_rate_error > 0.75
+    assert report.fire_lag_p99_ms > 1000.0
+
+
+# -- engines -----------------------------------------------------------------
+
+
+def test_threaded_run_fires_everything_and_maps_outcomes():
+    calls = []
+
+    def target(cls):
+        calls.append(cls.model)
+        if cls.model == "shedme":
+            return "shed"
+        if cls.model == "broken":
+            raise RuntimeError("kaput")
+        return "ok"
+
+    report = loadgen.run_open_loop_threaded(
+        target,
+        [
+            TrafficClass("fine", weight=2.0),
+            TrafficClass("shedme"),
+            TrafficClass("broken"),
+        ],
+        rate=500.0, total=200, seed=5, concurrency=16,
+    )
+    assert report.fired == 200 == len(calls)
+    assert report.ok + report.shed + report.error == 200
+    by_model = report.by_model()
+    assert by_model["shedme"].shed == by_model["shedme"].count
+    assert by_model["broken"].error == by_model["broken"].count
+    assert by_model["fine"].ok == by_model["fine"].count
+
+
+def test_multiprocess_noop_holds_offered_rate():
+    """The real engine: spawn workers, shared monotonic start, no-op
+    target. Everything scheduled fires exactly once, and the achieved
+    rate tracks the offered rate (the bench gates 5% at scale; unit
+    scale on a busy CI box gets a looser 25%)."""
+    t0 = time.monotonic()
+    report = loadgen.run_open_loop(
+        {"mode": "noop", "work_us": 20},
+        [TrafficClass("a", weight=3.0), TrafficClass("b")],
+        rate=400.0, total=240, seed=11, workers=2, concurrency=8,
+        process="uniform", start_delay_s=0.2,
+    )
+    elapsed = time.monotonic() - t0
+    assert report.fired == 240
+    assert report.ok == 240
+    assert report.offered_rate_error < 0.25, report
+    assert report.duration_s > 0.4  # ~240/400s of schedule actually ran
+    assert elapsed < 60.0
+    assert {c.model for c in report.classes} == {"a", "b"}
+    assert sum(c.count for c in report.classes) == 240

@@ -28,6 +28,6 @@ def test_contract_table_is_complete():
     for required in (
         "train-step-dp", "pipeline-wire-v1", "pipeline-wire-v2",
         "fused-flash-grad", "serving-batch", "elastic-resize",
-        "serving-batch-continuous",
+        "serving-batch-continuous", "serving-multiplex",
     ):
         assert required in names

@@ -70,3 +70,19 @@ def test_serving_dataplane_bench_smoke_rows_resolve_baseline():
     assert chaos["value"] == 64
     assert "failed=0" in chaos["unit"]
     assert "coverage={'replica_kill': 1}" in result.stderr
+
+    # ISSUE 17 front-door rows: multiplex p99 + measured page-in resolve
+    # against published baselines; the open-loop fidelity row is a hard
+    # gate (the bench exits nonzero above 5% offered-rate error, so a
+    # row at all means the harness held its schedule).
+    for name in (
+        "serving_multiplex_p99_ms",
+        "serving_page_in_seconds",
+        "serving_priority_p99_at_2x_ms",
+    ):
+        assert name in by_name, (name, sorted(by_name))
+        assert by_name[name]["vs_baseline"] is not None, by_name[name]
+    fidelity = by_name["serving_offered_rate_error"]
+    assert fidelity["value"] <= 0.05, fidelity
+    assert "# serving multiplex:" in result.stderr
+    assert "# serving priority:" in result.stderr

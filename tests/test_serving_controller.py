@@ -570,3 +570,208 @@ def test_process_spec_without_process_runtime_degrades_to_local():
     )
     converge(controller)
     assert sorted(local.replicas) == [serving_api.replica_name("pfleet", 0)]
+
+
+# -- multiplexed fleets: CR -> replicas -> status (ISSUE 17) -----------------
+
+
+class MuxRuntime(FakeRuntime):
+    """FakeRuntime whose replicas carry per-model registry stats, the
+    shape MultiModelReplica.stats() exposes to the controller."""
+
+    def __init__(self):
+        super().__init__()
+        self.rspecs: dict[str, dict] = {}
+
+    def ensure(self, name, rspec):
+        self.rspecs[name] = dict(rspec)
+        if name in self.replicas:
+            return
+        models = {
+            m["name"]: {
+                "state": "resident",
+                "version": int(m.get("modelVersion") or 1),
+                "page_ins": 1,
+            }
+            for m in rspec.get("models", [])
+        }
+        self.replicas[name] = {
+            "ready": True,
+            "version": 1,
+            "queue_depth": 0,
+            "inflight": 0,
+            "queue_wait_ms": 0.0,
+            "models": models,
+            "resident": len(models),
+        }
+
+    def roll(self, name, rspec):
+        for m in rspec.get("models", []):
+            row = self.replicas[name]["models"][m["name"]]
+            if row["state"] == "resident":
+                row["version"] = int(m.get("modelVersion") or 1)
+        self.rolls.append(name)
+        return 0.01
+
+
+def make_mux_deployment(**kwargs):
+    return serving_api.make_serving_deployment(
+        "mux",
+        replicas=2,
+        models=[
+            {"name": "alpha", "modelVersion": 1},
+            {"name": "beta", "modelVersion": 1, "priority": "batch"},
+        ],
+        **kwargs,
+    )
+
+
+def test_multiplexed_spec_flows_to_replicas():
+    api = FakeApiServer()
+    runtime = MuxRuntime()
+    controller = ServingDeploymentController(api, runtime=runtime)
+    api.create(make_mux_deployment(max_resident=1))
+    converge(controller)
+
+    assert len(runtime.replicas) == 2
+    for rspec in runtime.rspecs.values():
+        assert [m["name"] for m in rspec["models"]] == ["alpha", "beta"]
+        assert rspec["paging"] == {"maxResident": 1}
+    # Replica objects carry the same catalog (the worker's channel).
+    robj = api.get(
+        serving_api.REPLICA_KIND, serving_api.replica_name("mux", 0),
+        "default",
+    )
+    assert [m["name"] for m in robj.spec["models"]] == ["alpha", "beta"]
+
+
+def test_multiplexed_status_aggregates_per_model():
+    api = FakeApiServer()
+    runtime = MuxRuntime()
+    controller = ServingDeploymentController(api, runtime=runtime)
+    api.create(make_mux_deployment())
+    converge(controller)
+
+    status = api.get(serving_api.KIND, "mux", "default").status
+    by_name = {m["name"]: m for m in status["models"]}
+    assert set(by_name) == {"alpha", "beta"}
+    assert by_name["alpha"]["residentReplicas"] == 2
+    assert by_name["alpha"]["version"] == 1
+    assert by_name["alpha"]["pageIns"] == 2  # one per replica
+    assert all(r["resident"] == 2 for r in status["replicas"])
+
+
+def test_multiplexed_roll_targets_only_stale_resident_models():
+    api = FakeApiServer()
+    runtime = MuxRuntime()
+    controller = ServingDeploymentController(api, runtime=runtime)
+    api.create(make_mux_deployment())
+    converge(controller)
+    assert runtime.rolls == []
+
+    # beta pages out on replica 1: a version bump for beta must NOT
+    # roll that replica (its next page-in loads the new version free).
+    runtime.replicas[serving_api.replica_name("mux", 1)]["models"][
+        "beta"
+    ] = {"state": "registered", "version": 0, "page_ins": 1}
+
+    dep = api.get(serving_api.KIND, "mux", "default").thaw()
+    dep.spec = dict(dep.spec)
+    models = [dict(m) for m in dep.spec["models"]]
+    models[1]["modelVersion"] = 2  # bump beta only
+    dep.spec["models"] = models
+    api.update(dep)
+    converge(controller)
+
+    # Only replica 0 (beta resident + stale) rolled.
+    assert runtime.rolls == [serving_api.replica_name("mux", 0)]
+    events = [
+        e.spec for e in api.list("Event", "default")
+        if e.spec.get("reason") == "ReplicaRolled"
+    ]
+    assert events and "beta -> version 2" in events[-1]["message"]
+    # And alpha was never named: it is not stale.
+    assert "alpha" not in events[-1]["message"]
+
+
+def test_sync_replica_once_multimodel_loads_catalog():
+    from kubeflow_tpu.serving.__main__ import sync_replica_once
+    from kubeflow_tpu.api.objects import new_resource
+
+    api = FakeApiServer()
+    api.create(
+        new_resource(
+            serving_api.REPLICA_KIND,
+            "r0",
+            "default",
+            spec={
+                "model": "demo",
+                "maxBatch": 8,
+                "models": [
+                    {"name": "alpha", "modelVersion": 3},
+                    {"name": "beta", "modelVersion": 5},
+                ],
+            },
+        )
+    )
+    repo = FakeRepository()
+    live = sync_replica_once(
+        api, "r0", "default", repo, build_servable=build_servable
+    )
+    assert live == 5  # max across the catalog
+    assert sorted(repo.models) == ["alpha", "beta"]
+    assert repo.models["alpha"].version == 3
+    status = api.get(serving_api.REPLICA_KIND, "r0", "default").status
+    assert status["models"] == {"alpha": 3, "beta": 5}
+
+    # Idempotent: same versions -> no reloads.
+    sync_replica_once(
+        api, "r0", "default", repo, build_servable=build_servable
+    )
+    assert repo.loads == 2
+
+
+def test_models_and_paging_field_roundtrip_and_validation():
+    spec = serving_api.ServingDeploymentSpec(
+        models=(
+            serving_api.ModelEntry(name="alpha", model_version=2),
+            serving_api.ModelEntry(
+                name="beta", priority="batch", quota_rate=5.0,
+                quota_burst=10.0,
+            ),
+        ),
+        max_resident=1,
+    )
+    d = spec.to_dict()
+    assert [m["name"] for m in d["models"]] == ["alpha", "beta"]
+    assert d["models"][1]["priority"] == "batch"
+    assert d["models"][1]["quotaRate"] == 5.0
+    assert d["paging"] == {"maxResident": 1}
+    parsed = serving_api.ServingDeploymentSpec.from_dict(d)
+    assert parsed.models == spec.models
+    assert parsed.max_resident == 1
+    # Absent fields default to a single-model spec (old CRs parse).
+    legacy = serving_api.ServingDeploymentSpec.from_dict({})
+    assert legacy.models == () and legacy.max_resident == 0
+
+    with pytest.raises(ValueError, match="unique"):
+        serving_api.ServingDeploymentSpec(
+            models=(
+                serving_api.ModelEntry(name="a"),
+                serving_api.ModelEntry(name="a"),
+            )
+        ).validate()
+    with pytest.raises(ValueError, match="priority"):
+        serving_api.ModelEntry(name="a", priority="vip").validate()
+    with pytest.raises(ValueError, match="maxResident"):
+        serving_api.ServingDeploymentSpec(max_resident=-1).validate()
+    # Unknown fields inside a model entry are rejected (fat-finger
+    # protection, same policy as the spec root).
+    with pytest.raises(ValueError, match="unknown"):
+        serving_api.ServingDeploymentSpec.from_dict(
+            {"models": [{"name": "a", "quotaRte": 1}]}
+        )
+    with pytest.raises(ValueError, match="unknown"):
+        serving_api.ServingDeploymentSpec.from_dict(
+            {"paging": {"maxResidnt": 1}}
+        )

@@ -1,0 +1,244 @@
+"""Multi-model front door HTTP surface (ISSUE 17).
+
+`FrontDoorApp` is one HTTP boundary over the drain-aware `Router` for a
+whole multiplexed fleet: the ``/v1/models/<m>`` path segment selects
+the servable, priority/tenant ride headers, and every router verdict
+maps onto an honest status code (429 + jittered fractional Retry-After
+for sheds, 404 for an unknown model, 400 for client errors, 503 for a
+dead fleet). These tests drive the real registry → replica → router
+stack behind the app — no mocks on the serving path.
+"""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import (
+    AdmissionController,
+    BatchingConfig,
+    FrontDoorApp,
+    MultiModelReplica,
+    PagingConfig,
+    QuotaSpec,
+    Router,
+    ServableRegistry,
+)
+from kubeflow_tpu.serving import wire
+from kubeflow_tpu.serving.server import PRIORITY_HEADER, TENANT_HEADER
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+from kubeflow_tpu.web import TestClient
+
+
+class Doubler:
+    def __init__(self, name):
+        self.name = name
+        self.version = 1
+
+    def predict(self, instances):
+        return np.asarray(instances, dtype=np.float32) * 2.0
+
+
+@pytest.fixture()
+def stack():
+    metrics = MetricsRegistry()
+    admission = AdmissionController(
+        quotas={"capped": QuotaSpec(rate=0.001, burst=1.0)},
+        metrics=metrics,
+    )
+    router = Router(metrics, admission=admission, retry_jitter_seed=42)
+    registries = []
+    for i in range(2):
+        registry = ServableRegistry(
+            lambda rspec: Doubler(rspec["model"]),
+            batching=BatchingConfig(max_batch=4, timeout_ms=2.0),
+            paging=PagingConfig(max_resident=1),
+            metrics=metrics,
+        )
+        for model in ("alpha", "beta"):
+            registry.ensure({"model": model})
+        registries.append(registry)
+        router.add(MultiModelReplica(f"fd-{i}", registry))
+    app = FrontDoorApp(router, metrics=metrics)
+    yield app, TestClient(app), router
+    for name in list(router.replica_names()):
+        replica = router.replica(name)
+        router.remove(name)
+        replica.close()
+
+
+def test_models_list_aggregates_catalog(stack):
+    app, client, _ = stack
+    resp = client.get("/v1/models")
+    assert resp.status == 200
+    assert resp.json() == {"models": ["alpha", "beta"]}
+
+
+def test_predict_selects_model_from_path(stack):
+    app, client, _ = stack
+    for model in ("alpha", "beta"):
+        resp = client.post(
+            f"/v1/models/{model}:predict",
+            {"instances": [[1.0, 2.0]]},
+        )
+        assert resp.status == 200, resp.body
+        assert resp.json()["predictions"] == [[2.0, 4.0]]
+
+
+def test_binary_predict_roundtrip(stack):
+    app, client, _ = stack
+    x = np.ones((2, 3), np.float32)
+    resp = client.post(
+        "/v1/models/alpha:predict",
+        raw=wire.encode_tensor(x),
+        content_type=wire.TENSOR_CONTENT_TYPE,
+        headers={"Accept": wire.TENSOR_CONTENT_TYPE},
+    )
+    assert resp.status == 200, resp.body
+    assert resp.content_type == wire.TENSOR_CONTENT_TYPE
+    np.testing.assert_array_equal(wire.decode_tensor(resp.body), x * 2.0)
+
+
+def test_model_status_reports_residency(stack):
+    app, client, _ = stack
+    client.post("/v1/models/alpha:predict", {"instances": [[1.0]]})
+    resp = client.get("/v1/models/alpha")
+    assert resp.status == 200
+    body = resp.json()
+    assert body["resident_replicas"] >= 1
+    assert body["model_version_status"][0]["state"] == "AVAILABLE"
+    assert client.get("/v1/models/ghost").status == 404
+
+
+def test_unknown_model_predict_is_404(stack):
+    app, client, _ = stack
+    resp = client.post(
+        "/v1/models/ghost:predict", {"instances": [[1.0]]}
+    )
+    assert resp.status == 404
+
+
+def test_unknown_priority_is_400_not_shed(stack):
+    app, client, router = stack
+    shed_before = router.shed_total.value()
+    resp = client.post(
+        "/v1/models/alpha:predict",
+        {"instances": [[1.0]]},
+        headers={PRIORITY_HEADER: "vip"},
+    )
+    assert resp.status == 400
+    assert router.shed_total.value() == shed_before  # client error != shed
+
+
+def test_quota_shed_is_429_with_fractional_retry_after(stack):
+    app, client, router = stack
+    acked_before = router.acked_total.value()
+    first = client.post(
+        "/v1/models/alpha:predict",
+        {"instances": [[1.0]]},
+        headers={TENANT_HEADER: "capped"},
+    )
+    assert first.status == 200  # the burst token
+    resp = client.post(
+        "/v1/models/alpha:predict",
+        {"instances": [[1.0]]},
+        headers={TENANT_HEADER: "capped"},
+    )
+    assert resp.status == 429, resp.body
+    retry_after = dict(resp.headers)["Retry-After"]
+    assert "." in retry_after  # fractional seconds, docs/serving.md
+    assert float(retry_after) > 0.0
+    # One acked request total: the shed was refused pre-ack.
+    assert router.acked_total.value() == acked_before + 1
+    assert router.shed_total.value() >= 1
+
+
+def test_bad_tensor_frame_is_400_with_invalid_counter(stack):
+    app, client, _ = stack
+    before = app.request_count.value(model="alpha", outcome="invalid")
+    resp = client.post(
+        "/v1/models/alpha:predict",
+        raw=b"KFT1 definitely not a frame",
+        content_type=wire.TENSOR_CONTENT_TYPE,
+    )
+    assert resp.status == 400
+    after = app.request_count.value(model="alpha", outcome="invalid")
+    assert after == before + 1
+
+
+def test_empty_instances_is_400(stack):
+    app, client, _ = stack
+    resp = client.post("/v1/models/alpha:predict", {"instances": []})
+    assert resp.status == 400
+
+
+def test_dead_fleet_is_503(stack):
+    app, client, router = stack
+    for name in router.replica_names():
+        router.replica(name).kill()
+    resp = client.post(
+        "/v1/models/alpha:predict", {"instances": [[1.0]]}
+    )
+    assert resp.status == 503
+
+
+def test_metrics_endpoint_exposes_front_door_counters(stack):
+    app, client, _ = stack
+    client.post("/v1/models/alpha:predict", {"instances": [[1.0]]})
+    text = client.get("/metrics").body.decode()
+    assert "serving_front_door_requests_total" in text
+    assert "serving_page_ins_total" in text
+
+
+def test_cr_catalog_quota_reaches_the_front_door():
+    """End-to-end wiring regression: a `quotaRate` declared in the CR's
+    models[] must actually shed at the HTTP boundary — through the
+    ServingDeployment controller, the LocalReplicaRuntime hook, and
+    the router's per-model bucket — not sit decorative in the spec.
+    (Caught by a live-server drive: the spec fields validated and
+    round-tripped but nothing consumed them.)"""
+    from kubeflow_tpu.api import serving as serving_api
+    from kubeflow_tpu.controllers.serving import (
+        ServingDeploymentController,
+    )
+    from kubeflow_tpu.serving.replica import LocalReplicaRuntime
+    from kubeflow_tpu.testing import FakeApiServer
+
+    metrics = MetricsRegistry()
+    router = Router(metrics, retry_jitter_seed=7)
+    runtime = LocalReplicaRuntime(
+        router, lambda rspec: Doubler(rspec["model"]), metrics
+    )
+    api = FakeApiServer()
+    controller = ServingDeploymentController(
+        api, runtime=runtime, metrics=metrics
+    )
+    api.create(serving_api.make_serving_deployment(
+        "fd", replicas=1,
+        models=[
+            {"name": "alpha", "quotaRate": 0.001, "quotaBurst": 1.0},
+            {"name": "beta", "priority": "batch"},
+        ],
+    ))
+    controller.controller.run_until_idle()
+    try:
+        app = FrontDoorApp(router, metrics=metrics)
+        client = TestClient(app)
+        body = {"instances": [[1.0]]}
+
+        # Burst of 1: first request lands, second sheds honestly.
+        assert client.post(
+            "/v1/models/alpha:predict", body
+        ).status == 200
+        resp = client.post("/v1/models/alpha:predict", body)
+        assert resp.status == 429
+        assert float(dict(resp.headers)["Retry-After"]) > 0
+        # beta carries no quota — and its catalog-declared "batch"
+        # class resolves when the request names none (an unknown
+        # class here would be a 400).
+        assert client.post(
+            "/v1/models/beta:predict", body
+        ).status == 200
+    finally:
+        for name in list(router.replica_names()):
+            replica = router.replica(name)
+            router.remove(name)
+            replica.close()

@@ -1,0 +1,261 @@
+"""Multi-model servable registry: LRU weight paging + lifecycle (ISSUE 17).
+
+`serving/registry.py` is the tentpole's per-replica core: one catalog
+of N models, each with its own continuous-batch queue, with at most
+``max_resident`` holding live weights. These tests pin:
+
+- lazy page-in on first request, measured (`page_ins`,
+  `last_page_in_s`, the `serving_page_ins_total` counter);
+- LRU eviction at the residency limit, preferring idle victims;
+- a paged-out model transparently paging back in on its next request;
+- roll semantics: eager reload for a resident model, spec-only update
+  for a paged-out one, and the page-in-racing-roll interaction from the
+  docs/serving.md failure matrix (the roll waits the load out — no
+  caller is stranded on a discarded generation);
+- whole-registry kill: everything dies crisply, nothing resurrects.
+
+Per-model isolation under load (slow-model / kill-during-page-in) is
+pinned next door in tests/test_serving_batching.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import (
+    BatchingConfig,
+    ModelNotFound,
+    PagingConfig,
+    ServableRegistry,
+)
+from kubeflow_tpu.serving.batching import QueueClosed
+
+
+class Recorder:
+    """Factory + servable in one: records every build so tests can
+    assert exactly when page-ins happened."""
+
+    def __init__(self):
+        self.builds: list[str] = []
+        self._lock = threading.Lock()
+
+    def __call__(self, rspec: dict):
+        name = rspec["model"]
+        with self._lock:
+            self.builds.append(name)
+        outer = self
+
+        class _Servable:
+            def __init__(self):
+                self.name = name
+                self.version = int(rspec.get("modelVersion", 0) or 1)
+
+            def predict(self, instances):
+                return np.asarray(instances) * 2.0
+
+        del outer
+        return _Servable()
+
+
+def make_registry(max_resident=0, factory=None):
+    return ServableRegistry(
+        factory or Recorder(),
+        batching=BatchingConfig(max_batch=4, timeout_ms=2.0),
+        paging=PagingConfig(max_resident=max_resident),
+    )
+
+
+X = np.ones((1, 3))
+
+
+def test_page_in_is_lazy_and_measured():
+    factory = Recorder()
+    registry = make_registry(factory=factory)
+    try:
+        registry.ensure({"model": "a"})
+        assert factory.builds == []  # registration loads nothing
+        row = registry.stats()["models"]["a"]
+        assert row["state"] == "registered" and row["page_ins"] == 0
+
+        np.testing.assert_array_equal(registry.predict("a", X), X * 2.0)
+        assert factory.builds == ["a"]
+        row = registry.stats()["models"]["a"]
+        assert row["state"] == "resident"
+        assert row["page_ins"] == 1
+        assert row["last_page_in_s"] >= 0.0
+        assert registry.page_ins_total.value(model="a") == 1
+
+        registry.predict("a", X)  # resident: no rebuild
+        assert factory.builds == ["a"]
+    finally:
+        registry.close()
+
+
+def test_unknown_model_is_model_not_found():
+    registry = make_registry()
+    try:
+        with pytest.raises(ModelNotFound):
+            registry.predict("ghost", X)
+    finally:
+        registry.close()
+
+
+def test_lru_evicts_least_recently_used():
+    factory = Recorder()
+    registry = make_registry(max_resident=2, factory=factory)
+    try:
+        for name in ("a", "b", "c"):
+            registry.ensure({"model": name})
+        registry.predict("a", X)
+        time.sleep(0.01)  # monotonic last_used ordering
+        registry.predict("b", X)
+        time.sleep(0.01)
+        registry.predict("c", X)  # residency limit: "a" pages out
+
+        stats = registry.stats()
+        assert stats["resident"] == 2
+        assert stats["models"]["a"]["state"] == "registered"
+        assert stats["models"]["b"]["state"] == "resident"
+        assert stats["models"]["c"]["state"] == "resident"
+        assert registry.page_outs_total.value(model="a") == 1
+
+        # The paged-out model serves again — one more (measured) build.
+        np.testing.assert_array_equal(registry.predict("a", X), X * 2.0)
+        assert factory.builds == ["a", "b", "c", "a"]
+        assert registry.stats()["models"]["a"]["page_ins"] == 2
+        # ...and its page-in evicted the new LRU, "b".
+        assert registry.stats()["models"]["b"]["state"] == "registered"
+    finally:
+        registry.close()
+
+
+def test_predict_touch_refreshes_lru_rank():
+    registry = make_registry(max_resident=2)
+    try:
+        for name in ("a", "b", "c"):
+            registry.ensure({"model": name})
+        registry.predict("a", X)
+        time.sleep(0.01)
+        registry.predict("b", X)
+        time.sleep(0.01)
+        registry.predict("a", X)  # touch: "b" is now the LRU
+        time.sleep(0.01)
+        registry.predict("c", X)
+        stats = registry.stats()["models"]
+        assert stats["a"]["state"] == "resident"
+        assert stats["b"]["state"] == "registered"
+    finally:
+        registry.close()
+
+
+def test_roll_resident_reloads_eagerly():
+    factory = Recorder()
+    registry = make_registry(factory=factory)
+    try:
+        registry.ensure({"model": "a", "modelVersion": 1})
+        registry.predict("a", X)
+        registry.roll("a", {"model": "a", "modelVersion": 7})
+        # Still resident, new generation, no request needed.
+        row = registry.stats()["models"]["a"]
+        assert row["state"] == "resident" and row["version"] == 7
+        assert factory.builds == ["a", "a"]
+    finally:
+        registry.close()
+
+
+def test_roll_paged_out_updates_spec_only():
+    factory = Recorder()
+    registry = make_registry(factory=factory)
+    try:
+        registry.ensure({"model": "a", "modelVersion": 1})
+        registry.roll("a", {"model": "a", "modelVersion": 7})
+        assert factory.builds == []  # not resident: nothing loads
+        registry.predict("a", X)
+        assert registry.stats()["models"]["a"]["version"] == 7
+    finally:
+        registry.close()
+
+
+def test_roll_waits_out_inflight_page_in():
+    """Failure matrix: page-in-racing-roll. The roll must wait the
+    in-flight load out, then swap — the caller parked on the first
+    page-in completes against the generation it claimed, and the
+    post-roll version is the rolled spec's."""
+    release = threading.Event()
+    in_factory = threading.Event()
+    recorder = Recorder()
+
+    def factory(rspec):
+        if not in_factory.is_set():
+            in_factory.set()
+            release.wait(10)
+        return recorder(rspec)
+
+    registry = make_registry(factory=factory)
+    try:
+        registry.ensure({"model": "a", "modelVersion": 1})
+        results = []
+
+        def first_caller():
+            results.append(registry.predict("a", X))
+
+        t = threading.Thread(target=first_caller)
+        t.start()
+        assert in_factory.wait(5)  # page-in v1 is in flight
+
+        rolled = threading.Thread(
+            target=lambda: registry.roll(
+                "a", {"model": "a", "modelVersion": 2}
+            )
+        )
+        rolled.start()
+        time.sleep(0.05)
+        assert rolled.is_alive()  # parked behind the load, not yanking it
+
+        release.set()
+        t.join(timeout=10)
+        rolled.join(timeout=10)
+        assert not t.is_alive() and not rolled.is_alive()
+        assert len(results) == 1  # the racing caller was answered
+        assert registry.stats()["models"]["a"]["version"] == 2
+    finally:
+        release.set()
+        registry.close()
+
+
+def test_kill_registry_is_terminal():
+    registry = make_registry()
+    try:
+        registry.ensure({"model": "a"})
+        registry.predict("a", X)
+        registry.kill()
+        with pytest.raises(QueueClosed):
+            registry.predict("a", X)
+        assert registry.stats()["closed"]
+    finally:
+        registry.close()
+
+
+def test_factory_failure_surfaces_and_does_not_poison():
+    """A failed page-in reports its error to the waiting callers but
+    leaves the catalog entry retryable — the next request tries again."""
+    boom = [True]
+    recorder = Recorder()
+
+    def factory(rspec):
+        if boom[0]:
+            raise RuntimeError("checkpoint store down")
+        return recorder(rspec)
+
+    registry = make_registry(factory=factory)
+    try:
+        registry.ensure({"model": "a"})
+        with pytest.raises(RuntimeError, match="checkpoint store down"):
+            registry.predict("a", X)
+        assert registry.stats()["models"]["a"]["state"] == "registered"
+        boom[0] = False
+        np.testing.assert_array_equal(registry.predict("a", X), X * 2.0)
+    finally:
+        registry.close()

@@ -43,7 +43,7 @@ class FakeReplica:
         if self.gate is not None:
             self.gate.set()
 
-    def predict(self, x):
+    def predict(self, x, model=None):
         with self._lock:
             self.calls += 1
             fail, self.fail_once = self.fail_once, None
@@ -281,3 +281,211 @@ def test_all_draining_is_overloaded_not_dead():
         router.predict(1)
     router.admit(a.name)
     assert router.predict(1)[0] == "ok"
+
+
+# -- priority admission + quotas + jittered backoff (ISSUE 17) ---------------
+
+
+def test_unknown_priority_class_is_value_error():
+    from kubeflow_tpu.serving import AdmissionController
+
+    router, _ = make_fleet(n=1)
+    router.admission = AdmissionController()
+    with pytest.raises(ValueError, match="unknown priority"):
+        router.predict("x", priority="vip")
+
+
+def test_batch_sheds_at_its_ceiling_while_critical_passes():
+    """Headroom ladder: with fleet occupancy parked at the batch
+    ceiling (0.5x slots), batch sheds pre-ack while critical still
+    dispatches — the reserved slots are critical's to spend."""
+    from kubeflow_tpu.serving import AdmissionController
+
+    router, replicas = make_fleet(n=1, capacity=8)
+    router.admission = AdmissionController()
+    gate = threading.Event()
+    replicas[0].gate = gate
+    holders = [
+        threading.Thread(target=lambda: router.predict("x"))
+        for _ in range(4)
+    ]
+    try:
+        for t in holders:
+            t.start()
+        deadline = time.monotonic() + 5
+        while (
+            router.stats()["outstanding"] < 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        assert router.stats()["outstanding"] == 4  # == 0.5 * 8 slots
+
+        shed_before = counts(router)["shed"]
+        with pytest.raises(Overloaded) as excinfo:
+            router.predict("x", priority="batch")
+        assert "headroom" in str(excinfo.value)
+        assert excinfo.value.retry_after > 0
+        after = counts(router)
+        assert after["shed"] == shed_before + 1
+        # An honest shed is never acked.
+        assert after["acked"] == 4
+
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(
+                router.predict("x", priority="critical")
+            )
+        )
+        t.start()
+        time.sleep(0.1)
+        gate.set()
+        t.join(timeout=10)
+        assert done and done[0][0] == "ok"
+    finally:
+        gate.set()
+        for t in holders:
+            t.join(timeout=10)
+
+
+def test_tenant_quota_bucket_charges_once_per_request():
+    """Token-bucket quota: burst tokens spend one per REQUEST — a
+    dispatch retry after a replica death must not double-charge — and
+    an empty bucket sheds with a time-to-next-token hint."""
+    from kubeflow_tpu.serving import AdmissionController, QuotaSpec
+
+    clock = [100.0]
+    admission = AdmissionController(
+        quotas={"acme": QuotaSpec(rate=1.0, burst=2.0)},
+        clock=lambda: clock[0],
+    )
+    router, replicas = make_fleet(n=2)
+    router.admission = admission
+
+    # First request eats a token AND a dispatch retry (replica death
+    # mid-flight, respread to the survivor) — still one token.
+    replicas[0].fail_once = ReplicaGone("boom")
+    replicas[1].fail_once = ReplicaOverloaded("full")
+    out = router.predict("x", tenant="acme")
+    assert out[0] == "ok"
+    router.predict("x", tenant="acme")  # second token
+    with pytest.raises(Overloaded) as excinfo:
+        router.predict("x", tenant="acme")
+    assert "over quota" in str(excinfo.value)
+    # Hint ~1s to the next token, spread [0.5, 1.5]x by the jitter.
+    assert 0.4 <= excinfo.value.retry_after <= 1.6
+
+    clock[0] += 1.0  # refill exactly one token
+    router.predict("x", tenant="acme")
+    with pytest.raises(Overloaded):
+        router.predict("x", tenant="acme")
+    # Untenanted traffic is uncapped throughout.
+    assert router.predict("x")[0] == "ok"
+
+
+def test_retry_after_jitter_is_seeded_and_spread():
+    """Shed hints are deterministic per seed (chaos replays) but spread
+    across [0.5, 1.5]x base (no synchronized retry wave)."""
+
+    def shed_sequence(seed, n=8):
+        router = Router(retry_jitter_seed=seed)
+        hints = []
+        for _ in range(n):
+            try:
+                router.predict("x")
+            except NoReadyReplicas:
+                pass
+            try:
+                raise Overloaded("probe", retry_after=router._retry_hint())
+            except Overloaded as e:
+                hints.append(e.retry_after)
+        return hints
+
+    a, b, c = shed_sequence(7), shed_sequence(7), shed_sequence(11)
+    assert a == b  # same seed -> same schedule
+    assert a != c
+    base = Router().retry_after_s
+    assert all(0.5 * base <= h <= 1.5 * base for h in a)
+    spread = max(a) - min(a)
+    assert spread > 0.1 * base  # actually jittered, not constant
+
+
+def test_model_policy_wires_catalog_quota_and_priority():
+    """CR catalog → router: `set_model_policy` turns models[].quotaRate
+    into a live per-model bucket (key "model:<name>") and models[].
+    priority into the default class for requests that name none — the
+    wiring the ServingDeployment controller pushes on every reconcile,
+    so a quotaRate in the CR is enforcement, not decoration."""
+    from kubeflow_tpu.api.serving import ModelEntry
+
+    clock = [100.0]
+    router, _ = make_fleet(n=2)
+    router.set_model_policy([
+        ModelEntry("alpha", quota_rate=1.0, quota_burst=2.0),
+        ModelEntry("beta", priority="batch"),
+    ])
+    assert router.admission is not None
+    router.admission._clock = lambda: clock[0]
+    # Re-stamp the bucket onto the injected clock.
+    router.admission.set_quota(
+        "model:alpha", router.admission.quotas["model:alpha"]
+    )
+
+    router.predict("x", model="alpha")
+    router.predict("x", model="alpha")  # burst spent
+    with pytest.raises(Overloaded) as excinfo:
+        router.predict("x", model="alpha")
+    assert "over quota" in str(excinfo.value)
+    router.predict("x", model="beta")  # no quota on beta
+
+    # Resync idempotence: an unchanged catalog must NOT refill the
+    # bucket (set_quota would re-grant the burst every 50ms resync).
+    router.set_model_policy([
+        ModelEntry("alpha", quota_rate=1.0, quota_burst=2.0),
+        ModelEntry("beta", priority="batch"),
+    ])
+    with pytest.raises(Overloaded):
+        router.predict("x", model="alpha")
+
+    # priority=None defers to the catalog class; beta declared "batch",
+    # which check_priority sheds first under pressure — here just pin
+    # that the resolved class reaches the headroom gate (unknown class
+    # would raise ValueError, "standard" fallback for alpha).
+    router.predict("x", model="beta", priority=None)
+    clock[0] += 10.0
+    router.predict("x", model="alpha", priority=None)
+
+    # Dropping the quota from the catalog removes the bucket.
+    router.set_model_policy([ModelEntry("alpha"), ModelEntry("beta")])
+    assert "model:alpha" not in router.admission.quotas
+    for _ in range(5):
+        router.predict("x", model="alpha")
+
+
+def test_model_quota_shed_refunds_tenant_token():
+    """All-or-nothing multi-bucket charge: when the model bucket sheds,
+    the tenant token charged first is refunded — a capped model must
+    not silently drain its tenants' quotas."""
+    from kubeflow_tpu.serving import AdmissionController, QuotaSpec
+
+    clock = [100.0]
+    admission = AdmissionController(
+        quotas={
+            "acme": QuotaSpec(rate=1.0, burst=5.0),
+            "model:m": QuotaSpec(rate=0.001, burst=1.0),
+        },
+        clock=lambda: clock[0],
+    )
+    router, _ = make_fleet(n=2)
+    router.admission = admission
+
+    router.predict("x", model="m", tenant="acme")  # spends both
+    for _ in range(3):  # model bucket empty; tenant must NOT drain
+        with pytest.raises(Overloaded) as excinfo:
+            router.predict("x", model="m", tenant="acme")
+        assert "model:m" in str(excinfo.value)
+    # 4 tenant tokens remain: all spent on an uncapped model.
+    for _ in range(4):
+        router.predict("x", model="other", tenant="acme")
+    with pytest.raises(Overloaded) as excinfo:
+        router.predict("x", model="other", tenant="acme")
+    assert "'acme' over quota" in str(excinfo.value)

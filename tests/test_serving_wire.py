@@ -94,6 +94,48 @@ def test_corrupt_frames_refused(data):
         wire.decode_tensor(data)
 
 
+def _frame(header: bytes, payload: bytes = b"") -> bytes:
+    """Hand-build a frame around an arbitrary (hostile) header."""
+    return b"KFT1" + len(header).to_bytes(4, "little") + header + payload
+
+
+# Frames that are structurally intact — magic, length, ascii header —
+# but whose header is hostile (ISSUE 17 satellite). Each must die as a
+# WireFormatError in decode_tensor, never as a raw ValueError out of
+# np.dtype/reshape.
+HOSTILE_FRAMES = [
+    _frame(b"<U4:2", b"\x00" * 32),  # str dtype
+    _frame(b"object:1", b"\x00" * 8),  # object dtype
+    _frame(b"|V8:1", b"\x00" * 8),  # void/record dtype
+    _frame(b"<M8[s]:2", b"\x00" * 16),  # datetime dtype
+    _frame(b"<f4:-1,4", b"\x00" * 16),  # negative dim -> inferred reshape
+    _frame(b"<f4:2,,2", b"\x00" * 16),  # empty dims component
+    # int64-wrap collision: 4 * 4611686018427387905 == 2**64 + 4, so a
+    # wrapping product "matches" this 4-byte payload and reshape gets a
+    # 2**62-element shape. math.prod must catch it as a mismatch.
+    _frame(b"<f4:4611686018427387905", b"\x00" * 4),
+]
+
+
+@pytest.mark.parametrize("data", HOSTILE_FRAMES)
+def test_hostile_headers_refused(data):
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_tensor(data)
+
+
+@pytest.mark.parametrize(
+    "arr",
+    [
+        np.array(["a", "b"]),  # str
+        np.array([b"x"]),  # bytes
+        np.zeros(2, dtype="M8[s]"),  # datetime
+    ],
+)
+def test_non_numeric_encode_refused(arr):
+    with pytest.raises(wire.WireFormatError):
+        wire.encode_tensor(arr)
+
+
 def test_negotiation_helpers():
     tensor, js = wire.TENSOR_CONTENT_TYPE, "application/json"
     assert wire.is_tensor_request({"content-type": tensor})
@@ -210,6 +252,22 @@ def test_scalar_frame_is_400(client):
         content_type=wire.TENSOR_CONTENT_TYPE,
     )
     assert resp.status == 400  # no leading batch dimension
+
+
+@pytest.mark.parametrize("data", HOSTILE_FRAMES)
+def test_hostile_frame_is_clean_400_with_counter(client, app, data):
+    """Server boundary for the hostile headers: a clean 400 (not an
+    unhandled ValueError 500 out of the WSGI handler) and an invalid
+    request-counter bump the dashboards can alert on."""
+    before = app.request_count.value(model="mnist", outcome="invalid")
+    resp = client.post(
+        "/v1/models/mnist:predict",
+        raw=data,
+        content_type=wire.TENSOR_CONTENT_TYPE,
+    )
+    assert resp.status == 400, resp.body
+    after = app.request_count.value(model="mnist", outcome="invalid")
+    assert after == before + 1
 
 
 # -- pooled transport over a real server -------------------------------------
